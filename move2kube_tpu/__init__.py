@@ -1,0 +1,20 @@
+"""move2kube-tpu: re-platform applications onto Kubernetes with a TPU-first target.
+
+A ground-up, TPU-native rebuild of the capabilities of Move2Kube
+(reference: /root/reference, a pure-Go CLI — see SURVEY.md). The pipeline is:
+
+    source dir -> Plan -> (QA curation) -> IR -> IR passes -> objects -> files
+
+plus the net-new north star: detection of CUDA/NCCL/DeepSpeed GPU training
+workloads and their translation into JAX/XLA TPU deployments (JobSet pod
+slices with ``google.com/tpu`` resources), backed by a JAX model zoo
+(``move2kube_tpu.models``) with real dp/fsdp/tp/sp sharding
+(``move2kube_tpu.parallel``) and Pallas TPU kernels (``move2kube_tpu.ops``).
+"""
+
+__version__ = "0.1.0"
+
+APP_NAME = "move2kube-tpu"
+APP_NAME_SHORT = "m2kt"
+GROUP_NAME = "move2kube-tpu.io"
+API_VERSION = GROUP_NAME + "/v1alpha1"

@@ -1,0 +1,1 @@
+from move2kube_tpu.types import plan, ir, collection, output  # noqa: F401

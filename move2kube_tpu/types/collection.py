@@ -1,0 +1,186 @@
+"""Schemas for collector outputs.
+
+Parity with ``types/collection/`` in the reference: ClusterMetadata
+(cluster.go:28-120) with version-preference resolution, ImageInfo
+(image.go:27-50), CF app schemas (cfinstanceapps.go, cfcontainerizers.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from move2kube_tpu.utils import common
+
+CLUSTER_METADATA_KIND = "ClusterMetadata"
+IMAGES_METADATA_KIND = "ImageMetadata"
+CF_APPS_KIND = "CfApps"
+CF_CONTAINERIZERS_KIND = "CfContainerizers"
+
+
+@dataclass
+class ClusterMetadataSpec:
+    """Supported kinds/versions + storage classes of a target cluster.
+
+    ``api_kind_version_map`` maps Kind -> ordered list of group/version
+    strings, most-preferred first (parity: cluster.go:28-60).
+    """
+
+    api_kind_version_map: dict[str, list[str]] = field(default_factory=dict)
+    storage_classes: list[str] = field(default_factory=list)
+    # net-new: TPU capability of the cluster (empty = no TPU node pools)
+    tpu_accelerators: list[str] = field(default_factory=list)  # e.g. tpu-v5-lite-podslice
+    host_capabilities: dict[str, str] = field(default_factory=dict)
+
+    def get_supported_versions(self, kind: str) -> list[str]:
+        """Preferred group/versions for kind, or [] if unsupported
+        (parity: GetSupportedVersions cluster.go:107)."""
+        return list(self.api_kind_version_map.get(kind, []))
+
+    def supports_kind(self, kind: str) -> bool:
+        return bool(self.api_kind_version_map.get(kind))
+
+    def supports_tpu(self) -> bool:
+        return bool(self.tpu_accelerators)
+
+    def merge(self, other: "ClusterMetadataSpec") -> None:
+        for kind, versions in other.api_kind_version_map.items():
+            mine = self.api_kind_version_map.setdefault(kind, [])
+            for v in versions:
+                if v not in mine:
+                    mine.append(v)
+        for sc in other.storage_classes:
+            if sc not in self.storage_classes:
+                self.storage_classes.append(sc)
+        for acc in other.tpu_accelerators:
+            if acc not in self.tpu_accelerators:
+                self.tpu_accelerators.append(acc)
+
+    def to_dict(self) -> dict:
+        d: dict = {"apiKindVersionMap": self.api_kind_version_map}
+        if self.storage_classes:
+            d["storageClasses"] = self.storage_classes
+        if self.tpu_accelerators:
+            d["tpuAccelerators"] = self.tpu_accelerators
+        if self.host_capabilities:
+            d["hostCapabilities"] = self.host_capabilities
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ClusterMetadataSpec":
+        return cls(
+            api_kind_version_map={
+                k: list(v) for k, v in d.get("apiKindVersionMap", {}).items()
+            },
+            storage_classes=list(d.get("storageClasses", [])),
+            tpu_accelerators=list(d.get("tpuAccelerators", [])),
+            host_capabilities=dict(d.get("hostCapabilities", {})),
+        )
+
+
+@dataclass
+class ClusterMetadata:
+    name: str = ""
+    spec: ClusterMetadataSpec = field(default_factory=ClusterMetadataSpec)
+
+    def to_dict(self) -> dict:
+        doc = common.new_m2kt_doc(CLUSTER_METADATA_KIND, self.name)
+        doc["spec"] = self.spec.to_dict()
+        return doc
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ClusterMetadata":
+        return cls(
+            name=d.get("metadata", {}).get("name", ""),
+            spec=ClusterMetadataSpec.from_dict(d.get("spec", {})),
+        )
+
+
+def read_cluster_metadata(path: str) -> ClusterMetadata:
+    return ClusterMetadata.from_dict(common.read_m2kt_yaml(path, CLUSTER_METADATA_KIND))
+
+
+@dataclass
+class ImageInfo:
+    """Inspected image metadata (parity: types/collection/image.go:27-50)."""
+
+    names: list[str] = field(default_factory=list)
+    tags: list[tuple[str, str]] = field(default_factory=list)  # (name, tag)
+    user_id: int = -1
+    accessed_dirs: list[str] = field(default_factory=list)
+    ports_to_expose: list[int] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        doc = common.new_m2kt_doc(IMAGES_METADATA_KIND)
+        doc["spec"] = {
+            "tags": [f"{n}:{t}" for n, t in self.tags] or list(self.names),
+            "userID": self.user_id,
+            "accessedDirs": self.accessed_dirs,
+            "portsToExpose": self.ports_to_expose,
+        }
+        return doc
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ImageInfo":
+        spec = d.get("spec", {})
+        info = cls(
+            user_id=spec.get("userID", -1),
+            accessed_dirs=list(spec.get("accessedDirs", [])),
+            ports_to_expose=list(spec.get("portsToExpose", [])),
+        )
+        for t in spec.get("tags", []):
+            if ":" in t:
+                name, tag = t.rsplit(":", 1)
+                info.tags.append((name, tag))
+            info.names.append(t)
+        return info
+
+
+@dataclass
+class CfApp:
+    name: str = ""
+    buildpack: str = ""
+    detected_buildpack: str = ""
+    memory_mb: int = 0
+    instances: int = 1
+    ports: list[int] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class CfInstanceApps:
+    apps: list[CfApp] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        doc = common.new_m2kt_doc(CF_APPS_KIND)
+        doc["spec"] = {
+            "applications": [
+                {
+                    "name": a.name,
+                    "buildpack": a.buildpack,
+                    "detectedBuildpack": a.detected_buildpack,
+                    "memoryMB": a.memory_mb,
+                    "instances": a.instances,
+                    "ports": a.ports,
+                    "env": a.env,
+                }
+                for a in self.apps
+            ]
+        }
+        return doc
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CfInstanceApps":
+        apps = []
+        for a in d.get("spec", {}).get("applications", []):
+            apps.append(
+                CfApp(
+                    name=a.get("name", ""),
+                    buildpack=a.get("buildpack", ""),
+                    detected_buildpack=a.get("detectedBuildpack", ""),
+                    memory_mb=a.get("memoryMB", 0),
+                    instances=a.get("instances", 1),
+                    ports=list(a.get("ports", [])),
+                    env=dict(a.get("env", {})),
+                )
+            )
+        return cls(apps=apps)

@@ -1,0 +1,334 @@
+"""Intermediate representation carried through the translate pipeline.
+
+Parity with the reference's ``internal/types/ir.go``: a single mutable
+document holding services, images-to-build, storages, RBAC, target-cluster
+spec, cached pre-existing k8s objects, Helm values and Tekton wiring, with
+merge semantics for combining per-translator IRs (ir.go:256-278).
+
+The reference embeds ``corev1.PodSpec`` in its Service (ir.go:63-125); we
+have no client-go, so pod-level fields live in plain dicts that follow the
+k8s schema (they are emitted as YAML verbatim), with typed helpers for the
+fields the IR passes manipulate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from move2kube_tpu.types.collection import ClusterMetadataSpec
+from move2kube_tpu.types.output import HelmValues
+from move2kube_tpu.types.plan import (
+    AcceleratorInfo,
+    ContainerBuildType,
+    KubernetesOutput,
+    Plan,
+    PlanService,
+)
+from move2kube_tpu.utils import common
+
+
+# --- Storage (parity: ir.go:295-333) ---------------------------------------
+
+class StorageKind:
+    CONFIGMAP = "ConfigMap"
+    SECRET = "Secret"
+    PULL_SECRET = "PullSecret"
+    PVC = "PersistentVolumeClaim"
+
+
+@dataclass
+class Storage:
+    name: str
+    kind: str = StorageKind.CONFIGMAP
+    content: dict[str, bytes] = field(default_factory=dict)
+    secret_type: str = ""  # k8s secret type, e.g. kubernetes.io/dockerconfigjson
+    pvc_spec: dict = field(default_factory=dict)  # corev1.PersistentVolumeClaimSpec
+    annotations: dict[str, str] = field(default_factory=dict)
+
+    def merge(self, other: "Storage") -> bool:
+        if self.name != other.name:
+            return False
+        if other.kind:
+            self.kind = other.kind
+        self.content.update(other.content)
+        if other.secret_type:
+            self.secret_type = other.secret_type
+        if other.pvc_spec:
+            self.pvc_spec = other.pvc_spec
+        self.annotations.update(other.annotations)
+        return True
+
+
+# --- Container: an image to build or reuse (parity: ir.go:127-235) ---------
+
+@dataclass
+class RepoInfo:
+    git_repo_url: str = ""
+    git_repo_branch: str = ""
+    git_repo_dir: str = ""  # service dir relative to repo root
+    target_path: str = ""
+
+
+@dataclass
+class Container:
+    image_names: list[str] = field(default_factory=list)
+    new: bool = True  # False => image already exists, nothing to build
+    build_type: str = ContainerBuildType.NEW_DOCKERFILE
+    # generated files (Dockerfile, build scripts, rewritten training code...)
+    # keyed by path relative to the output containers/<svc>/ dir
+    new_files: dict[str, str] = field(default_factory=dict)
+    exposed_ports: list[int] = field(default_factory=list)
+    user_id: int = -1
+    accessed_dirs: list[str] = field(default_factory=list)
+    repo_info: RepoInfo = field(default_factory=RepoInfo)
+    # net-new: accelerator requirements the TPU apiresources read
+    accelerator: AcceleratorInfo | None = None
+
+    def add_file(self, path: str, contents: str) -> None:
+        self.new_files[path] = contents
+
+    def add_exposed_port(self, port: int) -> None:
+        if port not in self.exposed_ports:
+            self.exposed_ports.append(port)
+
+    def merge(self, other: "Container") -> bool:
+        """Dedup-merge: True if other refers to the same image (ir.go:180-235).
+
+        Containers with different build types are never merged (ir.go:170) —
+        they stay separate entries even when image names collide.
+        """
+        if self.build_type != other.build_type:
+            return False
+        if not set(self.image_names) & set(other.image_names):
+            return False
+        for n in other.image_names:
+            if n not in self.image_names:
+                self.image_names.append(n)
+        self.new = self.new or other.new
+        self.new_files.update(other.new_files)
+        for p in other.exposed_ports:
+            self.add_exposed_port(p)
+        if other.user_id >= 0:
+            self.user_id = other.user_id
+        for d in other.accessed_dirs:
+            if d not in self.accessed_dirs:
+                self.accessed_dirs.append(d)
+        if other.accelerator is not None:
+            self.accelerator = other.accelerator
+        return True
+
+
+def new_container_from_image_info(info) -> Container:
+    """Build a non-new Container from collected ImageInfo (ir.go:214-235)."""
+    c = Container(new=False, build_type=ContainerBuildType.REUSE)
+    c.image_names = [f"{name}:{tag}" for name, tag in info.tags] or list(info.names)
+    c.user_id = info.user_id
+    c.exposed_ports = list(info.ports_to_expose)
+    c.accessed_dirs = list(info.accessed_dirs)
+    return c
+
+
+# --- Service (parity: ir.go:63-125) ----------------------------------------
+
+@dataclass
+class PortForwarding:
+    service_port: int
+    container_port: int
+    name: str = ""
+
+
+@dataclass
+class Service:
+    name: str
+    backend_service_name: str = ""  # when k8s name differs from plan name
+    # pod-level fields as corev1-schema dicts (emitted verbatim):
+    containers: list[dict] = field(default_factory=list)  # corev1.Container
+    init_containers: list[dict] = field(default_factory=list)
+    volumes: list[dict] = field(default_factory=list)  # corev1.Volume
+    image_pull_secrets: list[str] = field(default_factory=list)
+    security_context: dict = field(default_factory=dict)
+    restart_policy: str = ""  # Always | OnFailure | Never
+    service_account_name: str = ""
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: list[dict] = field(default_factory=list)
+    subdomain: str = ""
+    hostname: str = ""
+    # service-level:
+    annotations: dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    replicas: int = 1
+    networks: list[str] = field(default_factory=list)
+    port_forwardings: list[PortForwarding] = field(default_factory=list)
+    service_rel_path: str = ""  # ingress fan-out path, default "/<name>"
+    only_ingress: bool = False
+    daemon: bool = False
+    # net-new TPU fields:
+    accelerator: AcceleratorInfo | None = None
+    job: bool = False  # run-to-completion workload (training) vs long-running
+
+    def add_port_forwarding(self, service_port: int, container_port: int, name: str = "") -> None:
+        for pf in self.port_forwardings:
+            if pf.service_port == service_port:
+                return
+        self.port_forwardings.append(PortForwarding(service_port, container_port, name))
+
+    def add_volume(self, volume: dict) -> None:
+        if all(v.get("name") != volume.get("name") for v in self.volumes):
+            self.volumes.append(volume)
+
+    def has_valid_annotation(self, annotation: str) -> bool:
+        return self.annotations.get(annotation) == "true"
+
+    def pod_spec(self) -> dict:
+        """Assemble the corev1.PodSpec dict for emission."""
+        spec: dict[str, Any] = {"containers": [dict(c) for c in self.containers]}
+        if self.init_containers:
+            spec["initContainers"] = [dict(c) for c in self.init_containers]
+        if self.volumes:
+            spec["volumes"] = self.volumes
+        if self.image_pull_secrets:
+            spec["imagePullSecrets"] = [{"name": n} for n in self.image_pull_secrets]
+        if self.security_context:
+            spec["securityContext"] = self.security_context
+        if self.restart_policy:
+            spec["restartPolicy"] = self.restart_policy
+        if self.service_account_name:
+            spec["serviceAccountName"] = self.service_account_name
+        if self.node_selector:
+            spec["nodeSelector"] = self.node_selector
+        if self.tolerations:
+            spec["tolerations"] = self.tolerations
+        if self.hostname:
+            spec["hostname"] = self.hostname
+        if self.subdomain:
+            spec["subdomain"] = self.subdomain
+        return spec
+
+    def merge(self, other: "Service") -> None:
+        self.containers.extend(c for c in other.containers if c not in self.containers)
+        self.init_containers.extend(
+            c for c in other.init_containers if c not in self.init_containers
+        )
+        self.tolerations.extend(t for t in other.tolerations if t not in self.tolerations)
+        if other.security_context:
+            self.security_context = other.security_context
+        if other.service_account_name:
+            self.service_account_name = other.service_account_name
+        if other.hostname:
+            self.hostname = other.hostname
+        if other.subdomain:
+            self.subdomain = other.subdomain
+        for v in other.volumes:
+            self.add_volume(v)
+        for s in other.image_pull_secrets:
+            if s not in self.image_pull_secrets:
+                self.image_pull_secrets.append(s)
+        self.annotations.update(other.annotations)
+        self.labels.update(other.labels)
+        self.replicas = max(self.replicas, other.replicas)
+        for n in other.networks:
+            if n not in self.networks:
+                self.networks.append(n)
+        for pf in other.port_forwardings:
+            self.add_port_forwarding(pf.service_port, pf.container_port, pf.name)
+        if other.restart_policy:
+            self.restart_policy = other.restart_policy
+        self.node_selector.update(other.node_selector)
+        self.daemon = self.daemon or other.daemon
+        self.job = self.job or other.job
+        if other.accelerator is not None:
+            self.accelerator = other.accelerator
+
+
+# --- Tekton wiring (parity: internal/types/tekton/tekton.go) ---------------
+
+@dataclass
+class TektonResources:
+    event_listeners: list[dict] = field(default_factory=list)
+    trigger_bindings: list[dict] = field(default_factory=list)
+    trigger_templates: list[dict] = field(default_factory=list)
+    pipelines: list[dict] = field(default_factory=list)
+
+
+# --- IR root (parity: ir.go:36-60, 237-400) --------------------------------
+
+@dataclass
+class IR:
+    name: str = common.DEFAULT_PROJECT_NAME
+    services: dict[str, Service] = field(default_factory=dict)
+    containers: list[Container] = field(default_factory=list)
+    storages: list[Storage] = field(default_factory=list)
+    roles: list[dict] = field(default_factory=list)
+    role_bindings: list[dict] = field(default_factory=list)
+    service_accounts: list[dict] = field(default_factory=list)
+    kubernetes: KubernetesOutput = field(default_factory=KubernetesOutput)
+    target_cluster_spec: ClusterMetadataSpec = field(default_factory=ClusterMetadataSpec)
+    cached_objects: list[dict] = field(default_factory=list)  # pre-existing k8s yamls
+    values: HelmValues = field(default_factory=HelmValues)
+    tekton: TektonResources = field(default_factory=TektonResources)
+    ingress_tls_secret_name: str = ""
+
+    def add_service(self, svc: Service) -> None:
+        if svc.name in self.services:
+            self.services[svc.name].merge(svc)
+        else:
+            self.services[svc.name] = svc
+
+    def add_container(self, container: Container) -> None:
+        """Dedup-add by image name (parity: IR.AddContainer ir.go:368)."""
+        for existing in self.containers:
+            if existing.merge(container):
+                return
+        self.containers.append(container)
+
+    def add_storage(self, storage: Storage) -> None:
+        for existing in self.storages:
+            if existing.name == storage.name and existing.kind == storage.kind:
+                existing.merge(storage)
+                return
+        self.storages.append(storage)
+
+    def get_container(self, image_name: str) -> Container | None:
+        for c in self.containers:
+            if image_name in c.image_names:
+                return c
+        return None
+
+    def merge(self, other: "IR") -> None:
+        """Combine another translator's IR into this one (ir.go:256-278)."""
+        for svc in other.services.values():
+            self.add_service(svc)
+        for c in other.containers:
+            self.add_container(c)
+        for s in other.storages:
+            self.add_storage(s)
+        self.roles.extend(r for r in other.roles if r not in self.roles)
+        self.role_bindings.extend(r for r in other.role_bindings if r not in self.role_bindings)
+        self.service_accounts.extend(
+            s for s in other.service_accounts if s not in self.service_accounts
+        )
+        self.kubernetes.merge(other.kubernetes)
+        self.target_cluster_spec.merge(other.target_cluster_spec)
+        self.cached_objects.extend(other.cached_objects)
+        self.values.merge(other.values)
+        if other.ingress_tls_secret_name:
+            self.ingress_tls_secret_name = other.ingress_tls_secret_name
+
+
+def new_ir(plan: Plan) -> IR:
+    import copy
+
+    ir = IR(name=plan.name)
+    # Deep copy: Go copies KubernetesOutput by value (ir.go:245); sharing the
+    # object here would leak translate-phase mutations back into the plan file.
+    ir.kubernetes = copy.deepcopy(plan.kubernetes)
+    return ir
+
+
+def service_from_plan(plan_svc: PlanService) -> Service:
+    svc = Service(name=common.make_dns_label(plan_svc.service_name))
+    svc.service_rel_path = plan_svc.service_rel_path or "/" + svc.name
+    if plan_svc.accelerator is not None:
+        svc.accelerator = plan_svc.accelerator
+    return svc

@@ -1,0 +1,50 @@
+"""Helm values.yaml schema (parity: types/output/helmvaluesoutput.go:31-80)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class HelmValues:
+    registry_url: str = ""
+    registry_namespace: str = ""
+    ingress_host: str = ""
+    # service name -> container name -> image (registry/ns/name:tag)
+    services: dict[str, dict[str, str]] = field(default_factory=dict)
+    storage_class: str = ""
+    global_variables: dict[str, str] = field(default_factory=dict)
+
+    def merge(self, other: "HelmValues") -> None:
+        if other.registry_url:
+            self.registry_url = other.registry_url
+        if other.registry_namespace:
+            self.registry_namespace = other.registry_namespace
+        if other.ingress_host:
+            self.ingress_host = other.ingress_host
+        for svc, containers in other.services.items():
+            self.services.setdefault(svc, {}).update(containers)
+        if other.storage_class:
+            self.storage_class = other.storage_class
+        self.global_variables.update(other.global_variables)
+
+    def set_image(self, service: str, container: str, image: str) -> None:
+        self.services.setdefault(service, {})[container] = image
+
+    def to_dict(self) -> dict:
+        d: dict = {
+            "registryurl": self.registry_url,
+            "registrynamespace": self.registry_namespace,
+        }
+        if self.ingress_host:
+            d["ingresshost"] = self.ingress_host
+        if self.services:
+            d["services"] = {
+                svc: {"containers": dict(containers)}
+                for svc, containers in self.services.items()
+            }
+        if self.storage_class:
+            d["storageclass"] = self.storage_class
+        if self.global_variables:
+            d["globalvariables"] = dict(self.global_variables)
+        return d

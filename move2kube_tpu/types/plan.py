@@ -1,0 +1,411 @@
+"""Plan schema: the serialized state between the plan and translate phases.
+
+Parity with the reference's ``types/plan/plan.go:52-233`` (Plan/PlanSpec/
+Service + enums) and ``types/plan/planutils.go:30-270`` (path
+relativization). The reference walks struct tags with reflection; we keep
+the same behavior — absolute paths in memory, root-relative paths on disk —
+with explicit conversion code per field, as SURVEY.md §7 recommends.
+
+Net-new for the TPU north star: the ``Gpu2Tpu`` translation type, the
+``JaxXla`` container build type, and per-service ``accelerator`` metadata
+(detected GPU topology that the TPU emitters size slices from).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+from move2kube_tpu import API_VERSION
+from move2kube_tpu.utils import common
+
+PLAN_KIND = "Plan"
+
+
+# --- Enums (parity: types/plan/plan.go:52-131) -----------------------------
+
+class TranslationType:
+    COMPOSE2KUBE = "Compose2Kube"
+    CFMANIFEST2KUBE = "Cfmanifest2Kube"
+    ANY2KUBE = "Any2Kube"
+    KUBE2KUBE = "Kube2Kube"
+    KNATIVE2KUBE = "Knative2Kube"
+    DOCKERFILE2KUBE = "Dockerfile2Kube"
+    GPU2TPU = "Gpu2Tpu"  # net-new: GPU training workload -> TPU deployment
+
+
+class SourceType:
+    DIRECTORY = "Directory"
+    COMPOSE = "DockerCompose"
+    CFMANIFEST = "CfManifest"
+    K8S = "Kubernetes"
+    KNATIVE = "Knative"
+    DOCKERFILE = "Dockerfile"
+    GPU_TRAINING = "GpuTraining"  # net-new: CUDA/NCCL/DeepSpeed source tree
+
+
+class ContainerBuildType:
+    NEW_DOCKERFILE = "NewDockerfile"
+    REUSE_DOCKERFILE = "ReuseDockerfile"
+    REUSE = "Reuse"
+    CNB = "CNB"
+    S2I = "S2I"
+    MANUAL = "Manual"
+    JAX_XLA = "JaxXla"  # net-new: rewrite GPU training code into a JAX TPU image
+
+
+class TargetArtifactType:
+    YAMLS = "Yamls"
+    HELM = "Helm"
+    KNATIVE = "Knative"
+
+
+class TargetClusterType:  # how plan.targetCluster is specified
+    BY_TYPE = "type"  # built-in profile name
+    BY_PATH = "path"  # collected ClusterMetadata yaml
+
+
+# --- Accelerator metadata (net-new) ----------------------------------------
+
+@dataclass
+class AcceleratorInfo:
+    """Detected GPU requirements of a service, and the TPU mapping for them.
+
+    Filled by the GPU detector (source/gputranslator.py); consumed by the
+    jax-xla containerizer and the TPU apiresources to size pod slices.
+    """
+
+    gpu_count: int = 0
+    gpu_vendor: str = ""  # e.g. "nvidia.com/gpu"
+    frameworks: list[str] = field(default_factory=list)  # torch, tf, deepspeed...
+    distributed_backend: str = ""  # nccl | gloo | mpi | ""
+    parallelism: dict[str, int] = field(default_factory=dict)  # dp/tp/pp/sp/zero_stage
+    model_family: str = ""  # resnet | bert | llama | generic
+    entrypoint: str = ""  # detected training script, abs path in memory
+    tpu_accelerator: str = ""  # e.g. tpu-v5-lite-podslice
+    tpu_topology: str = ""  # e.g. 2x4
+    num_hosts: int = 1
+
+    _CAMEL = {
+        "gpu_count": "gpuCount",
+        "gpu_vendor": "gpuVendor",
+        "frameworks": "frameworks",
+        "distributed_backend": "distributedBackend",
+        "parallelism": "parallelism",
+        "model_family": "modelFamily",
+        "entrypoint": "entrypoint",
+        "tpu_accelerator": "tpuAccelerator",
+        "tpu_topology": "tpuTopology",
+        "num_hosts": "numHosts",
+    }
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        for attr, key in self._CAMEL.items():
+            v = getattr(self, attr)
+            if v:
+                d[key] = v
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AcceleratorInfo":
+        obj = cls()
+        camel_to_attr = {key: attr for attr, key in cls._CAMEL.items()}
+        for k, v in d.items():
+            attr = camel_to_attr.get(k, k)
+            if hasattr(obj, attr):
+                setattr(obj, attr, v)
+        return obj
+
+
+# --- Plan service (parity: types/plan/plan.go:194-233) ---------------------
+
+@dataclass
+class PlanService:
+    service_name: str = ""
+    image: str = ""
+    translation_type: str = TranslationType.ANY2KUBE
+    container_build_type: str = ContainerBuildType.NEW_DOCKERFILE
+    source_types: list[str] = field(default_factory=list)
+    # containerization target options: per build type, e.g. the detected
+    # stack's template path (dockerfile), builder image (s2i/cnb), or the
+    # detected model family (jax-xla).
+    containerization_target_options: list[str] = field(default_factory=list)
+    # source artifacts: artifact-type -> list of paths (abs in memory)
+    source_artifacts: dict[str, list[str]] = field(default_factory=dict)
+    build_artifacts: dict[str, list[str]] = field(default_factory=dict)
+    update_container_build_pipeline: bool = True
+    update_deploy_pipeline: bool = True
+    service_rel_path: str = ""
+    accelerator: AcceleratorInfo | None = None
+
+    # Artifact type keys used inside source_artifacts/build_artifacts
+    SOURCE_DIR_ARTIFACT = "SourceDirectories"
+    DOCKERFILE_ARTIFACT = "Dockerfile"
+    COMPOSE_ARTIFACT = "DockerCompose"
+    CFMANIFEST_ARTIFACT = "CfManifest"
+    CFRUNNING_ARTIFACT = "CfRunningManifest"
+    K8S_ARTIFACT = "Kubernetes"
+    KNATIVE_ARTIFACT = "Knative"
+    IMAGEINFO_ARTIFACT = "ImageInfo"
+    GPU_ENTRYPOINT_ARTIFACT = "GpuTrainingEntrypoint"  # net-new
+
+    def add_source_artifact(self, artifact_type: str, path: str) -> None:
+        self.source_artifacts.setdefault(artifact_type, []).append(path)
+
+    def add_build_artifact(self, artifact_type: str, path: str) -> None:
+        self.build_artifacts.setdefault(artifact_type, []).append(path)
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {
+            "serviceName": self.service_name,
+            "translationType": self.translation_type,
+            "containerBuildType": self.container_build_type,
+        }
+        if self.image:
+            d["image"] = self.image
+        if self.source_types:
+            d["sourceTypes"] = list(self.source_types)
+        if self.containerization_target_options:
+            d["containerizationTargetOptions"] = list(self.containerization_target_options)
+        if self.source_artifacts:
+            d["sourceArtifacts"] = {k: list(v) for k, v in self.source_artifacts.items()}
+        if self.build_artifacts:
+            d["buildArtifacts"] = {k: list(v) for k, v in self.build_artifacts.items()}
+        d["updateContainerBuildPipeline"] = self.update_container_build_pipeline
+        d["updateDeployPipeline"] = self.update_deploy_pipeline
+        if self.service_rel_path:
+            d["serviceRelPath"] = self.service_rel_path
+        if self.accelerator is not None:
+            d["accelerator"] = self.accelerator.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PlanService":
+        svc = cls(
+            service_name=d.get("serviceName", ""),
+            image=d.get("image", ""),
+            translation_type=d.get("translationType", TranslationType.ANY2KUBE),
+            container_build_type=d.get("containerBuildType", ContainerBuildType.NEW_DOCKERFILE),
+            source_types=list(d.get("sourceTypes", [])),
+            containerization_target_options=list(d.get("containerizationTargetOptions", [])),
+            source_artifacts={k: list(v) for k, v in d.get("sourceArtifacts", {}).items()},
+            build_artifacts={k: list(v) for k, v in d.get("buildArtifacts", {}).items()},
+            update_container_build_pipeline=d.get("updateContainerBuildPipeline", True),
+            update_deploy_pipeline=d.get("updateDeployPipeline", True),
+            service_rel_path=d.get("serviceRelPath", ""),
+        )
+        if "accelerator" in d and d["accelerator"]:
+            svc.accelerator = AcceleratorInfo.from_dict(d["accelerator"])
+        return svc
+
+
+# --- Target cluster --------------------------------------------------------
+
+@dataclass
+class TargetCluster:
+    type: str = ""  # built-in profile name (e.g. "Kubernetes", "GCP-GKE-TPU")
+    path: str = ""  # or path to a collected ClusterMetadata yaml (abs in memory)
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.type:
+            d["type"] = self.type
+        if self.path:
+            d["path"] = self.path
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TargetCluster":
+        return cls(type=d.get("type", ""), path=d.get("path", ""))
+
+
+# --- Kubernetes output spec (parity: plan.go:134-192) ----------------------
+
+@dataclass
+class KubernetesOutput:
+    registry_url: str = ""
+    registry_namespace: str = ""
+    # "" means unset (parity with Go's zero-struct guard, plan.go:169);
+    # consumers resolve via effective_artifact_type().
+    artifact_type: str = ""
+    target_cluster: TargetCluster = field(default_factory=TargetCluster)
+    ignore_unsupported_kinds: bool = False
+
+    def effective_artifact_type(self) -> str:
+        return self.artifact_type or TargetArtifactType.YAMLS
+
+    def merge(self, other: "KubernetesOutput") -> None:
+        import copy
+
+        if other.registry_url:
+            self.registry_url = other.registry_url
+        if other.registry_namespace:
+            self.registry_namespace = other.registry_namespace
+        if other.artifact_type:
+            self.artifact_type = other.artifact_type
+        if other.target_cluster.type or other.target_cluster.path:
+            self.target_cluster = copy.deepcopy(other.target_cluster)
+        self.ignore_unsupported_kinds = (
+            self.ignore_unsupported_kinds or other.ignore_unsupported_kinds
+        )
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.registry_url:
+            d["registryURL"] = self.registry_url
+        if self.registry_namespace:
+            d["registryNamespace"] = self.registry_namespace
+        if self.artifact_type:
+            d["artifactType"] = self.artifact_type
+        tc = self.target_cluster.to_dict()
+        if tc:
+            d["targetCluster"] = tc
+        if self.ignore_unsupported_kinds:
+            d["ignoreUnsupportedKinds"] = True
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KubernetesOutput":
+        return cls(
+            registry_url=d.get("registryURL", ""),
+            registry_namespace=d.get("registryNamespace", ""),
+            artifact_type=d.get("artifactType", ""),
+            target_cluster=TargetCluster.from_dict(d.get("targetCluster", {})),
+            ignore_unsupported_kinds=d.get("ignoreUnsupportedKinds", False),
+        )
+
+
+# --- Plan ------------------------------------------------------------------
+
+@dataclass
+class Plan:
+    name: str = common.DEFAULT_PROJECT_NAME
+    root_dir: str = ""
+    services: dict[str, list[PlanService]] = field(default_factory=dict)
+    k8s_files: list[str] = field(default_factory=list)
+    qa_caches: list[str] = field(default_factory=list)
+    target_info_artifacts: dict[str, list[str]] = field(default_factory=dict)
+    kubernetes: KubernetesOutput = field(default_factory=KubernetesOutput)
+
+    TARGET_CLUSTERS_ARTIFACT = "KubernetesCluster"
+
+    def add_service(self, svc: PlanService) -> None:
+        self.services.setdefault(svc.service_name, []).append(svc)
+
+    # -- path relativization (parity: planutils.go:30-270) ------------------
+
+    def _service_path_fields(self, svc: PlanService):
+        """Yield (container, key) pairs whose values are path lists."""
+        for artifacts in (svc.source_artifacts, svc.build_artifacts):
+            for k in artifacts:
+                yield artifacts, k
+
+    def _convert_paths(self, conv) -> None:
+        self.k8s_files = [conv(p) for p in self.k8s_files]
+        self.qa_caches = [conv(p) for p in self.qa_caches]
+        for k in self.target_info_artifacts:
+            self.target_info_artifacts[k] = [conv(p) for p in self.target_info_artifacts[k]]
+        if self.kubernetes.target_cluster.path:
+            self.kubernetes.target_cluster.path = conv(self.kubernetes.target_cluster.path)
+        for svcs in self.services.values():
+            for svc in svcs:
+                for artifacts, k in self._service_path_fields(svc):
+                    artifacts[k] = [conv(p) for p in artifacts[k]]
+                if svc.accelerator and svc.accelerator.entrypoint:
+                    svc.accelerator.entrypoint = conv(svc.accelerator.entrypoint)
+
+    def _to_relative(self) -> None:
+        root = self.root_dir
+
+        def conv(p: str) -> str:
+            rel = common.relpath_under(p, root)
+            return rel if rel is not None else p
+
+        self._convert_paths(conv)
+
+    def _to_absolute(self) -> None:
+        root = self.root_dir
+
+        def conv(p: str) -> str:
+            return p if os.path.isabs(p) else os.path.normpath(os.path.join(root, p))
+
+        self._convert_paths(conv)
+
+    def set_root_dir(self, new_root: str) -> None:
+        """Re-root all paths (parity: Plan.SetRootDir planutils.go:214)."""
+        self._to_relative()
+        self.root_dir = os.path.abspath(new_root)
+        self._to_absolute()
+
+    # -- serialization ------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        self._to_relative()
+        try:
+            d = {
+                "apiVersion": API_VERSION,
+                "kind": PLAN_KIND,
+                "metadata": {"name": self.name},
+                "spec": {
+                    "inputs": {
+                        "rootDir": self.root_dir,
+                        "services": {
+                            name: [s.to_dict() for s in svcs]
+                            for name, svcs in sorted(self.services.items())
+                        },
+                    },
+                    "outputs": {"kubernetes": self.kubernetes.to_dict()},
+                },
+            }
+            inputs = d["spec"]["inputs"]
+            if self.k8s_files:
+                inputs["k8sFiles"] = list(self.k8s_files)
+            if self.qa_caches:
+                inputs["qaCaches"] = list(self.qa_caches)
+            if self.target_info_artifacts:
+                inputs["targetInfoArtifacts"] = {
+                    k: list(v) for k, v in self.target_info_artifacts.items()
+                }
+            return d
+        finally:
+            self._to_absolute()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Plan":
+        spec = d.get("spec", {})
+        inputs = spec.get("inputs", {})
+        outputs = spec.get("outputs", {})
+        plan = cls(
+            name=d.get("metadata", {}).get("name", common.DEFAULT_PROJECT_NAME),
+            root_dir=inputs.get("rootDir", ""),
+            k8s_files=list(inputs.get("k8sFiles", [])),
+            qa_caches=list(inputs.get("qaCaches", [])),
+            target_info_artifacts={
+                k: list(v) for k, v in inputs.get("targetInfoArtifacts", {}).items()
+            },
+            kubernetes=KubernetesOutput.from_dict(outputs.get("kubernetes", {})),
+        )
+        for name, svcs in inputs.get("services", {}).items():
+            plan.services[name] = [PlanService.from_dict(s) for s in svcs]
+        plan._to_absolute()
+        return plan
+
+
+def new_plan(name: str = common.DEFAULT_PROJECT_NAME) -> Plan:
+    plan = Plan(name=common.make_dns_label(name))
+    plan.kubernetes.registry_url = common.DEFAULT_REGISTRY_URL
+    plan.kubernetes.registry_namespace = plan.name
+    return plan
+
+
+def read_plan(path: str) -> Plan:
+    """Read and path-absolutize a plan file (parity: ReadPlan planutils.go:165)."""
+    doc = common.read_m2kt_yaml(path, PLAN_KIND)
+    return Plan.from_dict(doc)
+
+
+def write_plan(path: str, plan: Plan) -> None:
+    """Path-relativize and write (parity: WritePlan planutils.go:191)."""
+    common.write_yaml(path, plan.to_dict())

@@ -1,0 +1,2 @@
+from move2kube_tpu.utils import common  # noqa: F401
+from move2kube_tpu.utils.log import get_logger  # noqa: F401

@@ -1,0 +1,271 @@
+"""Shared helpers for the translate engine.
+
+TPU-native rebuild of the reference's ``internal/common/utils.go`` +
+``internal/common/constants.go`` surface (file finders, YAML/JSON IO with
+kind checking, template rendering, fuzzy matching, DNS-1123 sanitizers,
+common-directory math). Behavior parity, idiomatic Python.
+"""
+
+from __future__ import annotations
+
+import difflib
+import hashlib
+import json
+import os
+import re
+from typing import Any, Iterable
+
+import yaml
+
+from move2kube_tpu import API_VERSION, GROUP_NAME
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("common")
+
+# ---------------------------------------------------------------------------
+# Constants (parity: internal/common/constants.go:27-110)
+# ---------------------------------------------------------------------------
+
+DEFAULT_PLAN_FILE = "m2kt.plan"
+DEFAULT_PROJECT_NAME = "myproject"
+QA_CACHE_FILE = "m2ktqacache.yaml"
+IGNORE_FILENAME = ".m2ktignore"
+# Also honored for drop-in compatibility with reference source trees.
+LEGACY_IGNORE_FILENAMES = (".m2kignore",)
+EXPOSE_SERVICE_ANNOTATION = GROUP_NAME + "/service.expose"
+DEFAULT_SERVICE_PORT = 8080
+DEFAULT_PVC_SIZE = "100Mi"
+DEFAULT_REGISTRY_URL = "quay.io"
+DEFAULT_STORAGE_CLASS = "default"
+CONTAINERS_DIR = "containers"
+CICD_DIR = "cicd"
+COLLECT_OUTPUT_DIR = "m2kt_collect"
+
+# Global toggle (parity: common.IgnoreEnvironment): when True, nothing is
+# derived from the local environment (env vars, docker daemon, kubeconfig).
+IGNORE_ENVIRONMENT = False
+
+# ---------------------------------------------------------------------------
+# File finders (parity: GetFilesByExt utils.go:47, GetFilesByName utils.go:85)
+# ---------------------------------------------------------------------------
+
+
+def get_files_by_ext(root: str, exts: Iterable[str]) -> list[str]:
+    """Recursively find files under root with one of the given extensions."""
+    exts = tuple(e if e.startswith(".") else "." + e for e in exts)
+    out: list[str] = []
+    root = os.path.abspath(root)
+    for dirpath, dirnames, filenames in os.walk(root, followlinks=False):
+        dirnames[:] = [d for d in dirnames if d not in (".git",)]
+        for f in filenames:
+            if f.endswith(exts):
+                out.append(os.path.join(dirpath, f))
+    out.sort()
+    return out
+
+
+def get_files_by_name(root: str, names: Iterable[str]) -> list[str]:
+    """Recursively find files under root whose basename is in names."""
+    nameset = set(names)
+    out: list[str] = []
+    root = os.path.abspath(root)
+    for dirpath, dirnames, filenames in os.walk(root, followlinks=False):
+        dirnames[:] = [d for d in dirnames if d not in (".git",)]
+        for f in filenames:
+            if f in nameset:
+                out.append(os.path.join(dirpath, f))
+    out.sort()
+    return out
+
+
+def find_common_directory(paths: Iterable[str]) -> str:
+    """Longest common ancestor directory of paths (utils.go:527)."""
+    paths = [os.path.abspath(p) for p in paths]
+    if not paths:
+        return ""
+    return os.path.commonpath(paths)
+
+
+# ---------------------------------------------------------------------------
+# YAML / JSON IO (parity: ReadMove2KubeYaml utils.go:210, WriteYaml)
+# ---------------------------------------------------------------------------
+
+
+class _M2KTDumper(yaml.SafeDumper):
+    """Block-style dumper that never emits aliases (k8s YAML convention)."""
+
+    def ignore_aliases(self, data: Any) -> bool:  # noqa: ARG002
+        return True
+
+
+def _str_presenter(dumper: yaml.Dumper, data: str) -> yaml.Node:
+    if "\n" in data:
+        return dumper.represent_scalar("tag:yaml.org,2002:str", data, style="|")
+    return dumper.represent_scalar("tag:yaml.org,2002:str", data)
+
+
+_M2KTDumper.add_representer(str, _str_presenter)
+
+
+def to_yaml(obj: Any) -> str:
+    return yaml.dump(obj, Dumper=_M2KTDumper, default_flow_style=False, sort_keys=False)
+
+
+def read_yaml(path: str) -> Any:
+    with open(path, "r", encoding="utf-8") as f:
+        return yaml.safe_load(f)
+
+
+def write_yaml(path: str, obj: Any) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(to_yaml(obj))
+
+
+def read_m2kt_yaml(path: str, expected_kind: str) -> dict:
+    """Read a YAML doc and verify it is ours and of the expected kind.
+
+    Parity: common.ReadMove2KubeYaml (utils.go:210) — rejects docs whose
+    apiVersion group is not ours or whose kind mismatches.
+    """
+    doc = read_yaml(path)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a YAML mapping")
+    api_version = str(doc.get("apiVersion", ""))
+    if "/" in api_version:
+        group = api_version.rsplit("/", 1)[0]
+    else:
+        group = api_version
+    if group != GROUP_NAME:
+        raise ValueError(
+            f"{path}: apiVersion group {group!r} is not {GROUP_NAME!r}"
+        )
+    kind = str(doc.get("kind", ""))
+    if kind != expected_kind:
+        raise ValueError(f"{path}: kind {kind!r} != expected {expected_kind!r}")
+    return doc
+
+
+def new_m2kt_doc(kind: str, name: str = "") -> dict:
+    doc: dict[str, Any] = {"apiVersion": API_VERSION, "kind": kind}
+    if name:
+        doc["metadata"] = {"name": name}
+    return doc
+
+
+def read_json(path: str) -> Any:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Templates (parity: GetStringFromTemplate utils.go:348, WriteTemplateToFile)
+# ---------------------------------------------------------------------------
+
+
+def render_template(template_str: str, params: dict) -> str:
+    """Render a Jinja2 template string with strict undefined handling."""
+    import jinja2
+
+    env = jinja2.Environment(undefined=jinja2.StrictUndefined, keep_trailing_newline=True)
+    return env.from_string(template_str).render(**params)
+
+
+def write_template_to_file(template_str: str, params: dict, path: str, mode: int = 0o644) -> None:
+    out = render_template(template_str, params)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(out)
+    os.chmod(path, mode)
+
+
+# ---------------------------------------------------------------------------
+# Fuzzy matching (parity: GetClosestMatchingString utils.go:377)
+# ---------------------------------------------------------------------------
+
+
+def closest_matching_string(target: str, options: list[str]) -> str:
+    """Return the option closest to target (case/space-insensitive)."""
+    if not options:
+        return ""
+    norm = lambda s: re.sub(r"\s+", "", s.lower())  # noqa: E731
+    t = norm(target)
+    best, best_score = options[0], -1.0
+    for opt in options:
+        score = difflib.SequenceMatcher(None, t, norm(opt)).ratio()
+        if score > best_score:
+            best, best_score = opt, score
+    return best
+
+
+# ---------------------------------------------------------------------------
+# DNS-1123 sanitizers (parity: MakeStringDNSNameCompliant utils.go:445 et seq.)
+# ---------------------------------------------------------------------------
+
+_DNS_NAME_MAX = 253
+_DNS_LABEL_MAX = 63
+
+
+def _dns_sanitize(s: str, maxlen: int) -> str:
+    s = s.lower()
+    s = re.sub(r"[^a-z0-9\-.]", "-", s)
+    s = re.sub(r"\.+", ".", s)
+    s = s.strip("-.")
+    if len(s) > maxlen:
+        digest = hashlib.sha256(s.encode()).hexdigest()[:8]
+        s = s[: maxlen - 9].rstrip("-.") + "-" + digest
+    return s or "app"
+
+
+def make_dns_name(s: str) -> str:
+    """Sanitize to a DNS-1123 subdomain (lowercase alnum, '-', '.')."""
+    return _dns_sanitize(s, _DNS_NAME_MAX)
+
+
+def make_dns_label(s: str) -> str:
+    """Sanitize to a DNS-1123 label (lowercase alnum and '-', <=63 chars)."""
+    return _dns_sanitize(make_dns_name(s).replace(".", "-"), _DNS_LABEL_MAX)
+
+
+def make_env_name(s: str) -> str:
+    """Sanitize to a C_IDENTIFIER env-var name."""
+    s = re.sub(r"[^A-Za-z0-9_]", "_", s)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s.upper() or "_"
+
+
+def unique_name(base: str, taken: Iterable[str]) -> str:
+    taken = set(taken)
+    if base not in taken:
+        return base
+    i = 2
+    while f"{base}-{i}" in taken:
+        i += 1
+    return f"{base}-{i}"
+
+
+# ---------------------------------------------------------------------------
+# Path helpers
+# ---------------------------------------------------------------------------
+
+
+def is_parent(path: str, parent: str) -> bool:
+    """True if parent is an ancestor of (or equal to) path."""
+    path = os.path.abspath(path)
+    parent = os.path.abspath(parent)
+    return path == parent or path.startswith(parent.rstrip(os.sep) + os.sep)
+
+
+def relpath_under(path: str, root: str) -> str | None:
+    """Root-relative form of path if under root, else None."""
+    if not is_parent(path, root):
+        return None
+    return os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+
+
+def write_file(path: str, contents: str, mode: int = 0o644) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(contents)
+    os.chmod(path, mode)

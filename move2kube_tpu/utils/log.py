@@ -1,0 +1,60 @@
+"""Leveled logging for the translate engine.
+
+Mirrors the reference's logrus usage (a ``--verbose`` debug flag and
+warn-and-continue plugin loops; cmd/move2kube/move2kube.go:41-46) on top of
+stdlib logging.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+class _ColorFormatter(logging.Formatter):
+    COLORS = {
+        logging.DEBUG: "\x1b[36m",  # cyan
+        logging.INFO: "\x1b[32m",  # green
+        logging.WARNING: "\x1b[33m",  # yellow
+        logging.ERROR: "\x1b[31m",  # red
+        logging.CRITICAL: "\x1b[41m",  # red bg
+    }
+    RESET = "\x1b[0m"
+
+    def __init__(self, use_color: bool) -> None:
+        super().__init__("%(levelname)s[%(asctime)s] %(message)s", "%H:%M:%S")
+        self.use_color = use_color
+
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if self.use_color:
+            color = self.COLORS.get(record.levelno, "")
+            return f"{color}{msg}{self.RESET}"
+        return msg
+
+
+def configure(verbose: bool = False) -> None:
+    """Configure the root m2kt logger. Idempotent; later calls adjust level."""
+    global _CONFIGURED
+    logger = logging.getLogger("m2kt")
+    logger.setLevel(logging.DEBUG if verbose else logging.INFO)
+    if not _CONFIGURED:
+        handler = logging.StreamHandler(sys.stderr)
+        use_color = sys.stderr.isatty() and os.environ.get("NO_COLOR") is None
+        handler.setFormatter(_ColorFormatter(use_color))
+        logger.addHandler(handler)
+        logger.propagate = False
+        _CONFIGURED = True
+
+
+def get_logger(name: str | None = None) -> logging.Logger:
+    configure_if_needed()
+    return logging.getLogger("m2kt" if not name else f"m2kt.{name}")
+
+
+def configure_if_needed() -> None:
+    if not _CONFIGURED:
+        configure(verbose=os.environ.get("M2KT_VERBOSE", "") not in ("", "0", "false"))

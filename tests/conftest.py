@@ -1,0 +1,15 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
+available in CI; sharding semantics are identical under
+``xla_force_host_platform_device_count``).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

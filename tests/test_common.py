@@ -1,0 +1,70 @@
+import os
+
+import pytest
+
+from move2kube_tpu.utils import common
+
+
+def test_get_files_by_ext(tmp_path):
+    (tmp_path / "a.yaml").write_text("x: 1")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "b.yml").write_text("y: 2")
+    (tmp_path / "sub" / "c.txt").write_text("no")
+    found = common.get_files_by_ext(str(tmp_path), [".yaml", ".yml"])
+    assert [os.path.basename(f) for f in found] == ["a.yaml", "b.yml"]
+
+
+def test_get_files_by_name(tmp_path):
+    (tmp_path / "Dockerfile").write_text("FROM x")
+    (tmp_path / "d").mkdir()
+    (tmp_path / "d" / "Dockerfile").write_text("FROM y")
+    found = common.get_files_by_name(str(tmp_path), ["Dockerfile"])
+    assert len(found) == 2
+
+
+def test_dns_label():
+    assert common.make_dns_label("My_Service Name!") == "my-service-name"
+    assert common.make_dns_label("") == "app"
+    long = "a" * 100
+    out = common.make_dns_label(long)
+    assert len(out) <= 63
+
+
+def test_env_name():
+    assert common.make_env_name("my-var.1") == "MY_VAR_1"
+    assert common.make_env_name("1abc") == "_1ABC"
+
+
+def test_unique_name():
+    assert common.unique_name("svc", ["svc", "svc-2"]) == "svc-3"
+    assert common.unique_name("svc", []) == "svc"
+
+
+def test_closest_matching_string():
+    opts = ["Helm", "Yamls", "Knative"]
+    assert common.closest_matching_string("helm", opts) == "Helm"
+    assert common.closest_matching_string("YAML", opts) == "Yamls"
+
+
+def test_read_m2kt_yaml_kind_check(tmp_path):
+    p = tmp_path / "doc.yaml"
+    p.write_text("apiVersion: move2kube-tpu.io/v1alpha1\nkind: Plan\n")
+    doc = common.read_m2kt_yaml(str(p), "Plan")
+    assert doc["kind"] == "Plan"
+    with pytest.raises(ValueError):
+        common.read_m2kt_yaml(str(p), "ClusterMetadata")
+    p2 = tmp_path / "alien.yaml"
+    p2.write_text("apiVersion: apps/v1\nkind: Deployment\n")
+    with pytest.raises(ValueError):
+        common.read_m2kt_yaml(str(p2), "Deployment")
+
+
+def test_render_template():
+    out = common.render_template("FROM {{ base }}\nEXPOSE {{ port }}\n", {"base": "python:3", "port": 8080})
+    assert out == "FROM python:3\nEXPOSE 8080\n"
+
+
+def test_is_parent():
+    assert common.is_parent("/a/b/c", "/a/b")
+    assert common.is_parent("/a/b", "/a/b")
+    assert not common.is_parent("/a/bc", "/a/b")

@@ -1,0 +1,79 @@
+from move2kube_tpu.types import ir as irtypes
+from move2kube_tpu.types.plan import ContainerBuildType
+
+
+def test_container_merge_dedup():
+    a = irtypes.Container(image_names=["app:latest"], exposed_ports=[8080])
+    b = irtypes.Container(image_names=["app:latest", "app:v1"], exposed_ports=[8080, 9090])
+    assert a.merge(b)
+    assert a.image_names == ["app:latest", "app:v1"]
+    assert a.exposed_ports == [8080, 9090]
+    c = irtypes.Container(image_names=["other:latest"])
+    assert not a.merge(c)
+
+
+def test_ir_add_container_dedup():
+    ir = irtypes.IR()
+    ir.add_container(irtypes.Container(image_names=["app:latest"]))
+    ir.add_container(irtypes.Container(image_names=["app:latest"], exposed_ports=[80]))
+    assert len(ir.containers) == 1
+    assert ir.containers[0].exposed_ports == [80]
+
+
+def test_service_merge():
+    a = irtypes.Service(name="web")
+    a.containers.append({"name": "web", "image": "app:latest"})
+    a.add_port_forwarding(80, 8080)
+    b = irtypes.Service(name="web")
+    b.add_port_forwarding(80, 9090)  # same service port -> ignored
+    b.add_port_forwarding(443, 8443)
+    b.replicas = 3
+    a.merge(b)
+    assert len(a.port_forwardings) == 2
+    assert a.port_forwardings[0].container_port == 8080
+    assert a.replicas == 3
+
+
+def test_ir_merge():
+    a = irtypes.IR()
+    a.add_service(irtypes.Service(name="web"))
+    a.add_container(irtypes.Container(image_names=["web:latest"]))
+    b = irtypes.IR()
+    b.add_service(irtypes.Service(name="api"))
+    b.add_service(irtypes.Service(name="web", replicas=2))
+    b.add_container(irtypes.Container(image_names=["api:latest"]))
+    b.add_storage(irtypes.Storage(name="cfg", kind=irtypes.StorageKind.CONFIGMAP))
+    a.merge(b)
+    assert set(a.services) == {"web", "api"}
+    assert a.services["web"].replicas == 2
+    assert len(a.containers) == 2
+    assert len(a.storages) == 1
+
+
+def test_storage_merge():
+    ir = irtypes.IR()
+    ir.add_storage(
+        irtypes.Storage(name="cfg", kind=irtypes.StorageKind.CONFIGMAP, content={"a": b"1"})
+    )
+    ir.add_storage(
+        irtypes.Storage(name="cfg", kind=irtypes.StorageKind.CONFIGMAP, content={"b": b"2"})
+    )
+    assert len(ir.storages) == 1
+    assert ir.storages[0].content == {"a": b"1", "b": b"2"}
+
+
+def test_pod_spec_assembly():
+    svc = irtypes.Service(name="web", restart_policy="Always")
+    svc.containers.append({"name": "web", "image": "app:latest"})
+    svc.image_pull_secrets.append("regcred")
+    spec = svc.pod_spec()
+    assert spec["containers"][0]["image"] == "app:latest"
+    assert spec["imagePullSecrets"] == [{"name": "regcred"}]
+    assert spec["restartPolicy"] == "Always"
+
+
+def test_container_build_types():
+    c = irtypes.Container(build_type=ContainerBuildType.JAX_XLA)
+    c.add_file("Dockerfile", "FROM python:3.11\n")
+    c.add_file("train_tpu.py", "import jax\n")
+    assert set(c.new_files) == {"Dockerfile", "train_tpu.py"}

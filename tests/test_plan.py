@@ -1,0 +1,89 @@
+import os
+
+from move2kube_tpu.types import plan as plantypes
+
+
+def make_plan(root: str) -> plantypes.Plan:
+    p = plantypes.new_plan("testapp")
+    p.root_dir = root
+    svc = plantypes.PlanService(
+        service_name="web",
+        translation_type=plantypes.TranslationType.ANY2KUBE,
+        container_build_type=plantypes.ContainerBuildType.NEW_DOCKERFILE,
+        source_types=[plantypes.SourceType.DIRECTORY],
+    )
+    svc.add_source_artifact(
+        plantypes.PlanService.SOURCE_DIR_ARTIFACT, os.path.join(root, "web")
+    )
+    p.add_service(svc)
+    return p
+
+
+def test_plan_roundtrip(tmp_path):
+    root = str(tmp_path / "src")
+    os.makedirs(os.path.join(root, "web"))
+    p = make_plan(root)
+    plan_file = str(tmp_path / "m2kt.plan")
+    plantypes.write_plan(plan_file, p)
+
+    # On disk: paths under rootDir are relative
+    import yaml
+
+    raw = yaml.safe_load(open(plan_file))
+    svc_raw = raw["spec"]["inputs"]["services"]["web"][0]
+    assert svc_raw["sourceArtifacts"]["SourceDirectories"] == ["web"]
+
+    # In memory after read: absolute again
+    p2 = plantypes.read_plan(plan_file)
+    assert p2.name == "testapp"
+    svc2 = p2.services["web"][0]
+    assert svc2.source_artifacts["SourceDirectories"] == [os.path.join(root, "web")]
+    # memory copy unchanged by the write (to_dict restores abs paths)
+    assert p.services["web"][0].source_artifacts["SourceDirectories"] == [
+        os.path.join(root, "web")
+    ]
+
+
+def test_set_root_dir(tmp_path):
+    root = str(tmp_path / "src")
+    os.makedirs(os.path.join(root, "web"))
+    p = make_plan(root)
+    new_root = str(tmp_path / "elsewhere")
+    p.set_root_dir(new_root)
+    assert p.root_dir == new_root
+    assert p.services["web"][0].source_artifacts["SourceDirectories"] == [
+        os.path.join(new_root, "web")
+    ]
+
+
+def test_accelerator_roundtrip(tmp_path):
+    root = str(tmp_path / "src")
+    os.makedirs(root)
+    p = make_plan(root)
+    acc = plantypes.AcceleratorInfo(
+        gpu_count=8,
+        gpu_vendor="nvidia.com/gpu",
+        frameworks=["torch"],
+        distributed_backend="nccl",
+        model_family="bert",
+        tpu_accelerator="tpu-v5-lite-podslice",
+        tpu_topology="2x4",
+    )
+    p.services["web"][0].accelerator = acc
+    plan_file = str(tmp_path / "m2kt.plan")
+    plantypes.write_plan(plan_file, p)
+    p2 = plantypes.read_plan(plan_file)
+    acc2 = p2.services["web"][0].accelerator
+    assert acc2 is not None
+    assert acc2.gpu_count == 8
+    assert acc2.distributed_backend == "nccl"
+    assert acc2.tpu_topology == "2x4"
+
+
+def test_kubernetes_output_merge():
+    a = plantypes.KubernetesOutput(registry_url="quay.io", artifact_type="Yamls")
+    b = plantypes.KubernetesOutput(registry_url="gcr.io", registry_namespace="ns")
+    a.merge(b)
+    assert a.registry_url == "gcr.io"
+    assert a.registry_namespace == "ns"
+    assert a.artifact_type == "Yamls"
