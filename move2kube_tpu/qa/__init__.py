@@ -1,0 +1,12 @@
+from move2kube_tpu.qa.problem import Problem, SolutionForm  # noqa: F401
+from move2kube_tpu.qa.engine import (  # noqa: F401
+    fetch_answer,
+    fetch_bool,
+    fetch_input,
+    fetch_multi_select,
+    fetch_select,
+    start_engine,
+    reset_engines,
+    add_cache_engine,
+    set_write_cache,
+)

@@ -1,0 +1,59 @@
+"""QA answer cache: YAML persistence of solved problems.
+
+Parity: ``types/qaengine/cache.go:32-135`` — every answered problem is
+appended; ``get_solution`` fuzzy-matches new problems against stored ones
+so a previous run's answers replay headlessly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+from move2kube_tpu.utils import common
+from move2kube_tpu.qa.problem import Problem
+
+QA_CACHE_KIND = "QACache"
+
+
+@dataclass
+class Cache:
+    path: str = ""
+    problems: list[Problem] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def load(self) -> None:
+        if not self.path or not os.path.exists(self.path):
+            return
+        doc = common.read_m2kt_yaml(self.path, QA_CACHE_KIND)
+        self.problems = [
+            Problem.from_dict(p) for p in doc.get("spec", {}).get("solutions", [])
+        ]
+
+    def write(self) -> None:
+        if not self.path:
+            return
+        doc = common.new_m2kt_doc(QA_CACHE_KIND)
+        doc["spec"] = {"solutions": [p.to_dict() for p in self.problems]}
+        common.write_yaml(self.path, doc)
+
+    def add_solution(self, problem: Problem) -> None:
+        """Persist a solved problem (cache.go:84)."""
+        if not problem.resolved:
+            return
+        with self._lock:
+            self.problems.append(problem)
+            self.write()
+
+    def get_solution(self, problem: Problem) -> Problem | None:
+        """Answer a new problem from the cache if a stored one matches
+        (cache.go:114)."""
+        for cached in self.problems:
+            if cached.resolved and cached.matches(problem):
+                try:
+                    problem.set_answer(cached.answer)
+                except ValueError:
+                    continue
+                return problem
+        return None

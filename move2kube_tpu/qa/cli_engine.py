@@ -1,0 +1,79 @@
+"""Interactive terminal QA engine.
+
+Parity: ``internal/qaengine/cliengine.go:44-175`` (survey prompts per
+solution form) using stdlib input()/getpass.
+"""
+
+from __future__ import annotations
+
+import getpass
+import sys
+
+from move2kube_tpu.qa.engine import Engine
+from move2kube_tpu.qa.problem import Problem, SolutionForm
+
+
+class CliEngine(Engine):
+    def is_interactive(self) -> bool:
+        return True
+
+    def fetch_answer(self, problem: Problem) -> Problem:
+        print("", file=sys.stderr)
+        for line in problem.context:
+            print(f"  [{line}]", file=sys.stderr)
+        if problem.form == SolutionForm.SELECT:
+            self._ask_select(problem)
+        elif problem.form == SolutionForm.MULTI_SELECT:
+            self._ask_multi_select(problem)
+        elif problem.form == SolutionForm.CONFIRM:
+            default = "Y/n" if problem.default else "y/N"
+            raw = input(f"{problem.desc} [{default}] : ").strip()
+            problem.set_answer(raw if raw else bool(problem.default))
+        elif problem.form == SolutionForm.PASSWORD:
+            problem.set_answer(getpass.getpass(f"{problem.desc} : "))
+        elif problem.form == SolutionForm.MULTI_LINE:
+            print(f"{problem.desc} (end with a line containing only '.'):", file=sys.stderr)
+            lines = []
+            while True:
+                line = input()
+                if line == ".":
+                    break
+                lines.append(line)
+            problem.set_answer("\n".join(lines) or (problem.default or ""))
+        else:  # INPUT
+            raw = input(f"{problem.desc} [{problem.default or ''}] : ").strip()
+            problem.set_answer(raw if raw else (problem.default or ""))
+        return problem
+
+    def _ask_select(self, problem: Problem) -> None:
+        print(problem.desc, file=sys.stderr)
+        for i, opt in enumerate(problem.options, 1):
+            marker = "*" if opt == problem.default else " "
+            print(f" {marker}{i}. {opt}", file=sys.stderr)
+        raw = input(f"choose [1-{len(problem.options)}] : ").strip()
+        if not raw:
+            problem.set_default_answer()
+            return
+        if raw.isdigit() and 1 <= int(raw) <= len(problem.options):
+            problem.set_answer(problem.options[int(raw) - 1])
+        else:
+            problem.set_answer(raw)
+
+    def _ask_multi_select(self, problem: Problem) -> None:
+        print(problem.desc, file=sys.stderr)
+        defaults = set(problem.default or [])
+        for i, opt in enumerate(problem.options, 1):
+            marker = "*" if opt in defaults else " "
+            print(f" {marker}{i}. {opt}", file=sys.stderr)
+        raw = input("choose (comma-separated numbers, empty = defaults) : ").strip()
+        if not raw:
+            problem.set_default_answer()
+            return
+        picked = []
+        for tok in raw.split(","):
+            tok = tok.strip()
+            if tok.isdigit() and 1 <= int(tok) <= len(problem.options):
+                picked.append(problem.options[int(tok) - 1])
+            elif tok:
+                picked.append(tok)
+        problem.set_answer(picked)

@@ -1,0 +1,156 @@
+"""QA engine dispatcher.
+
+Parity: ``internal/qaengine/engine.go:29-118`` — an ordered chain of
+engines (cache engines first, interactive last); ``fetch_answer`` walks the
+chain until a problem resolves, retrying the last engine, and appends every
+answer to the write cache. Convenience wrappers mirror the reference's
+typed fetch helpers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from move2kube_tpu.qa.cache import Cache
+from move2kube_tpu.qa.problem import Problem, SolutionForm
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("qa")
+
+
+class Engine:
+    """Interface: resolve a problem or leave it unresolved."""
+
+    def start(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    def fetch_answer(self, problem: Problem) -> Problem:
+        raise NotImplementedError
+
+    def is_interactive(self) -> bool:
+        return False
+
+
+class DefaultEngine(Engine):
+    """Accept defaults for everything (parity: defaultengine.go:39)."""
+
+    def fetch_answer(self, problem: Problem) -> Problem:
+        problem.set_default_answer()
+        return problem
+
+
+class CacheEngine(Engine):
+    """Replay answers from a previous run's cache (cacheengine.go:41)."""
+
+    def __init__(self, cache_path: str) -> None:
+        self.cache = Cache(path=cache_path)
+
+    def start(self) -> None:
+        self.cache.load()
+
+    def fetch_answer(self, problem: Problem) -> Problem:
+        self.cache.get_solution(problem)
+        return problem
+
+
+_engines: list[Engine] = []
+_write_cache: Cache | None = None
+
+
+def reset_engines() -> None:
+    global _engines, _write_cache
+    _engines = []
+    _write_cache = None
+
+
+def start_engine(interactive: bool = False, qa_skip: bool = False,
+                 qa_port: int = 0) -> None:
+    """Install the interactive (or default) engine (engine.go:40-66)."""
+    if qa_skip or not interactive:
+        add_engine(DefaultEngine())
+    elif qa_port:
+        from move2kube_tpu.qa.rest_engine import HTTPRESTEngine
+
+        add_engine(HTTPRESTEngine(qa_port))
+    else:
+        from move2kube_tpu.qa.cli_engine import CliEngine
+
+        add_engine(CliEngine())
+
+
+def add_engine(engine: Engine) -> None:
+    engine.start()
+    _engines.append(engine)
+
+
+def add_cache_engine(cache_path: str) -> None:
+    """Cache engines resolve before interactive ones (engine.go:69-80)."""
+    e = CacheEngine(cache_path)
+    e.start()
+    # insert before the first non-cache engine
+    idx = 0
+    for idx, existing in enumerate(_engines):  # noqa: B007
+        if not isinstance(existing, CacheEngine):
+            break
+    else:
+        idx = len(_engines)
+    _engines.insert(idx, e)
+
+
+def set_write_cache(cache_path: str) -> None:
+    global _write_cache
+    _write_cache = Cache(path=cache_path)
+    _write_cache.write()
+
+
+def fetch_answer(problem: Problem) -> Problem:
+    """Resolve a problem through the engine chain (engine.go:84-118)."""
+    if not _engines:
+        add_engine(DefaultEngine())
+    for engine in _engines:
+        try:
+            engine.fetch_answer(problem)
+        except Exception as e:  # noqa: BLE001 - plugin tolerance
+            log.debug("qa engine %s failed on %s: %s", type(engine).__name__, problem.id, e)
+        if problem.resolved:
+            break
+    retries = 0
+    while not problem.resolved and retries < 3:
+        retries += 1
+        try:
+            _engines[-1].fetch_answer(problem)
+        except Exception as e:  # noqa: BLE001
+            log.warning("failed to fetch answer for %s: %s", problem.id, e)
+    if not problem.resolved:
+        problem.set_default_answer()
+    if _write_cache is not None:
+        _write_cache.add_solution(problem)
+    return problem
+
+
+# -- typed helpers (parity: qaengine convenience fetchers) -------------------
+
+def fetch_select(id: str, desc: str, context: list[str], default: str,
+                 options: list[str]) -> str:
+    return fetch_answer(Problem.select(id, desc, context, default, options)).answer
+
+
+def fetch_multi_select(id: str, desc: str, context: list[str],
+                       default: list[str], options: list[str]) -> list[str]:
+    return fetch_answer(Problem.multi_select(id, desc, context, default, options)).answer
+
+
+def fetch_input(id: str, desc: str, context: list[str], default: str = "") -> str:
+    return fetch_answer(Problem.input(id, desc, context, default)).answer
+
+
+def fetch_bool(id: str, desc: str, context: list[str], default: bool = True) -> bool:
+    return fetch_answer(Problem.confirm(id, desc, context, default)).answer
+
+
+def fetch_password(id: str, desc: str, context: list[str]) -> str:
+    return fetch_answer(Problem.password(id, desc, context)).answer
+
+
+def fetch_multiline(id: str, desc: str, context: list[str], default: str = "") -> str:
+    return fetch_answer(Problem.multiline(id, desc, context, default)).answer

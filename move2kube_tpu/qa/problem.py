@@ -1,0 +1,169 @@
+"""QA problem schema.
+
+Parity with the reference's ``types/qaengine/problem.go:30-280``: a Problem
+has an id, description, context lines and a typed Solution in one of six
+forms (Select, MultiSelect, Input, MultiLine, Password, Confirm), with
+answer validation/coercion and fuzzy matching of cached problems against
+new ones (the cache-replay contract keys on description text;
+problem.go:151-170).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+from move2kube_tpu.utils import common
+
+
+class SolutionForm:
+    SELECT = "Select"
+    MULTI_SELECT = "MultiSelect"
+    INPUT = "Input"
+    MULTI_LINE = "MultiLine"
+    PASSWORD = "Password"
+    CONFIRM = "Confirm"
+
+
+@dataclass
+class Problem:
+    id: str
+    desc: str
+    form: str
+    context: list[str] = field(default_factory=list)
+    options: list[str] = field(default_factory=list)
+    default: Any = None
+    answer: Any = None
+    resolved: bool = False
+
+    # -- constructors (parity: NewSelectProblem etc., problem.go:190-280) ---
+
+    @classmethod
+    def select(cls, id: str, desc: str, context: list[str], default: str,
+               options: list[str]) -> "Problem":
+        if default and default not in options:
+            default = options[0] if options else ""
+        return cls(id=id, desc=desc, form=SolutionForm.SELECT, context=context,
+                   options=options, default=default)
+
+    @classmethod
+    def multi_select(cls, id: str, desc: str, context: list[str],
+                     default: list[str], options: list[str]) -> "Problem":
+        default = [d for d in default if d in options]
+        return cls(id=id, desc=desc, form=SolutionForm.MULTI_SELECT,
+                   context=context, options=options, default=default)
+
+    @classmethod
+    def input(cls, id: str, desc: str, context: list[str], default: str = "") -> "Problem":
+        return cls(id=id, desc=desc, form=SolutionForm.INPUT, context=context,
+                   default=default)
+
+    @classmethod
+    def multiline(cls, id: str, desc: str, context: list[str], default: str = "") -> "Problem":
+        return cls(id=id, desc=desc, form=SolutionForm.MULTI_LINE, context=context,
+                   default=default)
+
+    @classmethod
+    def password(cls, id: str, desc: str, context: list[str]) -> "Problem":
+        return cls(id=id, desc=desc, form=SolutionForm.PASSWORD, context=context)
+
+    @classmethod
+    def confirm(cls, id: str, desc: str, context: list[str], default: bool = True) -> "Problem":
+        return cls(id=id, desc=desc, form=SolutionForm.CONFIRM, context=context,
+                   default=default)
+
+    # -- answer handling ----------------------------------------------------
+
+    def set_answer(self, answer: Any) -> None:
+        """Validate/coerce an answer and mark resolved (problem.go:60-140)."""
+        if self.form == SolutionForm.SELECT:
+            answer = str(answer)
+            if answer not in self.options:
+                match = common.closest_matching_string(answer, self.options)
+                if not match:
+                    raise ValueError(f"{self.id}: no options to select from")
+                answer = match
+        elif self.form == SolutionForm.MULTI_SELECT:
+            if isinstance(answer, str):
+                answer = [a.strip() for a in answer.split(",") if a.strip()]
+            answer = [a for a in answer if a in self.options]
+        elif self.form == SolutionForm.CONFIRM:
+            if isinstance(answer, str):
+                answer = answer.strip().lower() in ("y", "yes", "true", "1")
+            else:
+                answer = bool(answer)
+        else:  # Input / MultiLine / Password
+            answer = str(answer)
+        self.answer = answer
+        self.resolved = True
+
+    def set_default_answer(self) -> None:
+        if self.form == SolutionForm.CONFIRM:
+            self.set_answer(bool(self.default))
+        elif self.form == SolutionForm.MULTI_SELECT:
+            self.answer = list(self.default or [])
+            self.resolved = True
+        elif self.form == SolutionForm.SELECT:
+            if self.default:
+                self.set_answer(self.default)
+            elif self.options:
+                self.set_answer(self.options[0])
+            else:
+                raise ValueError(f"{self.id}: select problem with no options")
+        else:
+            self.set_answer(self.default if self.default is not None else "")
+
+    # -- cache matching (parity: matches/matchString problem.go:151-185) ----
+
+    def matches(self, other: "Problem") -> bool:
+        """True if a cached problem (self) answers a new problem (other).
+
+        Descriptions may contain [wildcard] segments that match anything —
+        the reference turns bracketed segments into regex wildcards.
+        """
+        if self.form != other.form:
+            return False
+        return _match_desc(self.desc, other.desc)
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {
+            "id": self.id,
+            "description": self.desc,
+            "solution": {"type": self.form},
+        }
+        if self.context:
+            d["context"] = list(self.context)
+        sol = d["solution"]
+        if self.options:
+            sol["options"] = list(self.options)
+        if self.default not in (None, "", []):
+            sol["default"] = self.default
+        if self.resolved:
+            sol["answer"] = self.answer
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Problem":
+        sol = d.get("solution", {})
+        p = cls(
+            id=d.get("id", ""),
+            desc=d.get("description", ""),
+            form=sol.get("type", SolutionForm.INPUT),
+            context=list(d.get("context", [])),
+            options=list(sol.get("options", [])),
+            default=sol.get("default"),
+        )
+        if "answer" in sol:
+            p.answer = sol["answer"]
+            p.resolved = True
+        return p
+
+
+def _match_desc(cached_desc: str, new_desc: str) -> bool:
+    if cached_desc == new_desc:
+        return True
+    # Bracketed segments are wildcards: "Select port for [svc]" matches any svc.
+    pattern = re.escape(cached_desc)
+    pattern = re.sub(r"\\\[.*?\\\]", ".*", pattern)
+    return re.fullmatch(pattern, new_desc) is not None

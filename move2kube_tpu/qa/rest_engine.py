@@ -1,0 +1,99 @@
+"""REST QA engine: lets a UI drive the pipeline over HTTP.
+
+Parity: ``internal/qaengine/httprestengine.go:58-160`` — the pipeline
+thread publishes the current problem and blocks; a client GETs
+``/problems/current`` and POSTs ``/problems/current/solution``.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from move2kube_tpu.qa.engine import Engine
+from move2kube_tpu.qa.problem import Problem
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("qa.rest")
+
+API_PREFIX = "/api/v1"
+
+
+class HTTPRESTEngine(Engine):
+    def __init__(self, port: int = 0) -> None:
+        self.port = port
+        self._current: Problem | None = None
+        self._lock = threading.Lock()
+        self._answers: queue.Queue = queue.Queue()
+        self._server: ThreadingHTTPServer | None = None
+
+    def is_interactive(self) -> bool:
+        return True
+
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        engine = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003
+                log.debug("rest: " + fmt, *args)
+
+            def _send(self, code: int, body: dict) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == API_PREFIX + "/problems/current":
+                    with engine._lock:
+                        p = engine._current
+                    if p is None:
+                        self._send(204, {})
+                    else:
+                        self._send(200, p.to_dict())
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                if self.path == API_PREFIX + "/problems/current/solution":
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                    except json.JSONDecodeError:
+                        self._send(400, {"error": "invalid json"})
+                        return
+                    if "solution" not in body:
+                        self._send(400, {"error": "missing 'solution'"})
+                        return
+                    engine._answers.put(body["solution"])
+                    self._send(200, {"status": "accepted"})
+                else:
+                    self._send(404, {"error": "not found"})
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._server.server_address[1]
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        log.info("QA REST engine listening on 127.0.0.1:%d%s", self.port, API_PREFIX)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    def fetch_answer(self, problem: Problem) -> Problem:
+        with self._lock:
+            self._current = problem
+        try:
+            answer = self._answers.get(timeout=600)
+            problem.set_answer(answer)
+        finally:
+            with self._lock:
+                self._current = None
+        return problem

@@ -1,0 +1,137 @@
+import json
+import urllib.request
+
+import pytest
+
+from move2kube_tpu.qa import engine as qaengine
+from move2kube_tpu.qa.cache import Cache
+from move2kube_tpu.qa.problem import Problem, SolutionForm
+
+
+@pytest.fixture(autouse=True)
+def fresh_engines():
+    qaengine.reset_engines()
+    yield
+    qaengine.reset_engines()
+
+
+def test_default_engine_select():
+    qaengine.start_engine(interactive=False)
+    ans = qaengine.fetch_select("svc.artifact", "Choose artifact type", [], "Helm",
+                                ["Yamls", "Helm", "Knative"])
+    assert ans == "Helm"
+
+
+def test_default_engine_select_no_default():
+    qaengine.start_engine(interactive=False)
+    ans = qaengine.fetch_select("x", "pick", [], "", ["a", "b"])
+    assert ans == "a"
+
+
+def test_confirm_coercion():
+    p = Problem.confirm("c", "sure?", [], default=False)
+    p.set_answer("yes")
+    assert p.answer is True
+    p2 = Problem.confirm("c", "sure?", [])
+    p2.set_answer("NO")
+    assert p2.answer is False
+
+
+def test_multiselect_filters_invalid():
+    p = Problem.multi_select("m", "pick many", [], ["a"], ["a", "b"])
+    p.set_answer(["a", "zzz", "b"])
+    assert p.answer == ["a", "b"]
+
+
+def test_select_fuzzy_answer():
+    p = Problem.select("s", "pick", [], "", ["Helm", "Yamls"])
+    p.set_answer("helm")
+    assert p.answer == "Helm"
+
+
+def test_cache_roundtrip_and_replay(tmp_path):
+    cache_file = str(tmp_path / "m2ktqacache.yaml")
+    qaengine.set_write_cache(cache_file)
+    qaengine.start_engine(interactive=False)
+    qaengine.fetch_select("svc.port", "Select port for [web]", [], "", ["8080", "9090"])
+
+    # fresh chain: cache answers before default would
+    qaengine.reset_engines()
+    qaengine.add_cache_engine(cache_file)
+    p = Problem.select("svc.port", "Select port for [web]", [], "9090", ["8080", "9090"])
+    qaengine.fetch_answer(p)
+    assert p.answer == "8080"  # cached answer wins over default
+
+
+def test_cache_wildcard_match(tmp_path):
+    c = Cache(path=str(tmp_path / "c.yaml"))
+    solved = Problem.select("p1", "Select port for [web]", [], "", ["8080"])
+    solved.set_answer("8080")
+    c.add_solution(solved)
+    newp = Problem.select("p2", "Select port for [api]", [], "", ["8080", "1234"])
+    assert c.get_solution(newp) is not None
+    assert newp.answer == "8080"
+
+
+def test_cache_ignores_form_mismatch(tmp_path):
+    c = Cache(path=str(tmp_path / "c.yaml"))
+    solved = Problem.input("p1", "Enter the host", [], "x.com")
+    solved.set_answer("y.com")
+    c.add_solution(solved)
+    newp = Problem.confirm("p2", "Enter the host", [])
+    assert c.get_solution(newp) is None
+
+
+def test_rest_engine():
+    from move2kube_tpu.qa.rest_engine import HTTPRESTEngine
+    import threading
+
+    e = HTTPRESTEngine(0)
+    e.start()
+    qaengine.add_engine(e)
+    base = f"http://127.0.0.1:{e.port}/api/v1"
+
+    result = {}
+
+    def pipeline():
+        result["answer"] = qaengine.fetch_select(
+            "r", "Choose registry", [], "quay.io", ["quay.io", "gcr.io"]
+        )
+
+    t = threading.Thread(target=pipeline)
+    t.start()
+    # poll current problem
+    prob = None
+    for _ in range(100):
+        try:
+            with urllib.request.urlopen(base + "/problems/current", timeout=2) as r:
+                if r.status == 200:
+                    prob = json.loads(r.read())
+                    break
+        except Exception:
+            pass
+        import time
+
+        time.sleep(0.02)
+    assert prob is not None and prob["id"] == "r"
+    req = urllib.request.Request(
+        base + "/problems/current/solution",
+        data=json.dumps({"solution": "gcr.io"}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=2) as r:
+        assert r.status == 200
+    t.join(timeout=5)
+    assert result["answer"] == "gcr.io"
+    e.stop()
+
+
+def test_fetch_answer_falls_back_to_default():
+    class BrokenEngine(qaengine.Engine):
+        def fetch_answer(self, problem):
+            raise RuntimeError("boom")
+
+    qaengine.add_engine(BrokenEngine())
+    ans = qaengine.fetch_bool("b", "continue?", [], default=True)
+    assert ans is True
