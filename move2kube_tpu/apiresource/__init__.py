@@ -1,0 +1,1 @@
+from move2kube_tpu.apiresource.base import APIResource, convert_objects  # noqa: F401

@@ -1,0 +1,173 @@
+"""API-resource engine: kind creation + cluster-supported-kind conversion.
+
+Parity: ``internal/apiresource/apiresource.go:37-179``. Each APIResource
+declares the kinds it handles, creates new objects from the IR, and
+converts any object (new or cached) into a kind/version the target cluster
+supports (driven by ``ClusterMetadataSpec.get_supported_versions``).
+Duplicates are merged by name + kind-group (loadResource :88,
+isSameResource :121).
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.types.collection import ClusterMetadataSpec
+from move2kube_tpu.types.ir import IR
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("apiresource")
+
+
+def obj_name(obj: dict) -> str:
+    return obj.get("metadata", {}).get("name", "")
+
+
+def obj_kind(obj: dict) -> str:
+    return obj.get("kind", "")
+
+
+def group_of(api_version: str) -> str:
+    return api_version.rsplit("/", 1)[0] if "/" in api_version else ""
+
+
+def make_obj(kind: str, api_version: str, name: str, labels: dict | None = None) -> dict:
+    meta: dict = {"name": name}
+    if labels:
+        meta["labels"] = dict(labels)
+    return {"apiVersion": api_version, "kind": kind, "metadata": meta}
+
+
+class APIResource:
+    """One kind family (Deployment-likes, Service-likes, Storage...)."""
+
+    def get_supported_kinds(self) -> list[str]:
+        raise NotImplementedError
+
+    def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
+        raise NotImplementedError
+
+    def convert_to_cluster_supported_kinds(
+        self, obj: dict, supported_kinds: set[str], other_objs: list[dict], ir: IR,
+    ) -> list[dict]:
+        """Convert obj into kinds the cluster supports; [] = drop."""
+        return [obj]
+
+    # -- engine (parity: GetUpdatedResources apiresource.go:72) -------------
+
+    def get_updated_resources(self, ir: IR, cluster: ClusterMetadataSpec,
+                              cached: list[dict]) -> list[dict]:
+        supported = self._supported_on(cluster)
+        objs: list[dict] = []
+        mine = [o for o in cached if obj_kind(o) in self.get_supported_kinds()]
+        for obj in self.create_new_resources(ir, supported):
+            self._merge_or_add(obj, objs)
+        for obj in mine:
+            converted = self._convert(obj, supported, objs, ir)
+            for c in converted:
+                self._merge_or_add(c, objs)
+        # final pass: every emitted object to a cluster-supported version
+        out: list[dict] = []
+        for obj in objs:
+            out.extend(self._fix_version(obj, cluster, ir))
+        return out
+
+    def _supported_on(self, cluster: ClusterMetadataSpec) -> set[str]:
+        if not cluster.api_kind_version_map:
+            return set(self.get_supported_kinds())  # no cluster info: keep all
+        return {k for k in self.get_supported_kinds() if cluster.supports_kind(k)}
+
+    def _convert(self, obj: dict, supported: set[str], others: list[dict],
+                 ir: IR) -> list[dict]:
+        try:
+            return self.convert_to_cluster_supported_kinds(obj, supported, others, ir)
+        except Exception as e:  # noqa: BLE001 - plugin tolerance
+            log.warning("conversion failed for %s/%s: %s", obj_kind(obj), obj_name(obj), e)
+            return [obj]
+
+    def _merge_or_add(self, obj: dict, objs: list[dict]) -> None:
+        for existing in objs:
+            if self._is_same(existing, obj):
+                _deep_merge(existing, obj)
+                return
+        objs.append(obj)
+
+    @staticmethod
+    def _is_same(a: dict, b: dict) -> bool:
+        """name + kind + group equality (isSameResource apiresource.go:121)."""
+        return (
+            obj_name(a) == obj_name(b)
+            and obj_kind(a) == obj_kind(b)
+            and group_of(a.get("apiVersion", "")) == group_of(b.get("apiVersion", ""))
+        )
+
+    def _fix_version(self, obj: dict, cluster: ClusterMetadataSpec, ir: IR) -> list[dict]:
+        kind = obj_kind(obj)
+        versions = cluster.get_supported_versions(kind)
+        if not cluster.api_kind_version_map:
+            return [obj]
+        if versions:
+            obj["apiVersion"] = versions[0]
+            return [obj]
+        if ir.kubernetes.ignore_unsupported_kinds:
+            log.warning("dropping unsupported kind %s/%s", kind, obj_name(obj))
+            return []
+        return [obj]  # keep as-is; user asked to keep unsupported kinds
+
+
+def convert_objects(ir: IR, resources: list[APIResource]) -> list[dict]:
+    """Run every APIResource over the IR + cached objects; pass through
+    cached kinds nobody owns (parity: apiresourceset loop)."""
+    cluster = ir.target_cluster_spec
+    owned_kinds: set[str] = set()
+    for r in resources:
+        owned_kinds.update(r.get_supported_kinds())
+    out: list[dict] = []
+    for r in resources:
+        try:
+            out.extend(r.get_updated_resources(ir, cluster, ir.cached_objects))
+        except Exception as e:  # noqa: BLE001
+            log.warning("apiresource %s failed: %s", type(r).__name__, e)
+    for obj in ir.cached_objects:
+        if obj_kind(obj) not in owned_kinds:
+            out.append(obj)
+    _fixup_dangling_pvcs(out, cluster)
+    return out
+
+
+def _fixup_dangling_pvcs(objs: list[dict], cluster: ClusterMetadataSpec) -> None:
+    """Rewrite persistentVolumeClaim volumes to emptyDir when the cluster
+    lacks PVC support (parity: convertVolumesKindsByPolicy deployment.go:417
+    + storage.go:230). Runs across ALL emitted objects, after every
+    APIResource — a workload and its claim are handled by different
+    resources, so the rewrite cannot live inside either one.
+    """
+    if not cluster.api_kind_version_map or cluster.supports_kind("PersistentVolumeClaim"):
+        return
+    for obj in objs:
+        spec = obj.get("spec", {})
+        pod_specs = []
+        tmpl = spec.get("template", {})
+        if tmpl.get("spec"):
+            pod_specs.append(tmpl["spec"])
+        for rj in spec.get("replicatedJobs", []):  # JobSet nesting
+            inner = rj.get("template", {}).get("spec", {}).get("template", {}).get("spec")
+            if inner:
+                pod_specs.append(inner)
+        if obj_kind(obj) == "Pod" and spec.get("volumes") is not None:
+            pod_specs.append(spec)
+        for ps in pod_specs:
+            for vol in ps.get("volumes", []) or []:
+                if "persistentVolumeClaim" in vol:
+                    vol.pop("persistentVolumeClaim", None)
+                    vol["emptyDir"] = {}
+
+
+def _deep_merge(dst: dict, src: dict) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        elif isinstance(v, list) and isinstance(dst.get(k), list):
+            for item in v:
+                if item not in dst[k]:
+                    dst[k].append(item)
+        else:
+            dst[k] = v

@@ -1,0 +1,37 @@
+"""ImageStream apiresource (OpenShift).
+
+Parity: ``internal/apiresource/imagestream.go`` — one ImageStream per
+built image when the target cluster supports the kind.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.apiresource.base import APIResource, make_obj
+from move2kube_tpu.types.ir import IR
+from move2kube_tpu.utils import common
+
+IMAGE_STREAM = "ImageStream"
+
+
+class ImageStreamAPIResource(APIResource):
+    def get_supported_kinds(self) -> list[str]:
+        return [IMAGE_STREAM]
+
+    def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
+        if IMAGE_STREAM not in supported_kinds:
+            return []
+        objs = []
+        for container in ir.containers:
+            if not container.new or not container.image_names:
+                continue
+            image = container.image_names[0]
+            name = common.make_dns_label(image.split("/")[-1].split(":")[0])
+            obj = make_obj(IMAGE_STREAM, "image.openshift.io/v1", name)
+            obj["spec"] = {
+                "tags": [{
+                    "name": image.rsplit(":", 1)[1] if ":" in image else "latest",
+                    "from": {"kind": "DockerImage", "name": image},
+                }]
+            }
+            objs.append(obj)
+        return objs

@@ -1,0 +1,35 @@
+"""NetworkPolicy apiresource: one policy per compose network.
+
+Parity: ``internal/apiresource/networkpolicy.go`` — services that declare
+networks get a label per network; each network becomes a NetworkPolicy
+allowing ingress among members.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.apiresource.base import APIResource, make_obj
+from move2kube_tpu.types.ir import IR
+
+NETWORK_LABEL_PREFIX = "move2kube-tpu.io/network."
+
+
+class NetworkPolicyAPIResource(APIResource):
+    def get_supported_kinds(self) -> list[str]:
+        return ["NetworkPolicy"]
+
+    def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
+        networks: set[str] = set()
+        for svc in ir.services.values():
+            for net in svc.networks:
+                networks.add(net)
+                svc.labels[NETWORK_LABEL_PREFIX + net] = "true"
+        objs = []
+        for net in sorted(networks):
+            obj = make_obj("NetworkPolicy", "networking.k8s.io/v1", net)
+            selector = {"matchLabels": {NETWORK_LABEL_PREFIX + net: "true"}}
+            obj["spec"] = {
+                "podSelector": selector,
+                "ingress": [{"from": [{"podSelector": selector}]}],
+            }
+            objs.append(obj)
+        return objs

@@ -1,0 +1,57 @@
+"""RBAC apiresources: ServiceAccount / Role / RoleBinding.
+
+Parity: ``internal/apiresource/{serviceaccount,role,rolebinding}.go``.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.apiresource.base import APIResource, make_obj
+from move2kube_tpu.types.ir import IR
+
+
+class ServiceAccountAPIResource(APIResource):
+    def get_supported_kinds(self) -> list[str]:
+        return ["ServiceAccount"]
+
+    def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
+        objs = []
+        for sa in ir.service_accounts:
+            obj = make_obj("ServiceAccount", "v1", sa.get("name", ""))
+            if sa.get("secrets"):
+                obj["secrets"] = [{"name": s} for s in sa["secrets"]]
+            objs.append(obj)
+        return objs
+
+
+class RoleAPIResource(APIResource):
+    def get_supported_kinds(self) -> list[str]:
+        return ["Role"]
+
+    def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
+        objs = []
+        for role in ir.roles:
+            obj = make_obj("Role", "rbac.authorization.k8s.io/v1", role.get("name", ""))
+            obj["rules"] = role.get("rules", [])
+            objs.append(obj)
+        return objs
+
+
+class RoleBindingAPIResource(APIResource):
+    def get_supported_kinds(self) -> list[str]:
+        return ["RoleBinding"]
+
+    def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
+        objs = []
+        for rb in ir.role_bindings:
+            obj = make_obj("RoleBinding", "rbac.authorization.k8s.io/v1", rb.get("name", ""))
+            obj["subjects"] = [{
+                "kind": "ServiceAccount",
+                "name": rb.get("service_account", ""),
+            }]
+            obj["roleRef"] = {
+                "kind": "Role",
+                "name": rb.get("role", ""),
+                "apiGroup": "rbac.authorization.k8s.io",
+            }
+            objs.append(obj)
+        return objs

@@ -1,0 +1,180 @@
+"""Service / Ingress / Route apiresource.
+
+Parity: ``internal/apiresource/service.go`` — one k8s Service per exposed
+IR service (headless when nothing is exposed), a single fan-out Ingress
+built from every service carrying the expose annotation (createIngress
+:446) with optional TLS, and Route<->Ingress<->Service conversions for
+OpenShift clusters (:147-389).
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.apiresource.base import APIResource, make_obj, obj_kind, obj_name
+from move2kube_tpu.apiresource.deployment import SELECTOR_LABEL
+from move2kube_tpu.types.ir import IR, Service
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("apiresource.service")
+
+SERVICE = "Service"
+INGRESS = "Ingress"
+ROUTE = "Route"
+
+EXPOSE_ANNOTATION = common.EXPOSE_SERVICE_ANNOTATION
+
+
+class ServiceAPIResource(APIResource):
+    def get_supported_kinds(self) -> list[str]:
+        return [SERVICE, INGRESS, ROUTE]
+
+    def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
+        objs: list[dict] = []
+        exposed: list[Service] = []
+        for svc in ir.services.values():
+            if svc.job:  # training workloads get headless services for ICI discovery
+                if svc.accelerator is not None:
+                    objs.append(self._create_headless(svc))
+                continue
+            if svc.port_forwardings:
+                objs.append(self._create_service(svc))
+                if svc.has_valid_annotation(EXPOSE_ANNOTATION):
+                    exposed.append(svc)
+            elif not svc.only_ingress:
+                objs.append(self._create_headless(svc))
+        if exposed:
+            if INGRESS in supported_kinds or not supported_kinds:
+                objs.append(self._create_ingress(ir, exposed))
+            elif ROUTE in supported_kinds:
+                objs.extend(self._create_route(svc) for svc in exposed)
+        return objs
+
+    def _create_service(self, svc: Service) -> dict:
+        obj = make_obj(SERVICE, "v1", svc.name, {SELECTOR_LABEL: svc.name})
+        ports = []
+        for pf in svc.port_forwardings:
+            port: dict = {
+                "name": pf.name or f"port-{pf.service_port}",
+                "port": pf.service_port,
+                "targetPort": pf.container_port,
+            }
+            ports.append(port)
+        obj["spec"] = {
+            "type": "ClusterIP",
+            "selector": {SELECTOR_LABEL: svc.name},
+            "ports": ports,
+        }
+        if svc.annotations:
+            obj["metadata"]["annotations"] = dict(svc.annotations)
+        return obj
+
+    def _create_headless(self, svc: Service) -> dict:
+        obj = make_obj(SERVICE, "v1", svc.name, {SELECTOR_LABEL: svc.name})
+        obj["spec"] = {
+            "clusterIP": "None",
+            "selector": {SELECTOR_LABEL: svc.name},
+        }
+        return obj
+
+    def _create_ingress(self, ir: IR, exposed: list[Service]) -> dict:
+        """Single fan-out ingress (service.go:446)."""
+        name = common.make_dns_label(ir.name)
+        obj = make_obj(INGRESS, "networking.k8s.io/v1", name)
+        host = ir.values.ingress_host or ""
+        paths = []
+        for svc in exposed:
+            port = svc.port_forwardings[0].service_port if svc.port_forwardings else common.DEFAULT_SERVICE_PORT
+            paths.append({
+                "path": svc.service_rel_path or "/" + svc.name,
+                "pathType": "Prefix",
+                "backend": {
+                    "service": {
+                        "name": svc.backend_service_name or svc.name,
+                        "port": {"number": port},
+                    }
+                },
+            })
+        rule: dict = {"http": {"paths": paths}}
+        if host:
+            rule["host"] = host
+        obj["spec"] = {"rules": [rule]}
+        if ir.ingress_tls_secret_name:
+            tls: dict = {"secretName": ir.ingress_tls_secret_name}
+            if host:
+                tls["hosts"] = [host]
+            obj["spec"]["tls"] = [tls]
+        return obj
+
+    def _create_route(self, svc: Service) -> dict:
+        port = svc.port_forwardings[0].service_port if svc.port_forwardings else common.DEFAULT_SERVICE_PORT
+        obj = make_obj(ROUTE, "route.openshift.io/v1", svc.name,
+                       {SELECTOR_LABEL: svc.name})
+        obj["spec"] = {
+            "to": {"kind": "Service", "name": svc.name},
+            "port": {"targetPort": port},
+        }
+        return obj
+
+    # -- conversions (service.go:147-389) -----------------------------------
+
+    def convert_to_cluster_supported_kinds(
+        self, obj: dict, supported: set[str], other_objs: list[dict], ir: IR,
+    ) -> list[dict]:
+        kind = obj_kind(obj)
+        if kind in supported or not supported:
+            return [obj]
+        if kind == INGRESS and ROUTE in supported:
+            return self._ingress_to_routes(obj)
+        if kind == ROUTE and INGRESS in supported:
+            return [self._route_to_ingress(obj)]
+        if kind in (INGRESS, ROUTE) and SERVICE in supported:
+            # expose via NodePort instead (service.go:360): mutate the
+            # already-accumulated Service objects in place and drop the obj
+            for other in other_objs:
+                if obj_kind(other) == SERVICE:
+                    other.setdefault("spec", {})["type"] = "NodePort"
+            return []
+        return [obj]
+
+    def _ingress_to_routes(self, obj: dict) -> list[dict]:
+        routes = []
+        for rule in obj.get("spec", {}).get("rules", []):
+            host = rule.get("host", "")
+            for path in rule.get("http", {}).get("paths", []):
+                backend = path.get("backend", {}).get("service", {})
+                name = backend.get("name", obj_name(obj))
+                route = make_obj(ROUTE, "route.openshift.io/v1",
+                                 common.make_dns_label(f"{obj_name(obj)}-{name}"))
+                route["spec"] = {
+                    "to": {"kind": "Service", "name": name},
+                    "port": {"targetPort": backend.get("port", {}).get("number", 80)},
+                }
+                if host:
+                    route["spec"]["host"] = host
+                if path.get("path"):
+                    route["spec"]["path"] = path["path"]
+                routes.append(route)
+        return routes
+
+    def _route_to_ingress(self, obj: dict) -> dict:
+        spec = obj.get("spec", {})
+        ing = make_obj(INGRESS, "networking.k8s.io/v1", obj_name(obj))
+        port = spec.get("port", {}).get("targetPort", 80)
+        rule: dict = {
+            "http": {
+                "paths": [{
+                    "path": spec.get("path", "/"),
+                    "pathType": "Prefix",
+                    "backend": {
+                        "service": {
+                            "name": spec.get("to", {}).get("name", ""),
+                            "port": {"number": port if isinstance(port, int) else 80},
+                        }
+                    },
+                }]
+            }
+        }
+        if spec.get("host"):
+            rule["host"] = spec["host"]
+        ing["spec"] = {"rules": [rule]}
+        return ing

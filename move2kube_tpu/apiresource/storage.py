@@ -1,0 +1,87 @@
+"""Storage apiresource: ConfigMap / Secret / PVC.
+
+Parity: ``internal/apiresource/storage.go`` — creates storage objects from
+IR storages (:42) and cross-converts when the cluster lacks a kind:
+ConfigMap <-> Secret, PVC -> emptyDir rewrite in pod volumes (:160-290).
+"""
+
+from __future__ import annotations
+
+import base64
+
+from move2kube_tpu.apiresource.base import APIResource, make_obj, obj_kind, obj_name
+from move2kube_tpu.types.ir import IR, StorageKind
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("apiresource.storage")
+
+CONFIG_MAP = "ConfigMap"
+SECRET = "Secret"
+PVC = "PersistentVolumeClaim"
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+class StorageAPIResource(APIResource):
+    def get_supported_kinds(self) -> list[str]:
+        return [CONFIG_MAP, SECRET, PVC]
+
+    def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
+        objs = []
+        for storage in ir.storages:
+            if storage.kind == StorageKind.CONFIGMAP:
+                obj = make_obj(CONFIG_MAP, "v1", storage.name)
+                obj["data"] = {
+                    k: v.decode() if isinstance(v, bytes) else str(v)
+                    for k, v in storage.content.items()
+                }
+            elif storage.kind in (StorageKind.SECRET, StorageKind.PULL_SECRET):
+                obj = make_obj(SECRET, "v1", storage.name)
+                if storage.secret_type:
+                    obj["type"] = storage.secret_type
+                elif storage.kind == StorageKind.PULL_SECRET:
+                    obj["type"] = "kubernetes.io/dockerconfigjson"
+                obj["data"] = {
+                    k: _b64(v if isinstance(v, bytes) else str(v).encode())
+                    for k, v in storage.content.items()
+                }
+            elif storage.kind == StorageKind.PVC:
+                obj = make_obj(PVC, "v1", storage.name)
+                obj["spec"] = storage.pvc_spec or {
+                    "accessModes": ["ReadWriteOnce"],
+                    "resources": {"requests": {"storage": "100Mi"}},
+                }
+            else:
+                continue
+            if storage.annotations:
+                obj["metadata"]["annotations"] = dict(storage.annotations)
+            objs.append(obj)
+        return objs
+
+    def convert_to_cluster_supported_kinds(
+        self, obj: dict, supported: set[str], other_objs: list[dict], ir: IR,
+    ) -> list[dict]:
+        kind = obj_kind(obj)
+        if kind in supported or not supported:
+            return [obj]
+        if kind == CONFIG_MAP and SECRET in supported:
+            sec = make_obj(SECRET, "v1", obj_name(obj))
+            sec["data"] = {k: _b64(str(v).encode()) for k, v in obj.get("data", {}).items()}
+            return [sec]
+        if kind == SECRET and CONFIG_MAP in supported:
+            cm = make_obj(CONFIG_MAP, "v1", obj_name(obj))
+            cm["data"] = {
+                k: base64.b64decode(v).decode(errors="replace")
+                for k, v in obj.get("data", {}).items()
+            }
+            return [cm]
+        if kind == PVC:
+            # cluster has no PVC: drop the claim; the workloads' dangling
+            # volume references are rewritten to emptyDir by the engine's
+            # final fixup pass (base.convert_objects; parity storage.go:230)
+            log.warning("cluster lacks PVC; %s dropped, volumes become emptyDir",
+                        obj_name(obj))
+            return []
+        return [obj]

@@ -1,0 +1,162 @@
+"""m2kt CLI: plan / translate / collect / version.
+
+Parity: ``cmd/move2kube/`` (cobra commands move2kube.go:37-47,
+translate.go:93-205, plan.go, collect.go, version.go). Every flag can also
+come from the environment as ``M2KT_<FLAG>`` (viper.AutomaticEnv parity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import move2kube_tpu
+from move2kube_tpu import qa
+from move2kube_tpu.engine.collector import collect
+from move2kube_tpu.engine.planner import create_plan, curate_plan
+from move2kube_tpu.engine.translator import translate
+from move2kube_tpu.types import plan as plantypes
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import configure, get_logger
+
+log = get_logger("cli")
+
+
+def _env_default(flag: str, default):
+    return os.environ.get("M2KT_" + flag.upper().replace("-", "_"), default)
+
+
+def _env_bool(flag: str, default: bool = False) -> bool:
+    """Boolean env parsing with viper semantics: 'false'/'0'/'' are False."""
+    raw = os.environ.get("M2KT_" + flag.upper().replace("-", "_"))
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="m2kt",
+        description="move2kube-tpu: re-platform applications onto Kubernetes, "
+                    "translating GPU training workloads to TPU.",
+    )
+    p.add_argument("--verbose", "-v", action="store_true",
+                   default=_env_bool("verbose"))
+    sub = p.add_subparsers(dest="command")
+
+    pp = sub.add_parser("plan", help="analyse sources and write m2kt.plan")
+    pp.add_argument("--source", "-s", default=_env_default("source", "."),
+                    help="source directory")
+    pp.add_argument("--name", "-n", default=_env_default("name", ""),
+                    help="project name")
+    pp.add_argument("--plan", "-p", default=_env_default("plan", common.DEFAULT_PLAN_FILE),
+                    help="plan file to write")
+
+    tp = sub.add_parser("translate", help="translate sources into deployment artifacts")
+    tp.add_argument("--source", "-s", default=_env_default("source", ""),
+                    help="source directory")
+    tp.add_argument("--plan", "-p", default=_env_default("plan", ""),
+                    help="existing plan file")
+    tp.add_argument("--outpath", "-o", default=_env_default("outpath", "."),
+                    help="output directory")
+    tp.add_argument("--name", "-n", default=_env_default("name", ""))
+    tp.add_argument("--curate", "-c", action="store_true", default=False,
+                    help="interactively curate the plan")
+    tp.add_argument("--qa-skip", action="store_true",
+                    default=_env_bool("qa_skip"),
+                    help="accept defaults for all questions")
+    tp.add_argument("--qa-port", type=int, default=int(_env_default("qa_port", 0) or 0),
+                    help="serve questions over REST on this port")
+    tp.add_argument("--qa-cache", default=_env_default("qa_cache", ""),
+                    help="replay answers from a previous run's cache file")
+    tp.add_argument("--ignore-env", action="store_true", default=False,
+                    help="derive nothing from the local environment")
+
+    cp = sub.add_parser("collect", help="collect metadata from cluster/docker")
+    cp.add_argument("--source", "-s", default=_env_default("source", "."))
+    cp.add_argument("--outpath", "-o", default=_env_default("outpath", "."))
+    cp.add_argument("--annotations", "-a", default="",
+                    help="comma-separated collector annotations filter")
+
+    sub.add_parser("version", help="print version")
+    return p
+
+
+def plan_handler(args) -> int:
+    source = os.path.abspath(args.source)
+    if not os.path.isdir(source):
+        log.error("source directory %s does not exist", source)
+        return 1
+    plan = create_plan(source, args.name)
+    plantypes.write_plan(args.plan, plan)
+    n = sum(len(v) for v in plan.services.values())
+    print(f"plan written to {args.plan} ({len(plan.services)} services, {n} options)")
+    return 0
+
+
+def translate_handler(args) -> int:
+    if args.ignore_env:
+        common.IGNORE_ENVIRONMENT = True
+    qa.reset_engines()
+    interactive = (args.curate or bool(args.qa_port)) and not args.qa_skip
+    qa.start_engine(interactive=interactive, qa_skip=args.qa_skip,
+                    qa_port=args.qa_port)
+    if args.qa_cache:
+        qa.add_cache_engine(args.qa_cache)
+
+    out_dir = os.path.abspath(args.outpath)
+    if args.plan and os.path.isfile(args.plan):
+        try:
+            plan = plantypes.read_plan(args.plan)
+        except ValueError as e:
+            log.error("cannot read plan: %s", e)
+            return 1
+        if args.source:
+            plan.set_root_dir(os.path.abspath(args.source))
+        if args.name:
+            plan.name = common.make_dns_label(args.name)
+    else:
+        if not args.source:
+            log.error("either --plan or --source is required")
+            return 1
+        source = os.path.abspath(args.source)
+        if not os.path.isdir(source):
+            log.error("source directory %s does not exist", source)
+            return 1
+        plan = create_plan(source, args.name)
+    for cache in plan.qa_caches:
+        qa.add_cache_engine(cache)
+    qa.set_write_cache(os.path.join(out_dir, common.QA_CACHE_FILE))
+    plan = curate_plan(plan)
+    translate(plan, out_dir)
+    print(f"artifacts written to {out_dir}")
+    return 0
+
+
+def collect_handler(args) -> int:
+    annotations = [a.strip() for a in args.annotations.split(",") if a.strip()]
+    collect(os.path.abspath(args.source), os.path.abspath(args.outpath), annotations)
+    print(f"collect output written to {os.path.join(args.outpath, common.COLLECT_OUTPUT_DIR)}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    configure(verbose=bool(args.verbose))
+    if args.command == "plan":
+        return plan_handler(args)
+    if args.command == "translate":
+        return translate_handler(args)
+    if args.command == "collect":
+        return collect_handler(args)
+    if args.command == "version":
+        print(f"move2kube-tpu {move2kube_tpu.__version__}")
+        return 0
+    parser.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Cluster collector: introspect the live target cluster.
+
+Parity: ``internal/collector/clustercollector.go`` — prefers the discovery
+API; we have no client-go, so the primary path shells out to ``kubectl
+api-resources`` / ``api-versions`` (collectUsingCLI :491) and also gathers
+storage classes and (net-new) TPU node-pool capability from node labels
+(``cloud.google.com/gke-tpu-accelerator``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+from move2kube_tpu.types import collection as collecttypes
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("collector.cluster")
+
+
+def _kubectl(*args: str) -> str | None:
+    if common.IGNORE_ENVIRONMENT:
+        return None
+    try:
+        res = subprocess.run(
+            ["kubectl", *args], capture_output=True, text=True, timeout=60, check=False,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return res.stdout if res.returncode == 0 else None
+
+
+class ClusterCollector:
+    def get_annotations(self) -> list[str]:
+        return ["k8s", "cluster"]
+
+    def collect(self, source_dir: str, out_dir: str) -> None:
+        out = _kubectl("api-resources", "--no-headers")
+        if out is None:
+            log.info("kubectl unavailable; skipping cluster collection")
+            return
+        spec = collecttypes.ClusterMetadataSpec()
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 4:
+                continue
+            # NAME [SHORTNAMES] APIVERSION NAMESPACED KIND
+            kind = parts[-1]
+            api_version = parts[-3]
+            spec.api_kind_version_map.setdefault(kind, [])
+            if api_version not in spec.api_kind_version_map[kind]:
+                spec.api_kind_version_map[kind].append(api_version)
+        sc_out = _kubectl("get", "storageclass", "-o", "name")
+        if sc_out:
+            spec.storage_classes = [
+                line.split("/", 1)[-1] for line in sc_out.splitlines() if line
+            ]
+        # net-new: TPU node pools
+        tpu_out = _kubectl(
+            "get", "nodes",
+            "-o", r"jsonpath={range .items[*]}{.metadata.labels.cloud\.google\.com/gke-tpu-accelerator}{'\n'}{end}",
+        )
+        if tpu_out:
+            spec.tpu_accelerators = sorted({l for l in tpu_out.splitlines() if l})
+        ctx = _kubectl("config", "current-context") or "cluster"
+        name = common.make_dns_label(ctx.strip())
+        cm = collecttypes.ClusterMetadata(name=name, spec=spec)
+        path = os.path.join(out_dir, "clusters", name + ".yaml")
+        common.write_yaml(path, cm.to_dict())
+        log.info("cluster metadata written to %s", path)

@@ -1,0 +1,87 @@
+"""Images collector: inspect container images referenced by the sources.
+
+Parity: ``internal/collector/imagescollector.go`` — image names from k8s /
+compose yamls in the source dir (or all local docker images), then
+``docker inspect`` for user, exposed ports and accessed dirs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+
+from move2kube_tpu.types import collection as collecttypes
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("collector.images")
+
+
+def _docker_inspect(image: str) -> dict | None:
+    if common.IGNORE_ENVIRONMENT:
+        return None
+    try:
+        res = subprocess.run(
+            ["docker", "inspect", image],
+            capture_output=True, text=True, timeout=60, check=False,
+        )
+        if res.returncode != 0:
+            return None
+        data = json.loads(res.stdout)
+        return data[0] if data else None
+    except (OSError, subprocess.TimeoutExpired, json.JSONDecodeError):
+        return None
+
+
+def images_from_sources(source_dir: str) -> list[str]:
+    images: list[str] = []
+    for path in common.get_files_by_ext(source_dir, [".yaml", ".yml"]):
+        try:
+            doc = common.read_yaml(path)
+        except Exception:  # noqa: BLE001
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("services"), dict):
+            for svc in doc["services"].values():
+                if isinstance(svc, dict) and svc.get("image"):
+                    images.append(str(svc["image"]))
+        elif isinstance(doc, dict) and doc.get("kind"):
+            tmpl = doc.get("spec", {}).get("template", {})
+            for c in tmpl.get("spec", {}).get("containers", []) or []:
+                if c.get("image"):
+                    images.append(str(c["image"]))
+    return sorted(set(images))
+
+
+class ImagesCollector:
+    def get_annotations(self) -> list[str]:
+        return ["k8s", "docker", "images"]
+
+    def collect(self, source_dir: str, out_dir: str) -> None:
+        for image in images_from_sources(source_dir):
+            inspected = _docker_inspect(image)
+            if inspected is None:
+                continue
+            cfg = inspected.get("Config", {}) or {}
+            info = collecttypes.ImageInfo()
+            name, _, tag = image.partition(":")
+            info.tags = [(name, tag or "latest")]
+            user = str(cfg.get("User", "") or "")
+            if user.isdigit():
+                info.user_id = int(user)
+            info.ports_to_expose = [
+                int(p.split("/")[0]) for p in (cfg.get("ExposedPorts") or {})
+                if p.split("/")[0].isdigit()
+            ]
+            dirs = set()
+            for env in cfg.get("Env") or []:
+                if env.startswith("PATH="):
+                    dirs.update(p for p in env[5:].split(":") if p)
+            dirs.update((inspected.get("Config", {}).get("Volumes") or {}).keys())
+            if cfg.get("WorkingDir"):
+                dirs.add(cfg["WorkingDir"])
+            info.accessed_dirs = sorted(dirs)
+            fname = common.make_dns_label(image.replace("/", "-").replace(":", "-"))
+            path = os.path.join(out_dir, "images", fname + ".yaml")
+            common.write_yaml(path, info.to_dict())
+            log.info("image metadata written to %s", path)

@@ -1,0 +1,7 @@
+from move2kube_tpu.containerizer.base import (  # noqa: F401
+    Containerizer,
+    get_container,
+    get_containerization_options,
+    init_containerizers,
+    reset_containerizers,
+)

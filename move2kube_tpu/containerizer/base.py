@@ -1,0 +1,96 @@
+"""Containerizer interface and registry.
+
+Parity: ``internal/containerizer/containerizer.go:37-62`` — each
+containerizer detects whether it can build a directory, offers target
+options at plan time, and produces a ``Container`` (generated files) at
+translate time. The registry is ordered; ``init_containerizers`` wires the
+built-ins and lets user-provided detectors in the source tree extend them.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.types.ir import Container
+from move2kube_tpu.types.plan import PlanService
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("containerizer")
+
+
+class Containerizer:
+    def init(self, source_dir: str) -> None:  # scan for detectors
+        pass
+
+    def get_build_type(self) -> str:
+        raise NotImplementedError
+
+    def get_target_options(self, plan, directory: str) -> list[str]:
+        """Options (e.g. stack template ids) this containerizer offers for
+        the directory; empty = cannot containerize it."""
+        raise NotImplementedError
+
+    def get_container(self, plan, service: PlanService) -> Container:
+        raise NotImplementedError
+
+
+_containerizers: list[Containerizer] = []
+
+
+def reset_containerizers() -> None:
+    _containerizers.clear()
+
+
+def init_containerizers(source_dir: str, extra: list[Containerizer] | None = None) -> None:
+    """Build the ordered registry (containerizer.go:56-62)."""
+    from move2kube_tpu.containerizer.dockerfile import DockerfileContainerizer
+    from move2kube_tpu.containerizer.jax_xla import JaxXlaContainerizer
+    from move2kube_tpu.containerizer.reuse import ReuseContainerizer
+    from move2kube_tpu.containerizer.reuse_dockerfile import ReuseDockerfileContainerizer
+    from move2kube_tpu.containerizer.s2i import S2IContainerizer
+    from move2kube_tpu.containerizer.cnb import CNBContainerizer
+
+    reset_containerizers()
+    regs: list[Containerizer] = [
+        JaxXlaContainerizer(),  # TPU first: GPU training dirs are claimed here
+        DockerfileContainerizer(),
+        S2IContainerizer(),
+        CNBContainerizer(),
+        ReuseContainerizer(),
+        ReuseDockerfileContainerizer(),
+    ]
+    if extra:
+        regs.extend(extra)
+    for c in regs:
+        try:
+            c.init(source_dir)
+            _containerizers.append(c)
+        except Exception as e:  # noqa: BLE001 - plugin tolerance
+            log.warning("containerizer %s failed to init: %s", type(c).__name__, e)
+
+
+def get_containerizers() -> list[Containerizer]:
+    return list(_containerizers)
+
+
+def get_containerization_options(plan, directory: str) -> dict[str, list[str]]:
+    """build-type -> target options for a directory (containerizer.go:64)."""
+    out: dict[str, list[str]] = {}
+    for c in _containerizers:
+        try:
+            options = c.get_target_options(plan, directory)
+        except Exception as e:  # noqa: BLE001
+            log.warning("containerizer %s failed on %s: %s", type(c).__name__, directory, e)
+            continue
+        if options:
+            out[c.get_build_type()] = options
+    return out
+
+
+def get_container(plan, service: PlanService) -> Container:
+    """Dispatch to the containerizer matching the service's build type
+    (containerizer.go:79)."""
+    for c in _containerizers:
+        if c.get_build_type() == service.container_build_type:
+            return c.get_container(plan, service)
+    raise ValueError(
+        f"no containerizer for build type {service.container_build_type!r}"
+    )

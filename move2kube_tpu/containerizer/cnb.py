@@ -1,0 +1,100 @@
+"""Cloud Native Buildpacks containerizer.
+
+Parity: ``internal/containerizer/cnbcontainerizer.go`` + the ``cnb/``
+provider chain. The reference probes builder support by running the CNB
+lifecycle detector via the docker daemon / pack CLI / runc; all of those
+are environment-gated. We keep the same provider seam but default to a
+static heuristic (stack detection implies buildpack support) so planning
+works with no daemon, and shell out to ``pack`` only when available and
+``IGNORE_ENVIRONMENT`` is False. Results are memoised per directory
+(parity: cnbcache).
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+
+from move2kube_tpu.containerizer import stacks
+from move2kube_tpu.containerizer.base import Containerizer
+from move2kube_tpu.containerizer.scripts import CNB_BUILD_SH
+from move2kube_tpu.types.ir import Container
+from move2kube_tpu.types.plan import ContainerBuildType, PlanService
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("containerizer.cnb")
+
+# parity: hardcoded builders, cnbcontainerizer.go:41
+BUILDERS = ["gcr.io/buildpacks/builder", "paketobuildpacks/builder-jammy-base"]
+
+# stacks known to be supported by the default builders
+_BUILDPACK_STACKS = {
+    "python", "django", "nodejs", "golang", "java-maven", "java-gradle", "ruby", "php",
+}
+
+
+class CNBContainerizer(Containerizer):
+    def __init__(self) -> None:
+        self._cache: dict[str, list[str]] = {}
+        self._pack = None  # lazily resolved
+
+    def get_build_type(self) -> str:
+        return ContainerBuildType.CNB
+
+    def _pack_available(self) -> bool:
+        if self._pack is None:
+            self._pack = (
+                not common.IGNORE_ENVIRONMENT and shutil.which("pack") is not None
+            )
+        return self._pack
+
+    def get_target_options(self, plan, directory: str) -> list[str]:
+        if directory in self._cache:
+            return self._cache[directory]
+        options: list[str] = []
+        matched = {m.stack for m in stacks.detect_stacks(directory)}
+        if matched & _BUILDPACK_STACKS:
+            if self._pack_available():
+                options = [b for b in BUILDERS if self._probe_pack(directory, b)] or list(BUILDERS)
+            else:
+                options = list(BUILDERS)
+        self._cache[directory] = options
+        return options
+
+    def _probe_pack(self, directory: str, builder: str) -> bool:
+        try:
+            res = subprocess.run(
+                ["pack", "build", "--dry-run", "--builder", builder, "--path", directory,
+                 "m2kt-probe"],
+                capture_output=True, timeout=120, check=False,
+            )
+            return res.returncode == 0
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+
+    def get_container(self, plan, service: PlanService) -> Container:
+        if not service.containerization_target_options:
+            raise ValueError(f"{service.service_name}: no CNB builder selected")
+        builder = service.containerization_target_options[0]
+        name = common.make_dns_label(service.service_name)
+        image_name = service.image or f"{name}:latest"
+        container = Container(
+            image_names=[image_name], new=True, build_type=ContainerBuildType.CNB,
+        )
+        from move2kube_tpu.containerizer.dockerfile import _record_source_dir
+
+        src_dirs = service.source_artifacts.get(PlanService.SOURCE_DIR_ARTIFACT, [])
+        if src_dirs:
+            _record_source_dir(container, plan, src_dirs[0])
+        container.add_file(
+            f"{name}-cnb-build.sh",
+            common.render_template(CNB_BUILD_SH, {
+                "service_name": name,
+                "builder": builder,
+                "image_name": image_name,
+                "context": ".",
+            }),
+        )
+        container.add_exposed_port(common.DEFAULT_SERVICE_PORT)
+        return container

@@ -1,0 +1,145 @@
+"""Dockerfile containerizer: generate a Dockerfile from per-stack templates.
+
+Parity: ``internal/containerizer/dockerfilecontainerizer.go:50-186``. The
+reference detects via embedded ``m2kdfdetect.sh`` scripts; built-in stacks
+here detect in-process (stacks.py). User-provided detectors still work the
+script way: any directory in the source tree containing ``m2ktdfdetect.sh``
+plus a ``Dockerfile`` template is registered as a custom option, the script
+is run with the service dir as argv[1], and its JSON stdout feeds the
+template — the same contract as the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+
+from move2kube_tpu.containerizer import stacks
+from move2kube_tpu.containerizer.base import Containerizer
+from move2kube_tpu.containerizer.scripts import DOCKER_BUILD_SH
+from move2kube_tpu.types.ir import Container
+from move2kube_tpu.types.plan import ContainerBuildType, PlanService
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("containerizer.dockerfile")
+
+CUSTOM_DETECT_SCRIPT = "m2ktdfdetect.sh"
+
+
+def _record_source_dir(container, plan, svc_dir: str) -> None:
+    """Remember the service's source dir relative to the plan root so
+    copysources.sh copies the right subtree next to the build files
+    (transformer/base.py reads repo_info.git_repo_dir)."""
+    rel = None
+    if plan is not None and getattr(plan, "root_dir", ""):
+        rel = common.relpath_under(svc_dir, plan.root_dir)
+    container.repo_info.git_repo_dir = rel if rel is not None else "."
+
+
+class DockerfileContainerizer(Containerizer):
+    def __init__(self) -> None:
+        self.custom_dirs: list[str] = []
+
+    def init(self, source_dir: str) -> None:
+        """Register custom detector dirs from the source tree
+        (dockerfilecontainerizer.go:50)."""
+        self.custom_dirs = [
+            os.path.dirname(p)
+            for p in common.get_files_by_name(source_dir, [CUSTOM_DETECT_SCRIPT])
+            if os.path.isfile(os.path.join(os.path.dirname(p), "Dockerfile"))
+        ]
+
+    def get_build_type(self) -> str:
+        return ContainerBuildType.NEW_DOCKERFILE
+
+    def get_target_options(self, plan, directory: str) -> list[str]:
+        options = [m.stack for m in stacks.detect_stacks(directory)]
+        for custom in self.custom_dirs:
+            if self._run_custom_detect(custom, directory) is not None:
+                options.append(custom)
+        return options
+
+    def _run_custom_detect(self, custom_dir: str, directory: str) -> dict | None:
+        script = os.path.join(custom_dir, CUSTOM_DETECT_SCRIPT)
+        try:
+            res = subprocess.run(
+                ["/bin/sh", script, directory],
+                capture_output=True, text=True, timeout=60, check=False,
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            log.debug("custom detect %s failed: %s", script, e)
+            return None
+        if res.returncode != 0:
+            return None
+        try:
+            params = json.loads(res.stdout or "{}")
+        except json.JSONDecodeError:
+            params = {}
+        return params if isinstance(params, dict) else {}
+
+    def get_container(self, plan, service: PlanService) -> Container:
+        """Render the stack template into Container.NewFiles
+        (dockerfilecontainerizer.go:86-186)."""
+        if not service.containerization_target_options:
+            raise ValueError(f"{service.service_name}: no containerization target option")
+        option = service.containerization_target_options[0]
+        svc_dirs = service.source_artifacts.get(PlanService.SOURCE_DIR_ARTIFACT, [])
+        if not svc_dirs:
+            raise ValueError(f"{service.service_name}: no source directory artifact")
+        svc_dir = svc_dirs[0]
+
+        if option in stacks.available_stacks():
+            match = next(
+                (m for m in stacks.detect_stacks(svc_dir) if m.stack == option), None
+            )
+            if match is None:
+                raise ValueError(
+                    f"{service.service_name}: stack {option!r} no longer detected in {svc_dir}"
+                )
+            template = stacks.read_template(option)
+            params = match.params
+        elif os.path.isdir(option):  # custom detector dir
+            params = self._run_custom_detect(option, svc_dir)
+            if params is None:
+                raise ValueError(f"{service.service_name}: custom detect failed in {option}")
+            with open(os.path.join(option, "Dockerfile"), encoding="utf-8") as f:
+                template = f.read()
+        else:
+            raise ValueError(f"{service.service_name}: unknown target option {option!r}")
+
+        name = common.make_dns_label(service.service_name)
+        image_name = service.image or f"{name}:latest"
+        container = Container(
+            image_names=[image_name],
+            new=True,
+            build_type=ContainerBuildType.NEW_DOCKERFILE,
+        )
+        _record_source_dir(container, plan, svc_dir)
+        dockerfile_name = "Dockerfile." + name
+        container.add_file(dockerfile_name, common.render_template(template, params))
+        container.add_file(
+            f"{name}-docker-build.sh",
+            common.render_template(DOCKER_BUILD_SH, {
+                "service_name": name,
+                "dockerfile_name": dockerfile_name,
+                "image_name": image_name,
+                "context": ".",
+            }),
+        )
+        port = params.get("port")
+        if port:
+            container.add_exposed_port(int(port))
+        # extra files next to a custom template ship too (reference parity)
+        if os.path.isdir(option):
+            for extra in os.listdir(option):
+                if extra in (CUSTOM_DETECT_SCRIPT, "Dockerfile"):
+                    continue
+                p = os.path.join(option, extra)
+                if os.path.isfile(p):
+                    with open(p, encoding="utf-8", errors="ignore") as f:
+                        container.add_file(
+                            extra, common.render_template(f.read(), params)
+                        )
+        return container

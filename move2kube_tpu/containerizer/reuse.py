@@ -1,0 +1,23 @@
+"""Reuse containerizer: the image already exists, nothing to build.
+
+Parity: ``internal/containerizer/reusecontainerizer.go:45``.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.containerizer.base import Containerizer
+from move2kube_tpu.types.ir import Container
+from move2kube_tpu.types.plan import ContainerBuildType, PlanService
+
+
+class ReuseContainerizer(Containerizer):
+    def get_build_type(self) -> str:
+        return ContainerBuildType.REUSE
+
+    def get_target_options(self, plan, directory: str) -> list[str]:
+        return []  # offered by translators that know an image exists, not by scan
+
+    def get_container(self, plan, service: PlanService) -> Container:
+        image = service.image or service.service_name + ":latest"
+        return Container(image_names=[image], new=False,
+                         build_type=ContainerBuildType.REUSE)

@@ -1,0 +1,53 @@
+"""Reuse-Dockerfile containerizer: user already has a Dockerfile.
+
+Parity: ``internal/containerizer/reusedockerfilecontainerizer.go:41-100`` —
+emits only the build script wired to the existing Dockerfile's location.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.containerizer.base import Containerizer
+from move2kube_tpu.containerizer.scripts import DOCKER_BUILD_SH
+from move2kube_tpu.types.ir import Container
+from move2kube_tpu.types.plan import ContainerBuildType, PlanService
+from move2kube_tpu.utils import common
+
+
+class ReuseDockerfileContainerizer(Containerizer):
+    def get_build_type(self) -> str:
+        return ContainerBuildType.REUSE_DOCKERFILE
+
+    def get_target_options(self, plan, directory: str) -> list[str]:
+        if os.path.isfile(os.path.join(directory, "Dockerfile")):
+            return [os.path.join(directory, "Dockerfile")]
+        return []
+
+    def get_container(self, plan, service: PlanService) -> Container:
+        dockerfiles = service.source_artifacts.get(PlanService.DOCKERFILE_ARTIFACT, [])
+        if dockerfiles:
+            dockerfile = dockerfiles[0]
+        elif service.containerization_target_options:
+            dockerfile = service.containerization_target_options[0]
+        else:
+            raise ValueError(f"{service.service_name}: no Dockerfile artifact")
+        name = common.make_dns_label(service.service_name)
+        image_name = service.image or f"{name}:latest"
+        container = Container(
+            image_names=[image_name], new=True,
+            build_type=ContainerBuildType.REUSE_DOCKERFILE,
+        )
+        # Build context = the Dockerfile's own directory; the build script is
+        # written under containers/<svc>/ and copysources.sh copies the
+        # source next to it (transformer parity).
+        container.add_file(
+            f"{name}-docker-build.sh",
+            common.render_template(DOCKER_BUILD_SH, {
+                "service_name": name,
+                "dockerfile_name": os.path.basename(dockerfile),
+                "image_name": image_name,
+                "context": ".",
+            }),
+        )
+        return container

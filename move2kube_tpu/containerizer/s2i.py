@@ -1,0 +1,108 @@
+"""Source-to-Image containerizer.
+
+Parity: ``internal/containerizer/s2icontainerizer.go:87-170`` — per-stack
+builder images; emits an ``<svc>-s2i-build.sh`` script. Custom detectors:
+directories containing ``m2kts2idetect.sh`` whose JSON stdout must include
+``builder``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+
+from move2kube_tpu.containerizer import stacks
+from move2kube_tpu.containerizer.base import Containerizer
+from move2kube_tpu.containerizer.scripts import S2I_BUILD_SH
+from move2kube_tpu.types.ir import Container
+from move2kube_tpu.types.plan import ContainerBuildType, PlanService
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("containerizer.s2i")
+
+CUSTOM_DETECT_SCRIPT = "m2kts2idetect.sh"
+
+# stack id -> s2i builder image (parity: internal/assets/s2i/*)
+BUILDERS = {
+    "python": "registry.access.redhat.com/ubi8/python-39",
+    "django": "registry.access.redhat.com/ubi8/python-39",
+    "nodejs": "registry.access.redhat.com/ubi8/nodejs-18",
+    "golang": "registry.access.redhat.com/ubi8/go-toolset",
+    "java-maven": "registry.access.redhat.com/ubi8/openjdk-17",
+    "java-gradle": "registry.access.redhat.com/ubi8/openjdk-17",
+    "php": "registry.access.redhat.com/ubi8/php-80",
+    "ruby": "registry.access.redhat.com/ubi8/ruby-30",
+}
+
+
+class S2IContainerizer(Containerizer):
+    def __init__(self) -> None:
+        self.custom_dirs: list[str] = []
+
+    def init(self, source_dir: str) -> None:
+        self.custom_dirs = [
+            os.path.dirname(p)
+            for p in common.get_files_by_name(source_dir, [CUSTOM_DETECT_SCRIPT])
+        ]
+
+    def get_build_type(self) -> str:
+        return ContainerBuildType.S2I
+
+    def get_target_options(self, plan, directory: str) -> list[str]:
+        options = [
+            BUILDERS[m.stack]
+            for m in stacks.detect_stacks(directory)
+            if m.stack in BUILDERS
+        ]
+        for custom in self.custom_dirs:
+            params = self._run_custom_detect(custom, directory)
+            if params and params.get("builder"):
+                options.append(params["builder"])
+        # dedup preserving order
+        seen: set[str] = set()
+        return [o for o in options if not (o in seen or seen.add(o))]
+
+    def _run_custom_detect(self, custom_dir: str, directory: str) -> dict | None:
+        script = os.path.join(custom_dir, CUSTOM_DETECT_SCRIPT)
+        try:
+            res = subprocess.run(
+                ["/bin/sh", script, directory],
+                capture_output=True, text=True, timeout=60, check=False,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if res.returncode != 0:
+            return None
+        try:
+            params = json.loads(res.stdout or "{}")
+        except json.JSONDecodeError:
+            return None
+        return params if isinstance(params, dict) else None
+
+    def get_container(self, plan, service: PlanService) -> Container:
+        if not service.containerization_target_options:
+            raise ValueError(f"{service.service_name}: no s2i builder selected")
+        builder = service.containerization_target_options[0]
+        name = common.make_dns_label(service.service_name)
+        image_name = service.image or f"{name}:latest"
+        container = Container(
+            image_names=[image_name], new=True, build_type=ContainerBuildType.S2I,
+        )
+        from move2kube_tpu.containerizer.dockerfile import _record_source_dir
+
+        src_dirs = service.source_artifacts.get(PlanService.SOURCE_DIR_ARTIFACT, [])
+        if src_dirs:
+            _record_source_dir(container, plan, src_dirs[0])
+        container.add_file(
+            f"{name}-s2i-build.sh",
+            common.render_template(S2I_BUILD_SH, {
+                "service_name": name,
+                "builder": builder,
+                "image_name": image_name,
+                "context": ".",
+            }),
+        )
+        container.add_exposed_port(common.DEFAULT_SERVICE_PORT)
+        return container

@@ -1,0 +1,23 @@
+"""Build-script templates emitted alongside generated Dockerfiles.
+
+Parity: ``internal/containerizer/scripts/constants.go:23-75``.
+"""
+
+DOCKER_BUILD_SH = """#!/bin/sh
+# Build the container image for service {{ service_name }}.
+# Run from the directory containing this script.
+cd "$(dirname "$0")"
+docker build -f {{ dockerfile_name }} -t {{ image_name }} {{ context }}
+"""
+
+S2I_BUILD_SH = """#!/bin/sh
+# Source-to-Image build for service {{ service_name }}.
+cd "$(dirname "$0")"
+s2i build {{ context }} {{ builder }} {{ image_name }}
+"""
+
+CNB_BUILD_SH = """#!/bin/sh
+# Cloud Native Buildpack build for service {{ service_name }}.
+cd "$(dirname "$0")"
+pack build {{ image_name }} --builder {{ builder }} --path {{ context }}
+"""

@@ -1,0 +1,226 @@
+"""Built-in per-stack detection for the Dockerfile containerizer.
+
+Parity with the reference's embedded asset tree (``internal/assets/
+dockerfiles/*/m2kdfdetect.sh`` + template pairs): each stack has a detect
+function that inspects a directory and returns template parameters (or
+None), plus a Jinja2 Dockerfile template shipped as package data under
+``move2kube_tpu/assets/dockerfiles/<stack>/Dockerfile``. The reference
+shells out to ``/bin/sh m2kdfdetect.sh``; we detect in-process but keep the
+same contract (JSON-able params feeding a template).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable
+
+from move2kube_tpu.utils import common
+
+ASSETS_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "assets")
+
+
+@dataclass
+class StackMatch:
+    stack: str  # template id, e.g. "python"
+    params: dict  # template parameters
+
+
+def _list_files(directory: str) -> list[str]:
+    try:
+        return sorted(os.listdir(directory))
+    except OSError:
+        return []
+
+
+# --- detectors (each: dir -> StackMatch | None) ----------------------------
+
+def detect_django(d: str) -> StackMatch | None:
+    if not os.path.isfile(os.path.join(d, "manage.py")):
+        return None
+    app_name = os.path.basename(d.rstrip(os.sep)) or "app"
+    return StackMatch("django", {
+        "app_name": common.make_dns_label(app_name),
+        "port": common.DEFAULT_SERVICE_PORT,
+        "has_requirements": os.path.isfile(os.path.join(d, "requirements.txt")),
+    })
+
+
+def detect_python(d: str) -> StackMatch | None:
+    files = _list_files(d)
+    py_files = [f for f in files if f.endswith(".py")]
+    if not py_files:
+        return None
+    main_script = ""
+    for candidate in ("main.py", "app.py", "server.py", "run.py", "wsgi.py"):
+        if candidate in files:
+            main_script = candidate
+            break
+    if not main_script:
+        # any .py that looks like an entrypoint
+        for f in py_files:
+            try:
+                with open(os.path.join(d, f), encoding="utf-8", errors="ignore") as fh:
+                    if "__main__" in fh.read():
+                        main_script = f
+                        break
+            except OSError:
+                continue
+    if not main_script:
+        return None
+    port = common.DEFAULT_SERVICE_PORT
+    try:
+        with open(os.path.join(d, main_script), encoding="utf-8", errors="ignore") as fh:
+            m = re.search(r"port\s*=\s*(\d{2,5})", fh.read(), re.IGNORECASE)
+            if m:
+                port = int(m.group(1))
+    except OSError:
+        pass
+    return StackMatch("python", {
+        "main_script": main_script,
+        "app_name": common.make_dns_label(os.path.basename(d.rstrip(os.sep)) or "app"),
+        "port": port,
+        "has_requirements": "requirements.txt" in files,
+    })
+
+
+def detect_nodejs(d: str) -> StackMatch | None:
+    pkg_path = os.path.join(d, "package.json")
+    if not os.path.isfile(pkg_path):
+        return None
+    node_version = "20"
+    port = common.DEFAULT_SERVICE_PORT
+    try:
+        pkg = json.load(open(pkg_path, encoding="utf-8"))
+        engines = pkg.get("engines", {})
+        m = re.search(r"(\d+)", str(engines.get("node", "")))
+        if m:
+            node_version = m.group(1)
+    except (OSError, json.JSONDecodeError):
+        pkg = {}
+    return StackMatch("nodejs", {
+        "node_version": node_version,
+        "port": port,
+        "has_start": bool(pkg.get("scripts", {}).get("start")),
+        "main": pkg.get("main", "index.js") or "index.js",
+    })
+
+
+def detect_golang(d: str) -> StackMatch | None:
+    gomod = os.path.join(d, "go.mod")
+    if not os.path.isfile(gomod):
+        return None
+    module = "app"
+    try:
+        for line in open(gomod, encoding="utf-8"):
+            if line.startswith("module"):
+                module = line.split()[-1].rsplit("/", 1)[-1]
+                break
+    except OSError:
+        pass
+    return StackMatch("golang", {
+        "app_name": common.make_dns_label(module),
+        "port": common.DEFAULT_SERVICE_PORT,
+    })
+
+
+def detect_java_maven(d: str) -> StackMatch | None:
+    pom = os.path.join(d, "pom.xml")
+    if not os.path.isfile(pom):
+        return None
+    artifact_id, packaging = "app", "jar"
+    try:
+        text = open(pom, encoding="utf-8", errors="ignore").read()
+        m = re.search(r"<artifactId>([^<]+)</artifactId>", text)
+        if m:
+            artifact_id = m.group(1)
+        m = re.search(r"<packaging>([^<]+)</packaging>", text)
+        if m:
+            packaging = m.group(1)
+    except OSError:
+        pass
+    return StackMatch("java-maven", {
+        "artifact_id": artifact_id,
+        "packaging": packaging,
+        "port": common.DEFAULT_SERVICE_PORT,
+    })
+
+
+def detect_java_gradle(d: str) -> StackMatch | None:
+    if not (os.path.isfile(os.path.join(d, "build.gradle"))
+            or os.path.isfile(os.path.join(d, "build.gradle.kts"))):
+        return None
+    return StackMatch("java-gradle", {
+        "app_name": common.make_dns_label(os.path.basename(d.rstrip(os.sep)) or "app"),
+        "port": common.DEFAULT_SERVICE_PORT,
+    })
+
+
+def detect_php(d: str) -> StackMatch | None:
+    files = _list_files(d)
+    if "composer.json" not in files and not any(f.endswith(".php") for f in files):
+        return None
+    return StackMatch("php", {"port": common.DEFAULT_SERVICE_PORT})
+
+
+def detect_ruby(d: str) -> StackMatch | None:
+    files = _list_files(d)
+    if "Gemfile" not in files:
+        return None
+    rackup = "config.ru" in files
+    main_script = ""
+    if not rackup:
+        rb = [f for f in files if f.endswith(".rb")]
+        main_script = "app.rb" if "app.rb" in files else (rb[0] if rb else "")
+        if not main_script:
+            return None
+    return StackMatch("ruby", {
+        "rackup": rackup,
+        "main_script": main_script,
+        "port": common.DEFAULT_SERVICE_PORT,
+    })
+
+
+# Order matters: specific before generic (django before python).
+DETECTORS: list[Callable[[str], StackMatch | None]] = [
+    detect_django,
+    detect_golang,
+    detect_nodejs,
+    detect_java_maven,
+    detect_java_gradle,
+    detect_ruby,
+    detect_php,
+    detect_python,
+]
+
+
+def detect_stacks(directory: str) -> list[StackMatch]:
+    """All stacks matching a directory, most specific first."""
+    out: list[StackMatch] = []
+    for det in DETECTORS:
+        m = det(directory)
+        if m is not None:
+            out.append(m)
+    return out
+
+
+def template_path(stack: str) -> str:
+    return os.path.join(ASSETS_DIR, "dockerfiles", stack, "Dockerfile")
+
+
+def read_template(stack: str) -> str:
+    with open(template_path(stack), encoding="utf-8") as f:
+        return f.read()
+
+
+def available_stacks() -> list[str]:
+    root = os.path.join(ASSETS_DIR, "dockerfiles")
+    try:
+        return sorted(
+            d for d in os.listdir(root)
+            if os.path.isfile(os.path.join(root, d, "Dockerfile"))
+        )
+    except OSError:
+        return []

@@ -1,0 +1,2 @@
+from move2kube_tpu.engine.planner import create_plan, curate_plan  # noqa: F401
+from move2kube_tpu.engine.translator import translate  # noqa: F401

@@ -1,0 +1,33 @@
+"""Collect driver.
+
+Parity: ``internal/move2kube/collector.go:29-63`` — runs all collectors
+with annotation filtering into ``m2kt_collect/``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("collector")
+
+
+def get_collectors() -> list:
+    from move2kube_tpu.collector.cluster import ClusterCollector
+    from move2kube_tpu.collector.images import ImagesCollector
+
+    return [ClusterCollector(), ImagesCollector()]
+
+
+def collect(source_dir: str, out_dir: str, annotations: list[str] | None = None) -> None:
+    out_dir = os.path.join(os.path.abspath(out_dir), common.COLLECT_OUTPUT_DIR)
+    os.makedirs(out_dir, exist_ok=True)
+    for collector in get_collectors():
+        if annotations and not set(annotations) & set(collector.get_annotations()):
+            continue
+        try:
+            collector.collect(source_dir, out_dir)
+        except Exception as e:  # noqa: BLE001 - collectors are environment-gated
+            log.warning("collector %s failed: %s", type(collector).__name__, e)

@@ -1,0 +1,112 @@
+"""Plan creation and curation.
+
+Parity: ``internal/move2kube/planner.go`` — ``create_plan`` iterates source
+loaders' service options + metadata loaders' update_plan (:30-62);
+``curate_plan`` interactively narrows services, build types, target options
+and output artifact type through the QA engine (:65-239).
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu import containerizer, qa
+from move2kube_tpu.metadata import get_loaders
+from move2kube_tpu.metadata import clusters as cluster_profiles
+from move2kube_tpu.source import get_source_loaders
+from move2kube_tpu.types import plan as plantypes
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("planner")
+
+
+def create_plan(root_dir: str, name: str = "") -> plantypes.Plan:
+    root_dir = os.path.abspath(root_dir)
+    plan = plantypes.new_plan(name or os.path.basename(root_dir.rstrip(os.sep))
+                              or common.DEFAULT_PROJECT_NAME)
+    plan.root_dir = root_dir
+    containerizer.init_containerizers(root_dir)
+    for translator in get_source_loaders():
+        try:
+            services = translator.get_service_options(plan)
+        except Exception as e:  # noqa: BLE001 - plugin tolerance (planner.go:40-45)
+            log.warning("translator %s failed during planning: %s",
+                        type(translator).__name__, e)
+            continue
+        for svc in services:
+            plan.add_service(svc)
+    for loader in get_loaders():
+        try:
+            loader.update_plan(plan)
+        except Exception as e:  # noqa: BLE001
+            log.warning("metadata loader %s failed: %s", type(loader).__name__, e)
+    return plan
+
+
+def curate_plan(plan: plantypes.Plan) -> plantypes.Plan:
+    """Interactive narrowing (planner.go:65-239): pick services, one
+    containerization option per service, artifact type and target cluster."""
+    if not plan.services:
+        log.warning("no services found in the plan")
+    service_names = sorted(plan.services.keys())
+    chosen_names = qa.fetch_multi_select(
+        "m2kt.services.select",
+        "Select the services to translate",
+        [], service_names, service_names,
+    )
+    new_services: dict[str, list[plantypes.PlanService]] = {}
+    for name in chosen_names:
+        options = plan.services[name]
+        if len(options) > 1:
+            descs = [
+                f"{o.container_build_type}"
+                + (f" ({o.containerization_target_options[0]})"
+                   if o.containerization_target_options else "")
+                for o in options
+            ]
+            picked = qa.fetch_select(
+                f"m2kt.services.{name}.build",
+                f"Select the containerization technique for service [{name}]",
+                [], descs[0], descs,
+            )
+            option = options[descs.index(picked)]
+        else:
+            option = options[0]
+        if len(option.containerization_target_options) > 1:
+            target = qa.fetch_select(
+                f"m2kt.services.{name}.target",
+                f"Select the containerization target for service [{name}]",
+                [], option.containerization_target_options[0],
+                option.containerization_target_options,
+            )
+            option.containerization_target_options = [target]
+        new_services[name] = [option]
+    plan.services = new_services
+
+    artifact = qa.fetch_select(
+        "m2kt.target.artifacttype",
+        "Select the output artifact type",
+        ["Yamls: plain kubernetes yamls | Helm: a helm chart | Knative: knative serving yamls"],
+        plan.kubernetes.effective_artifact_type(),
+        [plantypes.TargetArtifactType.YAMLS, plantypes.TargetArtifactType.HELM,
+         plantypes.TargetArtifactType.KNATIVE],
+    )
+    plan.kubernetes.artifact_type = artifact
+
+    cluster_options = sorted(cluster_profiles.builtin_clusters().keys())
+    collected = plan.target_info_artifacts.get(plantypes.Plan.TARGET_CLUSTERS_ARTIFACT, [])
+    cluster_options += collected
+    default_cluster = (plan.kubernetes.target_cluster.type
+                       or plan.kubernetes.target_cluster.path
+                       or cluster_profiles.DEFAULT_CLUSTER)
+    chosen_cluster = qa.fetch_select(
+        "m2kt.target.cluster",
+        "Select the target cluster type",
+        [], default_cluster, cluster_options,
+    )
+    if chosen_cluster in cluster_profiles.builtin_clusters():
+        plan.kubernetes.target_cluster = plantypes.TargetCluster(type=chosen_cluster)
+    else:
+        plan.kubernetes.target_cluster = plantypes.TargetCluster(path=chosen_cluster)
+    return plan

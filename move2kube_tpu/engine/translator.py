@@ -1,0 +1,69 @@
+"""The 8-stage translate pipeline.
+
+Parity: ``internal/move2kube/translator.go:34-110`` —
+source.Translate -> metadata.LoadToIR -> optimize -> ComposeTransformer ->
+customize -> [Helm] parameterize -> [new containers] CICD(Tekton) ->
+K8s|Knative transform + write.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu import containerizer
+from move2kube_tpu.metadata import get_loaders
+from move2kube_tpu.passes import customize, optimize, parameterize
+from move2kube_tpu.source import translate_sources
+from move2kube_tpu.transformer.base import get_transformer
+from move2kube_tpu.transformer.compose import ComposeTransformer
+from move2kube_tpu.types import plan as plantypes
+from move2kube_tpu.types.ir import IR
+from move2kube_tpu.types.plan import TargetArtifactType
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("translator")
+
+
+def translate(plan: plantypes.Plan, out_dir: str) -> IR:
+    out_dir = os.path.abspath(out_dir)
+    os.makedirs(out_dir, exist_ok=True)
+    containerizer.init_containerizers(plan.root_dir)
+
+    log.info("translating %d services", len(plan.services))
+    ir = translate_sources(plan)
+
+    for loader in get_loaders():
+        try:
+            loader.load_to_ir(plan, ir)
+        except Exception as e:  # noqa: BLE001
+            log.warning("metadata loader %s failed: %s", type(loader).__name__, e)
+
+    ir = optimize(ir)
+
+    compose_tf = ComposeTransformer()
+    try:
+        compose_tf.transform(ir)
+        compose_tf.write_objects(out_dir, ir)
+    except Exception as e:  # noqa: BLE001
+        log.warning("compose transformer failed: %s", e)
+
+    ir = customize(ir)
+
+    if ir.kubernetes.effective_artifact_type() == TargetArtifactType.HELM:
+        ir = parameterize(ir)
+
+    if any(c.new for c in ir.containers):
+        try:
+            from move2kube_tpu.transformer.cicd import CICDTransformer
+
+            cicd = CICDTransformer()
+            cicd.transform(ir)
+            cicd.write_objects(out_dir, ir)
+        except Exception as e:  # noqa: BLE001
+            log.warning("cicd transformer failed: %s", e)
+
+    transformer = get_transformer(ir)
+    transformer.transform(ir)
+    transformer.write_objects(out_dir, ir)
+    log.info("translation written to %s", out_dir)
+    return ir

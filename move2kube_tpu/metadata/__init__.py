@@ -1,0 +1,1 @@
+from move2kube_tpu.metadata.base import Loader, get_loaders  # noqa: F401

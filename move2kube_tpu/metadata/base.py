@@ -1,0 +1,123 @@
+"""Metadata loaders: feed collected/auxiliary data into plan and IR.
+
+Parity: ``internal/metadata/metadata.go:25-34`` — loaders update the plan
+at plan time and load data into the IR at translate time. Registry:
+ClusterMDLoader, K8sFilesLoader, QACacheLoader.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.metadata import clusters
+from move2kube_tpu.types import collection as collecttypes
+from move2kube_tpu.types.ir import IR
+from move2kube_tpu.types.plan import Plan, PlanService, TargetCluster, TranslationType
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("metadata")
+
+
+class Loader:
+    def update_plan(self, plan: Plan) -> None:
+        pass
+
+    def load_to_ir(self, plan: Plan, ir: IR) -> None:
+        pass
+
+
+class ClusterMDLoader(Loader):
+    """Parity: internal/metadata/clustermdloader.go:38-140."""
+
+    def update_plan(self, plan: Plan) -> None:
+        for path in common.get_files_by_ext(plan.root_dir, [".yaml", ".yml"]):
+            try:
+                doc = common.read_m2kt_yaml(path, collecttypes.CLUSTER_METADATA_KIND)
+            except Exception:  # noqa: BLE001
+                continue
+            cm = collecttypes.ClusterMetadata.from_dict(doc)
+            plan.target_info_artifacts.setdefault(
+                Plan.TARGET_CLUSTERS_ARTIFACT, []
+            ).append(path)
+            log.info("found collected cluster metadata %s (%s)", cm.name, path)
+        if not plan.kubernetes.target_cluster.type and not plan.kubernetes.target_cluster.path:
+            # default: TPU cluster when the plan has GPU training services
+            has_tpu = any(
+                s.translation_type == TranslationType.GPU2TPU
+                for svcs in plan.services.values() for s in svcs
+            )
+            plan.kubernetes.target_cluster = TargetCluster(
+                type=clusters.DEFAULT_TPU_CLUSTER if has_tpu else clusters.DEFAULT_CLUSTER
+            )
+
+    def load_to_ir(self, plan: Plan, ir: IR) -> None:
+        tc = plan.kubernetes.target_cluster
+        if tc.path:
+            try:
+                cm = collecttypes.read_cluster_metadata(tc.path)
+                ir.target_cluster_spec = cm.spec
+                return
+            except Exception as e:  # noqa: BLE001
+                log.warning("cannot read cluster metadata %s: %s", tc.path, e)
+        name = tc.type or clusters.DEFAULT_CLUSTER
+        cm = clusters.get_cluster(name)
+        if cm is None:
+            log.warning("unknown cluster profile %r; using %s", name, clusters.DEFAULT_CLUSTER)
+            cm = clusters.get_cluster(clusters.DEFAULT_CLUSTER)
+        ir.target_cluster_spec = cm.spec
+
+
+class K8sFilesLoader(Loader):
+    """Parity: internal/metadata/k8sfiles.go:35-95."""
+
+    def update_plan(self, plan: Plan) -> None:
+        for path in common.get_files_by_ext(plan.root_dir, [".yaml", ".yml"]):
+            try:
+                import yaml
+
+                with open(path, encoding="utf-8") as f:
+                    docs = list(yaml.safe_load_all(f))
+            except Exception:  # noqa: BLE001
+                continue
+            k8s_docs = [
+                d for d in docs
+                if isinstance(d, dict) and d.get("kind") and d.get("apiVersion")
+                and not str(d.get("apiVersion", "")).startswith("move2kube-tpu.io")
+                and not isinstance(d.get("services"), dict)  # not a compose file
+            ]
+            if k8s_docs and path not in plan.k8s_files:
+                plan.k8s_files.append(path)
+        if plan.k8s_files:
+            # register a kube2kube service so translate picks the files up
+            svc = PlanService(
+                service_name=common.make_dns_label(plan.name + "-k8s"),
+                translation_type=TranslationType.KUBE2KUBE,
+                container_build_type="Reuse",
+            )
+            for f in plan.k8s_files:
+                svc.add_source_artifact(PlanService.K8S_ARTIFACT, f)
+            plan.add_service(svc)
+
+    def load_to_ir(self, plan: Plan, ir: IR) -> None:
+        pass  # kube2kube translator loads the files
+
+
+class QACacheLoader(Loader):
+    """Parity: internal/metadata/qacaches.go:33-60."""
+
+    def update_plan(self, plan: Plan) -> None:
+        for path in common.get_files_by_name(plan.root_dir, [common.QA_CACHE_FILE]):
+            if path not in plan.qa_caches:
+                plan.qa_caches.append(path)
+
+    def load_to_ir(self, plan: Plan, ir: IR) -> None:
+        from move2kube_tpu.qa import add_cache_engine
+
+        for path in plan.qa_caches:
+            if os.path.exists(path):
+                add_cache_engine(path)
+
+
+def get_loaders() -> list[Loader]:
+    return [ClusterMDLoader(), K8sFilesLoader(), QACacheLoader()]

@@ -1,0 +1,105 @@
+"""Built-in target-cluster profiles.
+
+Parity: ``internal/metadata/clusters/constants.go`` — kind -> preferred
+group/version tables for AWS-EKS, Azure-AKS, GCP-GKE, IBM-IKS,
+IBM-Openshift, Kubernetes, Openshift.
+
+Net-new: the **GCP-GKE-TPU** profile adds JobSet (jobset.x-k8s.io) so TPU
+training services emit multi-host JobSets; it is the default target when a
+plan contains Gpu2Tpu services.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.types.collection import ClusterMetadata, ClusterMetadataSpec
+
+_COMMON_CORE: dict[str, list[str]] = {
+    "Pod": ["v1"],
+    "Service": ["v1"],
+    "ConfigMap": ["v1"],
+    "Secret": ["v1"],
+    "PersistentVolumeClaim": ["v1"],
+    "ServiceAccount": ["v1"],
+    "ReplicationController": ["v1"],
+    "Role": ["rbac.authorization.k8s.io/v1"],
+    "RoleBinding": ["rbac.authorization.k8s.io/v1"],
+    "Deployment": ["apps/v1"],
+    "DaemonSet": ["apps/v1"],
+    "StatefulSet": ["apps/v1"],
+    "Job": ["batch/v1"],
+    "CronJob": ["batch/v1"],
+    "Ingress": ["networking.k8s.io/v1"],
+    "NetworkPolicy": ["networking.k8s.io/v1"],
+    "HorizontalPodAutoscaler": ["autoscaling/v2"],
+}
+
+_OPENSHIFT_EXTRAS: dict[str, list[str]] = {
+    "DeploymentConfig": ["apps.openshift.io/v1"],
+    "Route": ["route.openshift.io/v1"],
+    "ImageStream": ["image.openshift.io/v1"],
+    "BuildConfig": ["build.openshift.io/v1"],
+}
+
+_TEKTON: dict[str, list[str]] = {
+    "Pipeline": ["tekton.dev/v1beta1"],
+    "PipelineRun": ["tekton.dev/v1beta1"],
+    "Task": ["tekton.dev/v1beta1"],
+    "EventListener": ["triggers.tekton.dev/v1alpha1"],
+    "TriggerBinding": ["triggers.tekton.dev/v1alpha1"],
+    "TriggerTemplate": ["triggers.tekton.dev/v1alpha1"],
+}
+
+_KNATIVE: dict[str, list[str]] = {
+    "Service": ["serving.knative.dev/v1", "v1"],
+}
+
+
+def _profile(name: str, extra: dict[str, list[str]] | None = None,
+             drop: list[str] | None = None,
+             storage_classes: list[str] | None = None,
+             tpu_accelerators: list[str] | None = None) -> ClusterMetadata:
+    kinds = {k: list(v) for k, v in _COMMON_CORE.items()}
+    kinds.update({k: list(v) for k, v in (_TEKTON | (extra or {})).items()})
+    for k in drop or []:
+        kinds.pop(k, None)
+    return ClusterMetadata(
+        name=name,
+        spec=ClusterMetadataSpec(
+            api_kind_version_map=kinds,
+            storage_classes=storage_classes or ["default"],
+            tpu_accelerators=tpu_accelerators or [],
+        ),
+    )
+
+
+def builtin_clusters() -> dict[str, ClusterMetadata]:
+    profiles = {
+        "Kubernetes": _profile("Kubernetes"),
+        "AWS-EKS": _profile("AWS-EKS", storage_classes=["gp2", "default"]),
+        "Azure-AKS": _profile("Azure-AKS", storage_classes=["managed-premium", "default"]),
+        "GCP-GKE": _profile("GCP-GKE", storage_classes=["standard-rwo", "standard"]),
+        "IBM-IKS": _profile("IBM-IKS", storage_classes=["ibmc-file-gold", "default"]),
+        "IBM-Openshift": _profile("IBM-Openshift", extra=_OPENSHIFT_EXTRAS,
+                                  storage_classes=["ibmc-file-gold", "default"]),
+        "Openshift": _profile("Openshift", extra=_OPENSHIFT_EXTRAS),
+        "GCP-GKE-TPU": _profile(
+            "GCP-GKE-TPU",
+            extra={"JobSet": ["jobset.x-k8s.io/v1alpha2"]},
+            storage_classes=["standard-rwo", "standard"],
+            tpu_accelerators=[
+                "tpu-v4-podslice",
+                "tpu-v5-lite-podslice",
+                "tpu-v5p-slice",
+                "tpu-v6e-slice",
+            ],
+        ),
+    }
+    return profiles
+
+
+DEFAULT_CLUSTER = "Kubernetes"
+DEFAULT_TPU_CLUSTER = "GCP-GKE-TPU"
+
+
+def get_cluster(name: str) -> ClusterMetadata | None:
+    return builtin_clusters().get(name)

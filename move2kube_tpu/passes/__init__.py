@@ -1,0 +1,3 @@
+from move2kube_tpu.passes.optimize import optimize  # noqa: F401
+from move2kube_tpu.passes.customize import customize  # noqa: F401
+from move2kube_tpu.passes.parameterize import parameterize  # noqa: F401

@@ -1,0 +1,130 @@
+"""Customizer passes: registry, storage and ingress QA.
+
+Parity: ``internal/customizer/`` — registry ``[registry, storage,
+ingress]`` (customizer.go:30-49).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from move2kube_tpu import qa
+from move2kube_tpu.types.ir import IR, Storage, StorageKind
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("customize")
+
+
+def registry_customizer(ir: IR) -> IR:
+    """QA: registry + namespace to push built images to; pull secret if the
+    registry needs auth (registrycustomizer.go:45)."""
+    if not any(c.new for c in ir.containers):
+        return ir
+    registry = qa.fetch_select(
+        "m2kt.target.registry.url",
+        "Select the registry to push images to",
+        ["Built images will be tagged and pushed here"],
+        ir.kubernetes.registry_url or common.DEFAULT_REGISTRY_URL,
+        [ir.kubernetes.registry_url or common.DEFAULT_REGISTRY_URL,
+         "quay.io", "gcr.io", "docker.io", "Other"],
+    )
+    if registry == "Other":
+        registry = qa.fetch_input(
+            "m2kt.target.registry.url.other", "Enter the registry URL", [],
+            common.DEFAULT_REGISTRY_URL,
+        )
+    namespace = qa.fetch_input(
+        "m2kt.target.registry.namespace",
+        "Enter the registry namespace",
+        [], ir.kubernetes.registry_namespace or ir.name,
+    )
+    ir.kubernetes.registry_url = registry
+    ir.kubernetes.registry_namespace = namespace
+    ir.values.registry_url = registry
+    ir.values.registry_namespace = namespace
+    needs_auth = qa.fetch_bool(
+        "m2kt.target.registry.auth",
+        f"Does the registry [{registry}] need authentication to pull?",
+        [], False,
+    )
+    if needs_auth:
+        secret_name = common.make_dns_label(registry) + "-imagepullsecret"
+        docker_config = {"auths": {registry: {"auth": ""}}}
+        ir.add_storage(Storage(
+            name=secret_name,
+            kind=StorageKind.PULL_SECRET,
+            content={".dockerconfigjson": json.dumps(docker_config).encode()},
+        ))
+        for svc in ir.services.values():
+            if secret_name not in svc.image_pull_secrets:
+                svc.image_pull_secrets.append(secret_name)
+    # rewrite image names to registry/namespace/name:tag for new images
+    for container in ir.containers:
+        if not container.new or not container.image_names:
+            continue
+        image = container.image_names[0]
+        if "/" not in image:
+            full = f"{registry}/{namespace}/{image}"
+            container.image_names.insert(0, full)
+            for svc in ir.services.values():
+                for c in svc.containers:
+                    if c.get("image") == image:
+                        c["image"] = full
+    return ir
+
+
+def storage_customizer(ir: IR) -> IR:
+    """QA: storage class selection for PVCs (storagecustomizer.go:42-210)."""
+    pvcs = [s for s in ir.storages if s.kind == StorageKind.PVC]
+    if not pvcs:
+        return ir
+    classes = ir.target_cluster_spec.storage_classes or [common.DEFAULT_STORAGE_CLASS]
+    chosen = qa.fetch_select(
+        "m2kt.storage.class",
+        "Select the storage class for persistent volume claims",
+        [f"PVCs: {', '.join(s.name for s in pvcs)}"],
+        classes[0], classes,
+    )
+    for pvc in pvcs:
+        pvc.pvc_spec.setdefault("storageClassName", chosen)
+        pvc.pvc_spec["storageClassName"] = chosen
+    ir.values.storage_class = chosen
+    return ir
+
+
+def ingress_customizer(ir: IR) -> IR:
+    """QA: ingress host + optional TLS secret (ingresscustomizer.go:33-60)."""
+    exposed = [
+        s for s in ir.services.values()
+        if s.has_valid_annotation(common.EXPOSE_SERVICE_ANNOTATION)
+    ]
+    if not exposed:
+        return ir
+    host = qa.fetch_input(
+        "m2kt.target.ingress.host",
+        "Enter the ingress host domain",
+        ["Services will be exposed under this domain"],
+        ir.name + ".com",
+    )
+    tls_secret = qa.fetch_input(
+        "m2kt.target.ingress.tls",
+        "Enter the TLS secret name (empty for none)",
+        [], "",
+    )
+    ir.values.ingress_host = host
+    ir.ingress_tls_secret_name = tls_secret
+    return ir
+
+
+CUSTOMIZERS = [registry_customizer, storage_customizer, ingress_customizer]
+
+
+def customize(ir: IR) -> IR:
+    for c in CUSTOMIZERS:
+        try:
+            ir = c(ir)
+        except Exception as e:  # noqa: BLE001
+            log.warning("customizer %s failed: %s", c.__name__, e)
+    return ir

@@ -1,0 +1,137 @@
+"""Optimizer passes over the IR.
+
+Parity: ``internal/optimizer/`` — sequential, failure-tolerant registry
+``[normalizeCharacter, ingress, replica, imagePullPolicy, portMerge]``
+(optimizer.go:31-52). The ingress and port-merge passes are interactive
+via the QA engine.
+"""
+
+from __future__ import annotations
+
+import re
+
+from move2kube_tpu import qa
+from move2kube_tpu.types.ir import IR
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("optimize")
+
+
+def normalize_character_optimizer(ir: IR) -> IR:
+    """Strip quotes/control chars from env values (normalizecharactersoptimizer.go:30)."""
+    for svc in ir.services.values():
+        for container in svc.containers:
+            for env in container.get("env", []) or []:
+                val = str(env.get("value", ""))
+                val = val.strip().strip("'\"")
+                env["value"] = re.sub(r"[\x00-\x08\x0b-\x1f]", "", val)
+    return ir
+
+
+def ingress_optimizer(ir: IR) -> IR:
+    """QA: which services to expose + per-service URL path
+    (ingressoptimizer.go:35-107)."""
+    candidates = [
+        name for name, svc in ir.services.items()
+        if svc.port_forwardings and not svc.job
+    ]
+    if not candidates:
+        return ir
+    chosen = qa.fetch_multi_select(
+        "m2kt.services.expose",
+        "Select the services to expose externally",
+        ["The chosen services will be reachable through an ingress"],
+        candidates,
+        candidates,
+    )
+    for name in chosen:
+        svc = ir.services[name]
+        rel_path = qa.fetch_input(
+            f"m2kt.services.{name}.urlpath",
+            f"URL path for service [{name}]",
+            [],
+            svc.service_rel_path or "/" + name,
+        )
+        if rel_path and not rel_path.startswith("/"):
+            rel_path = "/" + rel_path
+        svc.service_rel_path = rel_path
+        svc.annotations[common.EXPOSE_SERVICE_ANNOTATION] = "true"
+    return ir
+
+
+def replica_optimizer(ir: IR) -> IR:
+    """Minimum 2 replicas for serving workloads (replicaoptimizer.go:24-40)."""
+    for svc in ir.services.values():
+        if not svc.job and not svc.daemon and svc.replicas < 2:
+            svc.replicas = 2
+    return ir
+
+
+def image_pull_policy_optimizer(ir: IR) -> IR:
+    """imagePullPolicy: Always on every container (imagepullpolicyoptimizer.go:28)."""
+    for svc in ir.services.values():
+        for container in svc.containers:
+            container["imagePullPolicy"] = "Always"
+    return ir
+
+
+def port_merge_optimizer(ir: IR) -> IR:
+    """Merge container/exposed-port info; ask when ambiguous
+    (portmergeoptimizer.go:36-140)."""
+    for svc in ir.services.values():
+        if svc.job:
+            continue
+        container_ports: list[int] = []
+        for c in svc.containers:
+            for p in c.get("ports", []) or []:
+                if p.get("containerPort"):
+                    container_ports.append(int(p["containerPort"]))
+        image_ports: list[int] = []
+        for img_container in ir.containers:
+            if any(c.get("image") in img_container.image_names for c in svc.containers):
+                image_ports.extend(img_container.exposed_ports)
+        known = [pf.container_port for pf in svc.port_forwardings]
+        all_ports = [p for p in dict.fromkeys(container_ports + image_ports) if p]
+        missing = [p for p in all_ports if p not in known]
+        if not svc.port_forwardings and not all_ports:
+            port_str = qa.fetch_select(
+                f"m2kt.services.{svc.name}.port",
+                f"Select port to expose for service [{svc.name}]",
+                [], str(common.DEFAULT_SERVICE_PORT),
+                [str(common.DEFAULT_SERVICE_PORT)],
+            )
+            svc.add_port_forwarding(int(port_str), int(port_str))
+            if svc.containers:
+                svc.containers[0].setdefault("ports", []).append(
+                    {"containerPort": int(port_str)}
+                )
+        else:
+            for p in missing:
+                svc.add_port_forwarding(p, p)
+        # ensure container port lists include everything forwarded
+        for pf in svc.port_forwardings:
+            for c in svc.containers:
+                ports = c.setdefault("ports", [])
+                if all(x.get("containerPort") != pf.container_port for x in ports):
+                    ports.append({"containerPort": pf.container_port})
+    return ir
+
+
+OPTIMIZERS = [
+    normalize_character_optimizer,
+    ingress_optimizer,
+    replica_optimizer,
+    image_pull_policy_optimizer,
+    port_merge_optimizer,
+]
+
+
+def optimize(ir: IR) -> IR:
+    """Run all optimizers, tolerating per-pass failure (optimizer.go:37-52)."""
+    for opt in OPTIMIZERS:
+        try:
+            ir = opt(ir)
+        except Exception as e:  # noqa: BLE001
+            log.warning("optimizer %s failed: %s", opt.__name__, e)
+    return ir

@@ -1,0 +1,65 @@
+"""Helm parameterizers: rewrite IR values into ``{{ .Values.* }}`` refs.
+
+Parity: ``internal/parameterizer/`` — registry ``[imageName, ingress,
+storageClass]`` (parameterizer.go:31-50); populates ``ir.values`` for
+values.yaml emission. Only runs for Helm artifact output.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.types.ir import IR, StorageKind
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("parameterize")
+
+
+def image_name_parameterizer(ir: IR) -> IR:
+    """imagenameparameterizer.go:31 — per-service per-container image tags."""
+    for svc_name, svc in ir.services.items():
+        for container in svc.containers:
+            image = container.get("image", "")
+            if not image:
+                continue
+            built = any(
+                image in c.image_names for c in ir.containers if c.new
+            )
+            if not built:
+                continue
+            tail = image.split("/")[-1]
+            ir.values.set_image(svc_name, container["name"], tail)
+            # `index` syntax: DNS-1123 names contain '-', which dotted Go
+            # template paths cannot parse
+            container["image"] = (
+                "{{ .Values.registryurl }}/{{ .Values.registrynamespace }}/"
+                f'{{{{ index .Values.services "{svc_name}" "containers" "{container["name"]}" }}}}'
+            )
+    return ir
+
+
+def ingress_parameterizer(ir: IR) -> IR:
+    """ingressparameterizer.go:27 — host comes from values."""
+    if ir.values.ingress_host:
+        ir.values.global_variables.setdefault("ingresshost", ir.values.ingress_host)
+    return ir
+
+
+def storage_class_parameterizer(ir: IR) -> IR:
+    """storageclassparameterizer.go:29."""
+    for storage in ir.storages:
+        if storage.kind == StorageKind.PVC and storage.pvc_spec.get("storageClassName"):
+            ir.values.storage_class = storage.pvc_spec["storageClassName"]
+            storage.pvc_spec["storageClassName"] = "{{ .Values.storageclass }}"
+    return ir
+
+
+PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
+                  storage_class_parameterizer]
+
+
+def parameterize(ir: IR) -> IR:
+    for p in PARAMETERIZERS:
+        try:
+            ir = p(ir)
+        except Exception as e:  # noqa: BLE001
+            log.warning("parameterizer %s failed: %s", p.__name__, e)
+    return ir

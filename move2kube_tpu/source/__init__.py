@@ -1,0 +1,5 @@
+from move2kube_tpu.source.base import (  # noqa: F401
+    Translator,
+    get_source_loaders,
+    translate_sources,
+)

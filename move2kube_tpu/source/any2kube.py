@@ -1,0 +1,112 @@
+"""Any2Kube: the fallback directory-walker translator.
+
+Parity: ``internal/source/any2kube.go:43-141`` — walks every directory not
+claimed by other services (honoring ignore files), asks the containerizer
+registry for options, and emits one plan service per (dir x build type).
+At translate time it asks the chosen containerizer for the Container and
+builds the IR service with its exposed ports.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu import containerizer
+from move2kube_tpu.source.base import Translator
+from move2kube_tpu.source.ignores import IgnoreRules
+from move2kube_tpu.types import ir as irtypes
+from move2kube_tpu.types.plan import (
+    ContainerBuildType,
+    Plan,
+    PlanService,
+    SourceType,
+    TranslationType,
+)
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("source.any2kube")
+
+_SKIP_DIR_NAMES = {".git", "node_modules", "__pycache__", ".venv", "venv", "vendor"}
+
+
+def claimed_directories(plan: Plan) -> list[str]:
+    """Directories already owned by existing plan services (any2kube.go:58)."""
+    dirs = []
+    for svcs in plan.services.values():
+        for svc in svcs:
+            for paths in svc.source_artifacts.values():
+                for p in paths:
+                    if os.path.isdir(p):
+                        dirs.append(os.path.abspath(p))
+                    elif os.path.isfile(p):
+                        dirs.append(os.path.dirname(os.path.abspath(p)))
+    return dirs
+
+
+class Any2KubeTranslator(Translator):
+    def get_translation_type(self) -> str:
+        return TranslationType.ANY2KUBE
+
+    def get_service_options(self, plan: Plan) -> list[PlanService]:
+        root = plan.root_dir
+        ignores = IgnoreRules(root)
+        claimed = claimed_directories(plan)
+        services: list[PlanService] = []
+        taken_names = set(plan.services.keys())
+
+        for dirpath, dirnames, _filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in _SKIP_DIR_NAMES and not ignores.is_ignored(os.path.join(dirpath, d))
+            )
+            absdir = os.path.abspath(dirpath)
+            if any(common.is_parent(absdir, c) or common.is_parent(c, absdir) for c in claimed):
+                continue
+            options = containerizer.get_containerization_options(plan, absdir)
+            if not options:
+                continue
+            base = common.make_dns_label(
+                os.path.basename(absdir.rstrip(os.sep)) or plan.name
+            )
+            name = common.unique_name(base, taken_names)
+            taken_names.add(name)
+            for build_type, target_options in options.items():
+                svc = PlanService(
+                    service_name=name,
+                    translation_type=TranslationType.ANY2KUBE,
+                    container_build_type=build_type,
+                    source_types=[SourceType.DIRECTORY],
+                    containerization_target_options=list(target_options),
+                )
+                svc.add_source_artifact(PlanService.SOURCE_DIR_ARTIFACT, absdir)
+                svc.service_rel_path = "/" + name
+                services.append(svc)
+            # a containerizable dir claims its subtree (any2kube.go:98)
+            claimed.append(absdir)
+            dirnames[:] = []
+        return services
+
+    def translate(self, services: list[PlanService], plan: Plan) -> irtypes.IR:
+        ir = irtypes.IR(name=plan.name)
+        for plan_svc in services:
+            try:
+                container = containerizer.get_container(plan, plan_svc)
+            except Exception as e:  # noqa: BLE001 - plugin tolerance
+                log.warning("containerization failed for %s: %s", plan_svc.service_name, e)
+                continue
+            ir.add_container(container)
+            svc = irtypes.service_from_plan(plan_svc)
+            image = container.image_names[0] if container.image_names else svc.name + ":latest"
+            k8s_container: dict = {"name": svc.name, "image": image}
+            if container.exposed_ports:
+                k8s_container["ports"] = [
+                    {"containerPort": p} for p in container.exposed_ports
+                ]
+                for p in container.exposed_ports:
+                    svc.add_port_forwarding(p, p)
+            svc.containers.append(k8s_container)
+            if container.accelerator is not None:
+                svc.accelerator = container.accelerator
+            ir.add_service(svc)
+        return ir

@@ -1,0 +1,72 @@
+"""Source translator interface and registry.
+
+Parity: ``internal/source/translator.go:27-52`` — translators offer plan
+services at plan time (``get_service_options``) and convert selected
+services into IR at translate time. Registry order matters: Any2Kube is the
+fallback and must be last; the first plan service matching a translator's
+type wins at translate time.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.types.ir import IR, new_ir
+from move2kube_tpu.types.plan import Plan, PlanService
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("source")
+
+
+class Translator:
+    def get_translation_type(self) -> str:
+        raise NotImplementedError
+
+    def get_service_options(self, plan: Plan) -> list[PlanService]:
+        """Plan phase: detect services this translator can handle."""
+        raise NotImplementedError
+
+    def translate(self, services: list[PlanService], plan: Plan) -> IR:
+        """Translate phase: convert chosen services into IR."""
+        raise NotImplementedError
+
+
+def get_source_loaders() -> list[Translator]:
+    """Ordered registry (translator.go:35-40). Any2Kube must stay last."""
+    from move2kube_tpu.source.any2kube import Any2KubeTranslator
+    from move2kube_tpu.source.cfmanifest2kube import CfManifestTranslator
+    from move2kube_tpu.source.compose2kube import ComposeTranslator
+    from move2kube_tpu.source.dockerfile2kube import DockerfileTranslator
+    from move2kube_tpu.source.gpu2tpu import Gpu2TpuTranslator
+    from move2kube_tpu.source.kube2kube import KubeTranslator
+    from move2kube_tpu.source.knative2kube import KnativeTranslator
+
+    return [
+        ComposeTranslator(),
+        CfManifestTranslator(),
+        DockerfileTranslator(),
+        KubeTranslator(),
+        KnativeTranslator(),
+        Gpu2TpuTranslator(),  # claims GPU training dirs before the fallback
+        Any2KubeTranslator(),
+    ]
+
+
+def translate_sources(plan: Plan) -> IR:
+    """Run every translator over its services and merge the IRs
+    (translator.go:42-52)."""
+    ir = new_ir(plan)
+    translators = {t.get_translation_type(): t for t in get_source_loaders()}
+    by_type: dict[str, list[PlanService]] = {}
+    for svcs in plan.services.values():
+        for svc in svcs:
+            by_type.setdefault(svc.translation_type, []).append(svc)
+    for ttype, translator in translators.items():
+        services = by_type.get(ttype, [])
+        if not services:
+            continue
+        try:
+            sub_ir = translator.translate(services, plan)
+        except Exception as e:  # noqa: BLE001 - plugin tolerance
+            log.warning("translator %s failed: %s", ttype, e)
+            continue
+        ir.merge(sub_ir)
+    return ir

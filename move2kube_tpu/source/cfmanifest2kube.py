@@ -1,0 +1,137 @@
+"""Cloud Foundry manifest translator.
+
+Parity: ``internal/source/cfmanifest2kube.go`` — finds CF ``manifest.yml``
+files, matches apps against collected running-instance data
+(``m2kt_collect`` CfApps yamls referenced by the plan), offers every
+containerizer's options per app, and at translate time builds IR services
+with env vars, instance counts, and the PORT convention.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu import containerizer
+from move2kube_tpu.source.base import Translator
+from move2kube_tpu.types import collection as collecttypes
+from move2kube_tpu.types import ir as irtypes
+from move2kube_tpu.types.plan import (
+    Plan,
+    PlanService,
+    SourceType,
+    TranslationType,
+)
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("source.cfmanifest")
+
+CF_MANIFEST_NAMES = ["manifest.yml", "manifest.yaml"]
+
+
+def find_cf_manifests(root: str) -> list[tuple[str, list[dict]]]:
+    """-> [(path, applications)] for files that parse as CF manifests."""
+    out = []
+    for path in common.get_files_by_name(root, CF_MANIFEST_NAMES):
+        try:
+            doc = common.read_yaml(path)
+        except Exception:  # noqa: BLE001
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("applications"), list):
+            apps = [a for a in doc["applications"] if isinstance(a, dict) and a.get("name")]
+            if apps:
+                out.append((path, apps))
+    return out
+
+
+def _load_collected_apps(plan: Plan) -> dict[str, collecttypes.CfApp]:
+    apps: dict[str, collecttypes.CfApp] = {}
+    for path in plan.target_info_artifacts.get("CfApps", []):
+        try:
+            doc = common.read_m2kt_yaml(path, collecttypes.CF_APPS_KIND)
+            for app in collecttypes.CfInstanceApps.from_dict(doc).apps:
+                apps[app.name] = app
+        except Exception as e:  # noqa: BLE001
+            log.warning("cannot load collected cf apps %s: %s", path, e)
+    return apps
+
+
+class CfManifestTranslator(Translator):
+    def get_translation_type(self) -> str:
+        return TranslationType.CFMANIFEST2KUBE
+
+    def get_service_options(self, plan: Plan) -> list[PlanService]:
+        services: list[PlanService] = []
+        for manifest_path, apps in find_cf_manifests(plan.root_dir):
+            app_dir = os.path.dirname(manifest_path)
+            for app in apps:
+                name = common.make_dns_label(str(app["name"]))
+                src_dir = os.path.normpath(os.path.join(app_dir, str(app.get("path", "."))))
+                if not os.path.isdir(src_dir):
+                    src_dir = app_dir
+                options = containerizer.get_containerization_options(plan, src_dir)
+                for build_type, target_options in options.items():
+                    svc = PlanService(
+                        service_name=name,
+                        translation_type=TranslationType.CFMANIFEST2KUBE,
+                        container_build_type=build_type,
+                        source_types=[SourceType.CFMANIFEST],
+                        containerization_target_options=list(target_options),
+                    )
+                    svc.add_source_artifact(PlanService.CFMANIFEST_ARTIFACT, manifest_path)
+                    svc.add_source_artifact(PlanService.SOURCE_DIR_ARTIFACT, src_dir)
+                    services.append(svc)
+        return services
+
+    def translate(self, services: list[PlanService], plan: Plan) -> irtypes.IR:
+        ir = irtypes.IR(name=plan.name)
+        collected = _load_collected_apps(plan)
+        for plan_svc in services:
+            manifests = plan_svc.source_artifacts.get(PlanService.CFMANIFEST_ARTIFACT, [])
+            app_def: dict = {}
+            for m in manifests:
+                try:
+                    doc = common.read_yaml(m)
+                    for a in doc.get("applications", []):
+                        if common.make_dns_label(str(a.get("name", ""))) == plan_svc.service_name:
+                            app_def = a
+                            break
+                except Exception:  # noqa: BLE001
+                    continue
+            try:
+                container = containerizer.get_container(plan, plan_svc)
+            except Exception as e:  # noqa: BLE001
+                log.warning("cf containerization failed for %s: %s",
+                            plan_svc.service_name, e)
+                continue
+            ir.add_container(container)
+            svc = irtypes.service_from_plan(plan_svc)
+            running = collected.get(str(app_def.get("name", "")))
+            # port: running instance > containerizer detect > default 8080
+            # (cfmanifest2kube.go:265-412)
+            if running and running.ports:
+                port = running.ports[0]
+            elif container.exposed_ports:
+                port = container.exposed_ports[0]
+            else:
+                port = common.DEFAULT_SERVICE_PORT
+            image = container.image_names[0] if container.image_names else svc.name + ":latest"
+            env = [{"name": "PORT", "value": str(port)}]
+            for k, v in (app_def.get("env") or {}).items():
+                env.append({"name": str(k), "value": str(v)})
+            if running:
+                for k, v in running.env.items():
+                    if all(e["name"] != k for e in env):
+                        env.append({"name": k, "value": v})
+                svc.replicas = max(1, running.instances)
+            if app_def.get("instances"):
+                svc.replicas = max(1, int(app_def["instances"]))
+            svc.containers.append({
+                "name": svc.name,
+                "image": image,
+                "ports": [{"containerPort": port}],
+                "env": env,
+            })
+            svc.add_port_forwarding(port, port)
+            ir.add_service(svc)
+        return ir

@@ -1,0 +1,520 @@
+"""docker-compose translator.
+
+Parity: ``internal/source/compose2kube.go`` + ``internal/source/compose/``
+(v1/v2 loader v1v2.go, v3 loader v3.go, utils.go): find compose files by
+extension + ``services:`` key, offer Reuse vs ReuseDockerfile per service
+(build section present -> both), and convert full service semantics to IR:
+image/entrypoint/args/env (with interpolation honoring IGNORE_ENVIRONMENT),
+port syntaxes, expose, privileged/user/caps -> SecurityContext,
+stop_grace_period, mem_limit, restart policy, deploy.replicas, healthcheck
+-> readiness probe, networks -> NetworkPolicy annotations, tmpfs ->
+emptyDir, named volumes -> PVC + Storage, bind mounts -> hostPath,
+secrets/configs -> Storage.
+
+Net-new: GPU services (``runtime: nvidia``, ``deploy.resources.
+reservations.devices`` with gpu capability, count) get AcceleratorInfo so
+the TPU emitters turn them into pod-slice workloads (BASELINE config 4).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from move2kube_tpu.source import gpu_detect
+from move2kube_tpu.source.base import Translator
+from move2kube_tpu.types import ir as irtypes
+from move2kube_tpu.types.plan import (
+    ContainerBuildType,
+    Plan,
+    PlanService,
+    SourceType,
+    TranslationType,
+)
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("source.compose")
+
+COMPOSE_NETWORK_ANNOTATION = "move2kube-tpu.io/networks"
+
+
+def find_compose_files(root: str) -> list[str]:
+    """Compose files = yaml with a services mapping (compose2kube.go:122-150)."""
+    out = []
+    for path in common.get_files_by_ext(root, [".yaml", ".yml"]):
+        base = os.path.basename(path).lower()
+        looks_like = "compose" in base or base in ("docker-compose.yaml", "docker-compose.yml")
+        try:
+            doc = common.read_yaml(path)
+        except Exception:  # noqa: BLE001
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("services"), dict):
+            if looks_like or "version" in doc:
+                out.append(path)
+    return out
+
+
+def _interpolate(value: str, env_map: dict[str, str]) -> str:
+    """${VAR}, ${VAR:-default}, $VAR interpolation (v3.go via docker/cli;
+    environment honored only when IGNORE_ENVIRONMENT is False)."""
+
+    def repl(m: re.Match) -> str:
+        if m.group(0) == "$$":  # compose-spec escape for a literal $
+            return "$"
+        var = m.group("braced") or m.group("plain")
+        default = m.group("default") or ""
+        if var in env_map:
+            return env_map[var]
+        if not common.IGNORE_ENVIRONMENT and var in os.environ:
+            return os.environ[var]
+        return default
+
+    return re.sub(
+        r"\$(?:\$|\{(?P<braced>\w+)(?::?-(?P<default>[^}]*))?\}|(?P<plain>\w+))",
+        repl,
+        value,
+    )
+
+
+def _load_env_file(path: str) -> dict[str, str]:
+    env = {}
+    try:
+        for line in open(path, encoding="utf-8"):
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            env[k.strip()] = v.strip().strip("'\"")
+    except OSError:
+        pass
+    return env
+
+
+def parse_ports(raw_ports: list, expose: list) -> list[tuple[int, int]]:
+    """-> [(published, target)] covering short/long syntax
+    (v1v2.go getPorts:350, parseContainerPort:406)."""
+    out: list[tuple[int, int]] = []
+
+    def add(published: int, target: int) -> None:
+        if all(p[0] != published for p in out):
+            out.append((published, target))
+
+    for p in raw_ports or []:
+        if isinstance(p, dict):  # long syntax
+            target = int(p.get("target", 0) or 0)
+            published = int(p.get("published", target) or target)
+            if target:
+                add(published, target)
+            continue
+        s = str(p)
+        s = s.split("/")[0]  # strip protocol
+        parts = s.split(":")
+        try:
+            if len(parts) == 1:
+                port = int(parts[0])
+                add(port, port)
+            elif len(parts) == 2:
+                add(int(parts[0]), int(parts[1]))
+            else:  # ip:published:target
+                add(int(parts[-2]), int(parts[-1]))
+        except ValueError:
+            log.warning("unparseable port %r", p)
+    for e in expose or []:
+        try:
+            port = int(str(e).split("/")[0])
+            add(port, port)
+        except ValueError:
+            continue
+    return out
+
+
+def _parse_env(svc_def: dict, compose_dir: str) -> dict[str, str]:
+    env: dict[str, str] = {}
+    env_files = svc_def.get("env_file", [])
+    if isinstance(env_files, str):
+        env_files = [env_files]
+    for ef in env_files:
+        env.update(_load_env_file(os.path.join(compose_dir, ef)))
+    raw = svc_def.get("environment", {})
+    if isinstance(raw, list):
+        for item in raw:
+            if "=" in str(item):
+                k, v = str(item).split("=", 1)
+                env[k] = v
+            elif not common.IGNORE_ENVIRONMENT and str(item) in os.environ:
+                env[str(item)] = os.environ[str(item)]
+    elif isinstance(raw, dict):
+        for k, v in raw.items():
+            env[str(k)] = "" if v is None else str(v)
+    return env
+
+
+def _parse_memory(val) -> str | None:
+    """compose mem_limit ('512m', '2g', bytes) -> k8s quantity."""
+    if val is None:
+        return None
+    s = str(val).strip().lower()
+    m = re.fullmatch(r"(\d+)([bkmg]?)b?", s)
+    if not m:
+        return None
+    n, unit = int(m.group(1)), m.group(2)
+    return {"": str(n), "b": str(n), "k": f"{n}Ki", "m": f"{n}Mi", "g": f"{n}Gi"}[unit]
+
+
+def _gpu_info_from_service(svc_def: dict) -> int:
+    """GPU count requested by a compose service (runtime: nvidia /
+    deploy.resources.reservations.devices)."""
+    count = 0
+    if str(svc_def.get("runtime", "")).lower() == "nvidia":
+        count = 1
+    deploy = svc_def.get("deploy", {}) or {}
+    devices = (((deploy.get("resources") or {}).get("reservations") or {}).get("devices")) or []
+    for dev in devices:
+        caps = [str(c).lower() for c in (dev.get("capabilities") or [])]
+        if "gpu" in caps or "nvidia" in str(dev.get("driver", "")).lower():
+            c = dev.get("count", 1)
+            count = max(count, 999 if str(c) == "all" else int(c or 1))
+    env = svc_def.get("environment") or {}
+    if isinstance(env, dict) and "NVIDIA_VISIBLE_DEVICES" in env:
+        count = max(count, 1)
+    return count
+
+
+def _healthcheck_to_probe(hc: dict) -> dict | None:
+    """compose healthcheck -> readiness probe (v3.go getHealthCheck:574)."""
+    if not hc or hc.get("disable"):
+        return None
+    test = hc.get("test", [])
+    if isinstance(test, str):
+        command = ["CMD-SHELL", test]
+    else:
+        command = [str(t) for t in test]
+    if not command:
+        return None
+    if command[0] == "NONE":
+        return None
+    if command[0] in ("CMD", "CMD-SHELL"):
+        exec_cmd = command[1:] if command[0] == "CMD" else ["sh", "-c", *command[1:]]
+    else:
+        exec_cmd = command
+    probe: dict = {"exec": {"command": exec_cmd}}
+
+    def seconds(val) -> int | None:
+        if val is None:
+            return None
+        m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h)?", str(val).strip())
+        if not m:
+            return None
+        n = float(m.group(1))
+        mult = {"ms": 0.001, "s": 1, "m": 60, "h": 3600, None: 1}[m.group(2)]
+        return max(1, int(n * mult))
+
+    if seconds(hc.get("interval")):
+        probe["periodSeconds"] = seconds(hc.get("interval"))
+    if seconds(hc.get("timeout")):
+        probe["timeoutSeconds"] = seconds(hc.get("timeout"))
+    if seconds(hc.get("start_period")):
+        probe["initialDelaySeconds"] = seconds(hc.get("start_period"))
+    if hc.get("retries"):
+        probe["failureThreshold"] = int(hc["retries"])
+    return probe
+
+
+class ComposeTranslator(Translator):
+    def get_translation_type(self) -> str:
+        return TranslationType.COMPOSE2KUBE
+
+    def get_service_options(self, plan: Plan) -> list[PlanService]:
+        services: list[PlanService] = []
+        for compose_file in find_compose_files(plan.root_dir):
+            try:
+                doc = common.read_yaml(compose_file)
+            except Exception as e:  # noqa: BLE001
+                log.warning("cannot parse %s: %s", compose_file, e)
+                continue
+            for svc_name, svc_def in (doc.get("services") or {}).items():
+                if not isinstance(svc_def, dict):
+                    continue
+                name = common.make_dns_label(svc_name)
+                has_build = "build" in svc_def
+                build_types = (
+                    [ContainerBuildType.REUSE_DOCKERFILE, ContainerBuildType.REUSE]
+                    if has_build else [ContainerBuildType.REUSE]
+                )
+                for bt in build_types:
+                    svc = PlanService(
+                        service_name=name,
+                        image=str(svc_def.get("image", "") or f"{name}:latest"),
+                        translation_type=TranslationType.COMPOSE2KUBE,
+                        container_build_type=bt,
+                        source_types=[SourceType.COMPOSE],
+                    )
+                    svc.add_source_artifact(PlanService.COMPOSE_ARTIFACT, compose_file)
+                    if has_build:
+                        build = svc_def["build"]
+                        ctx = build if isinstance(build, str) else build.get("context", ".")
+                        dockerfile = (
+                            "Dockerfile" if isinstance(build, str)
+                            else build.get("dockerfile", "Dockerfile")
+                        )
+                        build_dir = os.path.normpath(
+                            os.path.join(os.path.dirname(compose_file), ctx)
+                        )
+                        svc.add_source_artifact(PlanService.SOURCE_DIR_ARTIFACT, build_dir)
+                        if bt == ContainerBuildType.REUSE_DOCKERFILE:
+                            svc.add_source_artifact(
+                                PlanService.DOCKERFILE_ARTIFACT,
+                                os.path.join(build_dir, dockerfile),
+                            )
+                    services.append(svc)
+        return services
+
+    def translate(self, services: list[PlanService], plan: Plan) -> irtypes.IR:
+        ir = irtypes.IR(name=plan.name)
+        # group chosen services by compose file
+        by_file: dict[str, list[PlanService]] = {}
+        for svc in services:
+            for f in svc.source_artifacts.get(PlanService.COMPOSE_ARTIFACT, []):
+                by_file.setdefault(f, []).append(svc)
+        for compose_file, plan_svcs in by_file.items():
+            try:
+                self._convert_file(ir, compose_file, plan_svcs, plan)
+            except Exception as e:  # noqa: BLE001
+                log.warning("compose translate failed for %s: %s", compose_file, e)
+        return ir
+
+    def _convert_file(self, ir: irtypes.IR, compose_file: str,
+                      plan_svcs: list[PlanService], plan: Plan) -> None:
+        doc = common.read_yaml(compose_file)
+        compose_dir = os.path.dirname(compose_file)
+        wanted = {s.service_name: s for s in plan_svcs}
+        top_volumes = doc.get("volumes") or {}
+        for svc_name, svc_def in (doc.get("services") or {}).items():
+            name = common.make_dns_label(svc_name)
+            if name not in wanted:
+                continue
+            plan_svc = wanted[name]
+            if not isinstance(svc_def, dict):
+                continue
+            self._convert_service(
+                ir, name, svc_def, plan_svc, compose_dir, top_volumes, doc
+            )
+        # secrets/configs -> Storage (v3.go:432-478)
+        for sec_name, sec_def in (doc.get("secrets") or {}).items():
+            self._add_file_storage(ir, sec_name, sec_def, compose_dir,
+                                   irtypes.StorageKind.SECRET)
+        for cfg_name, cfg_def in (doc.get("configs") or {}).items():
+            self._add_file_storage(ir, cfg_name, cfg_def, compose_dir,
+                                   irtypes.StorageKind.CONFIGMAP)
+
+    def _add_file_storage(self, ir: irtypes.IR, name: str, definition: dict,
+                          compose_dir: str, kind: str) -> None:
+        name = common.make_dns_label(name)
+        storage = irtypes.Storage(name=name, kind=kind)
+        if isinstance(definition, dict) and definition.get("file"):
+            path = os.path.join(compose_dir, definition["file"])
+            try:
+                storage.content[os.path.basename(path)] = open(path, "rb").read()
+            except OSError as e:
+                log.warning("cannot read %s content %s: %s", kind, path, e)
+        ir.add_storage(storage)
+
+    def _convert_service(self, ir: irtypes.IR, name: str, svc_def: dict,
+                         plan_svc: PlanService, compose_dir: str,
+                         top_volumes: dict, doc: dict) -> None:
+        svc = irtypes.service_from_plan(plan_svc)
+        env_map = _parse_env(svc_def, compose_dir)
+
+        image = _interpolate(str(svc_def.get("image", "") or plan_svc.image or f"{name}:latest"), env_map)
+        container: dict = {"name": name, "image": image}
+
+        # entrypoint/command (compose entrypoint->k8s command, command->args)
+        ep = svc_def.get("entrypoint")
+        if ep:
+            container["command"] = [ep] if isinstance(ep, str) else [str(x) for x in ep]
+        cmd = svc_def.get("command")
+        if cmd:
+            container["args"] = (
+                ["sh", "-c", cmd] if isinstance(cmd, str) else [str(x) for x in cmd]
+            )
+        if env_map:
+            container["env"] = [
+                {"name": k, "value": _interpolate(v, env_map)} for k, v in env_map.items()
+            ]
+
+        ports = parse_ports(svc_def.get("ports"), svc_def.get("expose"))
+        if ports:
+            container["ports"] = [{"containerPort": t} for _, t in ports]
+            for published, target in ports:
+                svc.add_port_forwarding(published, target)
+
+        # security context (privileged/user/cap_add/cap_drop/read_only)
+        sec: dict = {}
+        if svc_def.get("privileged"):
+            sec["privileged"] = True
+        if svc_def.get("read_only"):
+            sec["readOnlyRootFilesystem"] = True
+        user = svc_def.get("user")
+        if user is not None:
+            m = re.match(r"^(\d+)", str(user))
+            if m:
+                sec["runAsUser"] = int(m.group(1))
+        caps: dict = {}
+        if svc_def.get("cap_add"):
+            caps["add"] = [str(c) for c in svc_def["cap_add"]]
+        if svc_def.get("cap_drop"):
+            caps["drop"] = [str(c) for c in svc_def["cap_drop"]]
+        if caps:
+            sec["capabilities"] = caps
+        if sec:
+            container["securityContext"] = sec
+        group_add = svc_def.get("group_add")
+        if group_add:
+            svc.security_context.setdefault("supplementalGroups", []).extend(
+                int(g) for g in group_add if str(g).isdigit()
+            )
+
+        # resources
+        mem = _parse_memory(svc_def.get("mem_limit")
+                            or (svc_def.get("deploy", {}).get("resources", {})
+                                .get("limits", {}) or {}).get("memory"))
+        if mem:
+            container.setdefault("resources", {}).setdefault("limits", {})["memory"] = mem
+
+        # healthcheck -> readiness probe
+        probe = _healthcheck_to_probe(svc_def.get("healthcheck") or {})
+        if probe:
+            container["readinessProbe"] = probe
+
+        # restart policy (v1v2.go: restart / deploy.restart_policy)
+        restart = str(svc_def.get("restart", "")
+                      or (svc_def.get("deploy", {}).get("restart_policy", {}) or {}).get("condition", ""))
+        if restart in ("no", "none"):
+            svc.restart_policy = "Never"
+        elif restart.startswith("on-failure"):
+            svc.restart_policy = "OnFailure"
+        elif restart in ("always", "any", "unless-stopped"):
+            svc.restart_policy = "Always"
+
+        if svc_def.get("stop_grace_period"):
+            m = re.match(r"(\d+)", str(svc_def["stop_grace_period"]))
+            if m:
+                svc.annotations["move2kube-tpu.io/stop-grace-period"] = m.group(1)
+
+        # replicas
+        deploy = svc_def.get("deploy") or {}
+        if deploy.get("replicas"):
+            svc.replicas = int(deploy["replicas"])
+
+        # networks -> annotation consumed by the NetworkPolicy apiresource
+        networks = svc_def.get("networks")
+        if isinstance(networks, dict):
+            svc.networks = [common.make_dns_label(n) for n in networks]
+        elif isinstance(networks, list):
+            svc.networks = [common.make_dns_label(str(n)) for n in networks]
+
+        # tmpfs -> emptyDir (utils.go tmpfs fabrication)
+        tmpfs = svc_def.get("tmpfs")
+        if isinstance(tmpfs, str):
+            tmpfs = [tmpfs]
+        for i, mount in enumerate(tmpfs or []):
+            vol_name = f"{name}-tmpfs-{i}"
+            svc.add_volume({"name": vol_name, "emptyDir": {"medium": "Memory"}})
+            container.setdefault("volumeMounts", []).append(
+                {"name": vol_name, "mountPath": str(mount).split(":")[0]}
+            )
+
+        # volumes: named -> PVC, path -> hostPath (v1v2.go:269-320)
+        for i, vol in enumerate(svc_def.get("volumes") or []):
+            if isinstance(vol, dict):  # long syntax
+                vtype = vol.get("type", "volume")
+                src, target = vol.get("source", ""), vol.get("target", "")
+                read_only = bool(vol.get("read_only"))
+            else:
+                parts = str(vol).split(":")
+                if len(parts) == 1:
+                    src, target, read_only = "", parts[0], False
+                else:
+                    src, target = parts[0], parts[1]
+                    read_only = len(parts) > 2 and parts[2] == "ro"
+                vtype = "bind" if src.startswith((".", "/", "~")) else "volume"
+            if not target:
+                continue
+            if vtype == "tmpfs":
+                vol_name = f"{name}-tmpfs-l{i}"
+                svc.add_volume({"name": vol_name, "emptyDir": {"medium": "Memory"}})
+            elif vtype == "bind" or (src and src.startswith((".", "/", "~"))):
+                vol_name = common.make_dns_label(f"{name}-hostpath-{i}")
+                host_path = os.path.normpath(os.path.join(compose_dir, src)) if src.startswith(".") else src
+                svc.add_volume({"name": vol_name, "hostPath": {"path": host_path}})
+            else:
+                vol_name = common.make_dns_label(src or f"{name}-vol-{i}")
+                svc.add_volume({
+                    "name": vol_name,
+                    "persistentVolumeClaim": {"claimName": vol_name},
+                })
+                pvc = irtypes.Storage(
+                    name=vol_name, kind=irtypes.StorageKind.PVC,
+                    pvc_spec={
+                        "accessModes": ["ReadWriteOnce"],
+                        "resources": {"requests": {"storage": common.DEFAULT_PVC_SIZE}},
+                    },
+                )
+                ir.add_storage(pvc)
+            mount: dict = {"name": vol_name, "mountPath": target}
+            if read_only:
+                mount["readOnly"] = True
+            container.setdefault("volumeMounts", []).append(mount)
+
+        # secrets/configs mounts
+        for sec in svc_def.get("secrets") or []:
+            sec_name = common.make_dns_label(sec if isinstance(sec, str) else sec.get("source", ""))
+            vol_name = f"secret-{sec_name}"
+            svc.add_volume({"name": vol_name, "secret": {"secretName": sec_name}})
+            container.setdefault("volumeMounts", []).append(
+                {"name": vol_name, "mountPath": f"/run/secrets/{sec_name}", "readOnly": True}
+            )
+        for cfg in svc_def.get("configs") or []:
+            cfg_name = common.make_dns_label(cfg if isinstance(cfg, str) else cfg.get("source", ""))
+            vol_name = f"config-{cfg_name}"
+            svc.add_volume({"name": vol_name, "configMap": {"name": cfg_name}})
+            target = cfg.get("target", f"/{cfg_name}") if isinstance(cfg, dict) else f"/{cfg_name}"
+            container.setdefault("volumeMounts", []).append(
+                {"name": vol_name, "mountPath": target}
+            )
+
+        # net-new: GPU service -> TPU accelerator info (BASELINE config 4)
+        gpu_count = _gpu_info_from_service(svc_def)
+        if gpu_count:
+            gpu_count = min(gpu_count, 256)
+            acc_type, topology, hosts = gpu_detect.map_gpu_to_tpu(gpu_count)
+            from move2kube_tpu.types.plan import AcceleratorInfo
+
+            svc.accelerator = AcceleratorInfo(
+                gpu_count=gpu_count,
+                gpu_vendor="nvidia.com/gpu",
+                distributed_backend="nccl" if gpu_count > 1 else "",
+                tpu_accelerator=acc_type,
+                tpu_topology=topology,
+                num_hosts=hosts,
+            )
+            # GPU compose services become TPU pod-slice workloads (JobSet)
+            svc.job = True
+            svc.restart_policy = "Never"
+
+        svc.containers.append(container)
+        ir.add_service(svc)
+
+        # the image itself: reuse or rebuild
+        if plan_svc.container_build_type == ContainerBuildType.REUSE:
+            ir.add_container(irtypes.Container(
+                image_names=[image], new=False, build_type=ContainerBuildType.REUSE,
+            ))
+        else:
+            from move2kube_tpu import containerizer as czr
+
+            try:
+                ir.add_container(czr.get_container(plan, plan_svc))
+            except Exception as e:  # noqa: BLE001
+                log.warning("compose build for %s failed: %s", name, e)

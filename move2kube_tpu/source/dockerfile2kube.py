@@ -1,0 +1,146 @@
+"""Dockerfile translator: source trees that already carry Dockerfiles.
+
+Parity: ``internal/source/dockerfile2kube.go`` — finds files parseable as
+Dockerfiles (must contain a FROM instruction; isDockerFile :117-144),
+buckets multiple Dockerfiles into services by path (bucketDFs :214-280) and
+routes each to the ReuseDockerfile containerizer.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from move2kube_tpu import containerizer
+from move2kube_tpu.source.base import Translator
+from move2kube_tpu.source.ignores import IgnoreRules
+from move2kube_tpu.types import ir as irtypes
+from move2kube_tpu.types.plan import (
+    ContainerBuildType,
+    Plan,
+    PlanService,
+    SourceType,
+    TranslationType,
+)
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("source.dockerfile")
+
+_INSTRUCTION = re.compile(
+    r"^\s*(FROM|RUN|CMD|LABEL|MAINTAINER|EXPOSE|ENV|ADD|COPY|ENTRYPOINT|VOLUME|USER|WORKDIR|ARG|ONBUILD|STOPSIGNAL|HEALTHCHECK|SHELL)\b",
+    re.IGNORECASE,
+)
+
+
+def is_dockerfile(path: str) -> bool:
+    """A file is a Dockerfile if it parses as instructions incl. FROM
+    (dockerfile2kube.go:117-144)."""
+    try:
+        with open(path, encoding="utf-8", errors="ignore") as f:
+            text = f.read(65536)
+    except OSError:
+        return False
+    has_from = False
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if not _INSTRUCTION.match(line) and not raw.startswith((" ", "\t")) and not raw.rstrip().endswith("\\"):
+            # allow continuation lines; anything else disqualifies
+            if not has_from:
+                return False
+        if line.upper().startswith("FROM"):
+            has_from = True
+    return has_from
+
+
+def find_dockerfiles(root: str) -> list[str]:
+    ignores = IgnoreRules(root)
+    out = []
+    candidates = common.get_files_by_name(root, ["Dockerfile", "Containerfile"])
+    candidates += [
+        p for p in common.get_files_by_ext(root, [".dockerfile"]) if p not in candidates
+    ]
+    for p in candidates:
+        if not ignores.is_ignored(p) and is_dockerfile(p):
+            out.append(p)
+    return out
+
+
+def bucket_dockerfiles(dockerfiles: list[str], root: str) -> dict[str, str]:
+    """service name -> dockerfile path, named by containing dir
+    (bucketDFs dockerfile2kube.go:214-280)."""
+    buckets: dict[str, str] = {}
+    for df in dockerfiles:
+        d = os.path.dirname(df)
+        rel = common.relpath_under(d, root)
+        if rel in (None, "."):
+            name = common.make_dns_label(os.path.basename(root.rstrip(os.sep)) or "app")
+        else:
+            name = common.make_dns_label(rel.replace(os.sep, "-"))
+        name = common.unique_name(name, buckets.keys())
+        buckets[name] = df
+    return buckets
+
+
+class DockerfileTranslator(Translator):
+    def get_translation_type(self) -> str:
+        return TranslationType.DOCKERFILE2KUBE
+
+    def get_service_options(self, plan: Plan) -> list[PlanService]:
+        dockerfiles = find_dockerfiles(plan.root_dir)
+        services = []
+        for name, df in bucket_dockerfiles(dockerfiles, plan.root_dir).items():
+            svc = PlanService(
+                service_name=name,
+                translation_type=TranslationType.DOCKERFILE2KUBE,
+                container_build_type=ContainerBuildType.REUSE_DOCKERFILE,
+                source_types=[SourceType.DOCKERFILE],
+                containerization_target_options=[df],
+            )
+            svc.add_source_artifact(PlanService.DOCKERFILE_ARTIFACT, df)
+            svc.add_source_artifact(PlanService.SOURCE_DIR_ARTIFACT, os.path.dirname(df))
+            services.append(svc)
+        return services
+
+    def translate(self, services: list[PlanService], plan: Plan) -> irtypes.IR:
+        ir = irtypes.IR(name=plan.name)
+        for plan_svc in services:
+            try:
+                container = containerizer.get_container(plan, plan_svc)
+            except Exception as e:  # noqa: BLE001
+                log.warning("dockerfile containerization failed for %s: %s",
+                            plan_svc.service_name, e)
+                continue
+            # ports from the user's Dockerfile EXPOSE lines
+            dockerfiles = plan_svc.source_artifacts.get(PlanService.DOCKERFILE_ARTIFACT, [])
+            for df in dockerfiles:
+                for port in _exposed_ports(df):
+                    container.add_exposed_port(port)
+            ir.add_container(container)
+            svc = irtypes.service_from_plan(plan_svc)
+            image = container.image_names[0] if container.image_names else svc.name + ":latest"
+            k8s_container: dict = {"name": svc.name, "image": image}
+            if container.exposed_ports:
+                k8s_container["ports"] = [{"containerPort": p} for p in container.exposed_ports]
+                for p in container.exposed_ports:
+                    svc.add_port_forwarding(p, p)
+            svc.containers.append(k8s_container)
+            ir.add_service(svc)
+        return ir
+
+
+def _exposed_ports(dockerfile: str) -> list[int]:
+    ports = []
+    try:
+        for line in open(dockerfile, encoding="utf-8", errors="ignore"):
+            m = re.match(r"\s*EXPOSE\s+(.+)", line, re.IGNORECASE)
+            if m:
+                for tok in m.group(1).split():
+                    tok = tok.split("/")[0]
+                    if tok.isdigit():
+                        ports.append(int(tok))
+    except OSError:
+        pass
+    return ports

@@ -1,0 +1,116 @@
+"""Gpu2Tpu translator: claim GPU training directories for the TPU target.
+
+Net-new vs the reference (the north star, BASELINE.json): walks the source
+tree like Any2Kube but only claims directories whose Python sources are GPU
+training workloads (CUDA / NCCL / DeepSpeed — see ``gpu_detect``). Each
+claimed dir becomes a plan service with ``JaxXla`` build type and
+AcceleratorInfo recording detected GPU topology and the chosen TPU slice.
+
+At translate time the jax-xla containerizer rewrites the entrypoint into a
+JAX program from the model zoo and the IR service is marked as a
+run-to-completion Job with TPU resources — the TPU apiresources emit a
+JobSet instead of a Deployment for it.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu import containerizer
+from move2kube_tpu.source import gpu_detect
+from move2kube_tpu.source.base import Translator
+from move2kube_tpu.source.ignores import IgnoreRules
+from move2kube_tpu.types import ir as irtypes
+from move2kube_tpu.types.plan import (
+    ContainerBuildType,
+    Plan,
+    PlanService,
+    SourceType,
+    TranslationType,
+)
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("source.gpu2tpu")
+
+_SKIP_DIR_NAMES = {".git", "node_modules", "__pycache__", ".venv", "venv", "vendor"}
+
+
+class Gpu2TpuTranslator(Translator):
+    def get_translation_type(self) -> str:
+        return TranslationType.GPU2TPU
+
+    def get_service_options(self, plan: Plan) -> list[PlanService]:
+        from move2kube_tpu.source.any2kube import claimed_directories
+
+        root = plan.root_dir
+        ignores = IgnoreRules(root)
+        claimed = claimed_directories(plan)
+        services: list[PlanService] = []
+        taken_names = set(plan.services.keys())
+
+        for dirpath, dirnames, _filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in _SKIP_DIR_NAMES and not ignores.is_ignored(os.path.join(dirpath, d))
+            )
+            absdir = os.path.abspath(dirpath)
+            if any(common.is_parent(absdir, c) or common.is_parent(c, absdir) for c in claimed):
+                continue
+            report = gpu_detect.analyze_directory(absdir)
+            if report is None:
+                continue
+            # claim the smallest directory containing the training code: if
+            # everything lives under one child, keep walking into it instead
+            script_home = common.find_common_directory(report.training_scripts)
+            if script_home and os.path.abspath(script_home) != absdir:
+                if os.path.isfile(script_home):
+                    script_home = os.path.dirname(script_home)
+                if os.path.abspath(script_home) != absdir:
+                    continue
+            base = common.make_dns_label(
+                os.path.basename(absdir.rstrip(os.sep)) or plan.name
+            )
+            name = common.unique_name(base, taken_names)
+            taken_names.add(name)
+            acc = gpu_detect.report_to_accelerator(report)
+            svc = PlanService(
+                service_name=name,
+                translation_type=TranslationType.GPU2TPU,
+                container_build_type=ContainerBuildType.JAX_XLA,
+                source_types=[SourceType.GPU_TRAINING],
+                containerization_target_options=[report.model_family or "generic"],
+                accelerator=acc,
+            )
+            svc.add_source_artifact(PlanService.SOURCE_DIR_ARTIFACT, absdir)
+            if report.entrypoint:
+                svc.add_source_artifact(
+                    PlanService.GPU_ENTRYPOINT_ARTIFACT, report.entrypoint
+                )
+            for ev in report.evidence[:5]:
+                log.info("gpu2tpu %s: %s", name, ev)
+            services.append(svc)
+            claimed.append(absdir)
+            dirnames[:] = []
+        return services
+
+    def translate(self, services: list[PlanService], plan: Plan) -> irtypes.IR:
+        ir = irtypes.IR(name=plan.name)
+        for plan_svc in services:
+            try:
+                container = containerizer.get_container(plan, plan_svc)
+            except Exception as e:  # noqa: BLE001
+                log.warning("jax-xla containerization failed for %s: %s",
+                            plan_svc.service_name, e)
+                continue
+            if container.accelerator is None:
+                container.accelerator = plan_svc.accelerator
+            ir.add_container(container)
+            svc = irtypes.service_from_plan(plan_svc)
+            svc.job = True  # run-to-completion training workload
+            svc.restart_policy = "Never"
+            svc.accelerator = plan_svc.accelerator
+            image = container.image_names[0] if container.image_names else svc.name + ":latest"
+            svc.containers.append({"name": svc.name, "image": image})
+            ir.add_service(svc)
+        return ir

@@ -1,0 +1,352 @@
+"""Static detection of GPU training workloads in user source trees.
+
+The north-star detection layer (net-new vs the reference; BASELINE.json):
+AST + pattern analysis of Python sources recognising
+
+- CUDA usage: ``torch.cuda``, ``.cuda()``, ``.to('cuda')``, cupy, numba.cuda
+- distributed backends: ``dist.init_process_group('nccl'|'gloo'|'mpi')``,
+  ``torchrun``/``torch.distributed.launch``, horovod
+- DeepSpeed: imports + ``ds_config`` JSON (ZeRO stage, pipeline/tensor
+  parallel sizes)
+- TF GPU: ``tf.config...'GPU'``, ``MirroredStrategy``
+- model family (resnet / bert / llama / generic) from imports and symbols
+
+and GPU resource requests in compose / k8s inputs (``nvidia.com/gpu``,
+``runtime: nvidia``) — handled by the compose/k8s translators calling
+:func:`gpu_resources_from_k8s_container`.
+
+The result feeds ``AcceleratorInfo`` on plan services; the jax-xla
+containerizer and the TPU apiresources size slices from it (see
+:func:`map_gpu_to_tpu`).
+
+Analysis degrades gracefully: unparseable files fall back to text-pattern
+scanning, mirroring how the reference tolerates undetectable stacks by
+falling back to Manual containerization.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+from move2kube_tpu.types.plan import AcceleratorInfo
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("gpu_detect")
+
+_SKIP_DIRS = {".git", "node_modules", "__pycache__", ".venv", "venv"}
+
+# Import roots that signal each framework
+_FRAMEWORK_IMPORTS = {
+    "torch": "torch",
+    "tensorflow": "tf",
+    "deepspeed": "deepspeed",
+    "horovod": "horovod",
+    "cupy": "cupy",
+    "jax": "jax",  # already-ported code: no translation needed
+}
+
+_MODEL_FAMILY_PATTERNS = [
+    ("llama", re.compile(r"llama|LlamaForCausalLM|mistral|decoder_layer|rotary", re.I)),
+    ("bert", re.compile(r"\bbert\b|BertModel|BertForSequenceClassification|AutoModelForSequenceClassification", re.I)),
+    ("resnet", re.compile(r"resnet|torchvision\.models", re.I)),
+    ("gpt", re.compile(r"\bgpt2?\b|GPT2LMHeadModel|causal_lm|CausalLM", re.I)),
+    ("unet", re.compile(r"\bunet\b|diffusion", re.I)),
+]
+
+_CUDA_TEXT = re.compile(
+    r"torch\.cuda|\.cuda\(\)|to\(['\"]cuda|device\s*=\s*['\"]cuda|cupy|numba\.cuda"
+    r"|tf\.config[^\n]*GPU|nvidia-smi|CUDA_VISIBLE_DEVICES"
+)
+_NCCL_TEXT = re.compile(r"['\"]nccl['\"]|init_process_group|DistributedDataParallel|torchrun|torch\.distributed")
+
+
+@dataclass
+class GpuReport:
+    """What the analyzer found for one directory."""
+
+    frameworks: list[str] = field(default_factory=list)
+    uses_cuda: bool = False
+    distributed_backend: str = ""  # nccl | gloo | mpi | horovod | ""
+    world_size_hint: int = 0  # e.g. from --nproc_per_node or ds_config
+    zero_stage: int = 0
+    tensor_parallel: int = 1
+    pipeline_parallel: int = 1
+    model_family: str = ""
+    entrypoint: str = ""  # training script path
+    training_scripts: list[str] = field(default_factory=list)
+    evidence: list[str] = field(default_factory=list)  # human-readable findings
+
+
+def _iter_py_files(directory: str, max_files: int = 500):
+    n = 0
+    for dirpath, dirnames, filenames in os.walk(directory):
+        dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+                n += 1
+                if n >= max_files:
+                    return
+
+
+class _PyVisitor(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.imports: set[str] = set()
+        self.backend: str = ""
+        self.is_training = False
+        self.nproc_hint = 0
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.imports.add(a.name.split(".")[0])
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module:
+            self.imports.add(node.module.split(".")[0])
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # dist.init_process_group("nccl") / backend="nccl"
+        fname = ""
+        if isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            fname = node.func.id
+        if fname == "init_process_group":
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    if arg.value in ("nccl", "gloo", "mpi"):
+                        self.backend = arg.value
+                        break
+        if fname in ("backward", "step") or fname in ("fit", "train"):
+            self.is_training = True
+        self.generic_visit(node)
+
+
+def analyze_file(path: str) -> tuple[_PyVisitor | None, str]:
+    try:
+        text = open(path, encoding="utf-8", errors="ignore").read()
+    except OSError:
+        return None, ""
+    try:
+        tree = ast.parse(text)
+        v = _PyVisitor()
+        v.visit(tree)
+    except SyntaxError:
+        v = None
+    return v, text
+
+
+_analysis_cache: dict[str, GpuReport | None] = {}
+
+
+def clear_cache() -> None:
+    _analysis_cache.clear()
+
+
+def analyze_directory(directory: str) -> GpuReport | None:
+    """Analyze a directory; None if it is not a GPU training workload.
+
+    Memoised per absolute path: the plan walker and the jax-xla
+    containerizer both probe the same directories (and the walker probes
+    every ancestor), so uncached analysis would re-read subtrees
+    O(dirs x files) times.
+    """
+    directory = os.path.abspath(directory)
+    if directory in _analysis_cache:
+        return _analysis_cache[directory]
+    report = _analyze_directory_uncached(directory)
+    _analysis_cache[directory] = report
+    return report
+
+
+def _analyze_directory_uncached(directory: str) -> GpuReport | None:
+    report = GpuReport()
+    family_votes: dict[str, int] = {}
+    for path in _iter_py_files(directory):
+        v, text = analyze_file(path)
+        rel = os.path.relpath(path, directory)
+        imports = v.imports if v else set()
+        if v is None and text:
+            # fall back to text heuristics on unparseable files
+            for root in _FRAMEWORK_IMPORTS:
+                if re.search(rf"\bimport {root}\b|\bfrom {root}\b", text):
+                    imports.add(root)
+        for root in imports & set(_FRAMEWORK_IMPORTS):
+            if root not in report.frameworks:
+                report.frameworks.append(root)
+        uses_cuda = bool(_CUDA_TEXT.search(text))
+        if uses_cuda:
+            report.uses_cuda = True
+            report.evidence.append(f"{rel}: CUDA usage")
+        if v and v.backend and not report.distributed_backend:
+            report.distributed_backend = v.backend
+            report.evidence.append(f"{rel}: init_process_group({v.backend!r})")
+        elif not report.distributed_backend and _NCCL_TEXT.search(text) and "nccl" in text:
+            report.distributed_backend = "nccl"
+            report.evidence.append(f"{rel}: nccl reference")
+        if "horovod" in imports and not report.distributed_backend:
+            report.distributed_backend = "horovod"
+        for fam, pat in _MODEL_FAMILY_PATTERNS:
+            if pat.search(text):
+                family_votes[fam] = family_votes.get(fam, 0) + len(pat.findall(text))
+        is_trainingish = (v and v.is_training) or bool(
+            re.search(r"\.backward\(\)|optimizer\.step|loss|train_loop|model\.fit", text)
+        )
+        if is_trainingish and (uses_cuda or imports & {"torch", "tensorflow", "deepspeed", "horovod"}):
+            report.training_scripts.append(path)
+
+    # DeepSpeed config JSON (ZeRO stage, micro batch, parallel sizes)
+    for cfg in common.get_files_by_ext(directory, [".json"]):
+        try:
+            doc = common.read_json(cfg)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if "zero_optimization" in doc or "train_micro_batch_size_per_gpu" in doc:
+            if "deepspeed" not in report.frameworks:
+                report.frameworks.append("deepspeed")
+            zo = doc.get("zero_optimization", {})
+            if isinstance(zo, dict):
+                report.zero_stage = int(zo.get("stage", 0) or 0)
+            report.tensor_parallel = int(
+                doc.get("tensor_parallel", {}).get("tp_size", 1)
+                if isinstance(doc.get("tensor_parallel"), dict) else 1
+            )
+            report.evidence.append(
+                f"{os.path.relpath(cfg, directory)}: deepspeed config (ZeRO-{report.zero_stage})"
+            )
+            if not report.distributed_backend:
+                report.distributed_backend = "nccl"
+
+    # torchrun / launch hints in shell scripts
+    for sh in common.get_files_by_ext(directory, [".sh"]):
+        try:
+            text = open(sh, encoding="utf-8", errors="ignore").read()
+        except OSError:
+            continue
+        m = re.search(r"--nproc[_-]per[_-]node[=\s]+(\d+)", text)
+        if m:
+            report.world_size_hint = max(report.world_size_hint, int(m.group(1)))
+            if not report.distributed_backend:
+                report.distributed_backend = "nccl"
+            report.evidence.append(
+                f"{os.path.relpath(sh, directory)}: torchrun nproc_per_node={m.group(1)}"
+            )
+        m = re.search(r"--num[_-]gpus[=\s]+(\d+)", text)
+        if m:
+            report.world_size_hint = max(report.world_size_hint, int(m.group(1)))
+
+    # decide: is this a GPU training workload?
+    gpu_frameworks = set(report.frameworks) & {"torch", "tensorflow", "deepspeed", "horovod", "cupy"}
+    if not gpu_frameworks:
+        return None
+    if not (report.uses_cuda or report.distributed_backend or "deepspeed" in report.frameworks):
+        return None
+    if not report.training_scripts:
+        return None
+
+    report.model_family = max(family_votes, key=family_votes.get) if family_votes else "generic"
+    report.entrypoint = _pick_entrypoint(report.training_scripts)
+    return report
+
+
+def _pick_entrypoint(scripts: list[str]) -> str:
+    def score(p: str) -> tuple:
+        base = os.path.basename(p).lower()
+        return (
+            0 if "train" in base else (1 if base in ("main.py", "run.py") else 2),
+            p.count(os.sep),
+            p,
+        )
+
+    return sorted(scripts, key=score)[0] if scripts else ""
+
+
+# --- GPU -> TPU topology mapping -------------------------------------------
+
+# (accelerator type, chips per host) — v5e hosts have 4 or 8 chips depending
+# on topology; we use 4 (the 2x2 sub-slice host) for small counts and 2x4
+# hosts for v5e-8 and above. v5p hosts have 4 chips.
+_V5E = "tpu-v5-lite-podslice"
+_V5P = "tpu-v5p-slice"
+
+# gpu_count -> (accelerator, topology, num_hosts)
+_TOPOLOGY_TABLE = [
+    (1, (_V5E, "1x1", 1)),
+    (4, (_V5E, "2x2", 1)),
+    (8, (_V5E, "2x4", 2)),
+    (16, (_V5E, "4x4", 4)),
+    (32, (_V5E, "4x8", 8)),
+    (64, (_V5P, "4x4x4", 16)),
+    (128, (_V5P, "4x4x8", 32)),
+    (256, (_V5P, "4x8x8", 64)),
+]
+
+
+def map_gpu_to_tpu(gpu_count: int, zero_stage: int = 0) -> tuple[str, str, int]:
+    """Choose a TPU slice for a GPU chip count.
+
+    ZeRO-3 / model-parallel workloads (sharded params) prefer v5p for HBM
+    capacity and 3D torus ICI; everything else maps to v5e pod slices.
+    """
+    if gpu_count <= 0:
+        gpu_count = 1
+    for threshold, (acc, topo, hosts) in _TOPOLOGY_TABLE:
+        if gpu_count <= threshold:
+            if zero_stage >= 3 and threshold >= 8:
+                # large sharded model: v5p host groups of 4 chips
+                chips = max(threshold, 8)
+                if chips <= 16:
+                    return (_V5P, "2x2x4", max(1, chips // 4))
+                return (_V5P, "4x4x4", 16)
+            return (acc, topo, hosts)
+    return (_V5P, "4x8x8", 64)
+
+
+def report_to_accelerator(report: GpuReport, gpu_count: int = 0) -> AcceleratorInfo:
+    """Convert an analysis report into plan AcceleratorInfo."""
+    count = gpu_count or report.world_size_hint or 1
+    acc_type, topology, hosts = map_gpu_to_tpu(count, report.zero_stage)
+    parallelism: dict[str, int] = {}
+    if report.zero_stage:
+        parallelism["zero_stage"] = report.zero_stage
+    if report.tensor_parallel > 1:
+        parallelism["tp"] = report.tensor_parallel
+    if report.pipeline_parallel > 1:
+        parallelism["pp"] = report.pipeline_parallel
+    if count > 1:
+        parallelism.setdefault("dp", count)
+    return AcceleratorInfo(
+        gpu_count=count,
+        gpu_vendor="nvidia.com/gpu",
+        frameworks=list(report.frameworks),
+        distributed_backend=report.distributed_backend,
+        parallelism=parallelism,
+        model_family=report.model_family,
+        entrypoint=report.entrypoint,
+        tpu_accelerator=acc_type,
+        tpu_topology=topology,
+        num_hosts=hosts,
+    )
+
+
+def gpu_resources_from_k8s_container(container: dict) -> int:
+    """GPU count requested by a k8s container spec (nvidia.com/gpu et al)."""
+    total = 0
+    resources = container.get("resources", {}) or {}
+    for section in ("limits", "requests"):
+        for key, val in (resources.get(section) or {}).items():
+            if "gpu" in key.lower():
+                try:
+                    total = max(total, int(val))
+                except (TypeError, ValueError):
+                    total = max(total, 1)
+    return total

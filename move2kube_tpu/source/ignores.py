@@ -1,0 +1,57 @@
+""".m2ktignore handling: gitignore-like exclusion for the directory walkers.
+
+Parity: ``internal/source/any2kube.go:151`` (getIgnorePaths) — ignore files
+anywhere in the tree exclude paths relative to their own directory.
+Supported syntax: one pattern per line, ``#`` comments, ``*`` wildcards
+(fnmatch), trailing ``/`` to match directories only.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+
+from move2kube_tpu.utils import common
+
+IGNORE_FILES = (common.IGNORE_FILENAME, *common.LEGACY_IGNORE_FILENAMES)
+
+
+class IgnoreRules:
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        # dir -> list of patterns (relative to that dir)
+        self.rules: dict[str, list[str]] = {}
+        for name in IGNORE_FILES:
+            for path in common.get_files_by_name(self.root, [name]):
+                patterns = []
+                try:
+                    for line in open(path, encoding="utf-8"):
+                        line = line.strip()
+                        if line and not line.startswith("#"):
+                            patterns.append(line)
+                except OSError:
+                    continue
+                if patterns:
+                    self.rules.setdefault(os.path.dirname(path), []).extend(patterns)
+
+    def is_ignored(self, path: str) -> bool:
+        path = os.path.abspath(path)
+        for rule_dir, patterns in self.rules.items():
+            rel = common.relpath_under(path, rule_dir)
+            if rel is None or rel == ".":
+                continue
+            rel_posix = rel.replace(os.sep, "/")
+            for pat in patterns:
+                pat = pat.rstrip("/")
+                if not pat:
+                    continue
+                # match full relative path or any leading component
+                if fnmatch.fnmatch(rel_posix, pat) or fnmatch.fnmatch(
+                    os.path.basename(rel_posix), pat
+                ):
+                    return True
+                parts = rel_posix.split("/")
+                for i in range(1, len(parts)):
+                    if fnmatch.fnmatch("/".join(parts[:i]), pat):
+                        return True
+        return False

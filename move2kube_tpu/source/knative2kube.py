@@ -1,0 +1,32 @@
+"""Knative translator: existing Knative yamls round-trip.
+
+Parity: ``internal/source/knative2kube.go`` — delegates to the Knative
+apiresource set; existing Knative Services are cached and re-emitted
+against the target cluster.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.source.base import Translator
+from move2kube_tpu.source.kube2kube import load_k8s_yamls
+from move2kube_tpu.types import ir as irtypes
+from move2kube_tpu.types.plan import Plan, PlanService, TranslationType
+
+
+class KnativeTranslator(Translator):
+    def get_translation_type(self) -> str:
+        return TranslationType.KNATIVE2KUBE
+
+    def get_service_options(self, plan: Plan) -> list[PlanService]:
+        return []  # planning handled by metadata loader
+
+    def translate(self, services: list[PlanService], plan: Plan) -> irtypes.IR:
+        ir = irtypes.IR(name=plan.name)
+        paths = []
+        for svc in services:
+            paths.extend(svc.source_artifacts.get(PlanService.KNATIVE_ARTIFACT, []))
+        ir.cached_objects.extend(
+            o for o in load_k8s_yamls(paths)
+            if str(o.get("apiVersion", "")).startswith("serving.knative.dev")
+        )
+        return ir

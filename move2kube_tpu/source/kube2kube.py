@@ -1,0 +1,50 @@
+"""Kube2Kube: re-target existing Kubernetes yamls.
+
+Parity: ``internal/source/kube2kube.go`` — planning is handled by the
+K8sFilesLoader metadata loader; translate re-reads the plan's k8s yamls
+into ``ir.cached_objects`` so the apiresource engine converts them to
+cluster-supported kinds/versions at write time.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.source.base import Translator
+from move2kube_tpu.types import ir as irtypes
+from move2kube_tpu.types.plan import Plan, PlanService, TranslationType
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("source.kube2kube")
+
+
+def load_k8s_yamls(paths: list[str]) -> list[dict]:
+    objs = []
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                import yaml
+
+                for doc in yaml.safe_load_all(f):
+                    if isinstance(doc, dict) and doc.get("kind") and doc.get("apiVersion"):
+                        objs.append(doc)
+        except Exception as e:  # noqa: BLE001
+            log.warning("cannot load k8s yaml %s: %s", path, e)
+    return objs
+
+
+class KubeTranslator(Translator):
+    def get_translation_type(self) -> str:
+        return TranslationType.KUBE2KUBE
+
+    def get_service_options(self, plan: Plan) -> list[PlanService]:
+        return []  # planning handled by metadata loader (kube2kube.go:35-38)
+
+    def translate(self, services: list[PlanService], plan: Plan) -> irtypes.IR:
+        ir = irtypes.IR(name=plan.name)
+        paths = []
+        for svc in services:
+            paths.extend(svc.source_artifacts.get(PlanService.K8S_ARTIFACT, []))
+        if not paths:
+            paths = plan.k8s_files
+        ir.cached_objects.extend(load_k8s_yamls(paths))
+        return ir

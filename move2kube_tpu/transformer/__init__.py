@@ -1,0 +1,1 @@
+from move2kube_tpu.transformer.base import get_transformer, write_containers  # noqa: F401

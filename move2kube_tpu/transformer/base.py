@@ -1,0 +1,118 @@
+"""Shared transformer machinery: container file emission + object writing.
+
+Parity: ``internal/transformer/transformer.go`` — ``write_containers``
+dumps every Container's NewFiles under ``<out>/containers/<svc>/`` and
+generates buildimages.sh / copysources.sh / pushimages.sh (:59-160);
+``write_objects`` serializes k8s objects to YAML files (:162);
+``get_transformer`` picks K8s vs Knative by artifact type (:51-56).
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.transformer import templates
+from move2kube_tpu.types.ir import IR
+from move2kube_tpu.types.plan import TargetArtifactType
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("transformer")
+
+
+class Transformer:
+    def transform(self, ir: IR) -> None:
+        raise NotImplementedError
+
+    def write_objects(self, out_dir: str, ir: IR) -> None:
+        raise NotImplementedError
+
+
+def get_transformer(ir: IR) -> "Transformer":
+    from move2kube_tpu.transformer.k8s import K8sTransformer
+    from move2kube_tpu.transformer.knative import KnativeTransformer
+
+    if ir.kubernetes.effective_artifact_type() == TargetArtifactType.KNATIVE:
+        return KnativeTransformer()
+    return K8sTransformer()
+
+
+def write_containers(out_dir: str, ir: IR, root_dir: str = "") -> None:
+    """Emit generated container files + helper scripts (transformer.go:59-160)."""
+    containers_dir = os.path.join(out_dir, common.CONTAINERS_DIR)
+    build_scripts = []
+    copies = []
+    images = []
+    manual = []
+    for container in ir.containers:
+        if not container.new:
+            continue
+        if not container.new_files:
+            if container.image_names:
+                manual.append(container.image_names[0])
+            continue
+        image = container.image_names[0] if container.image_names else "app:latest"
+        svc_name = common.make_dns_label(image.split("/")[-1].split(":")[0])
+        svc_dir = os.path.join(containers_dir, svc_name)
+        for rel_path, contents in container.new_files.items():
+            mode = 0o755 if rel_path.endswith(".sh") else 0o644
+            common.write_file(os.path.join(svc_dir, rel_path), contents, mode)
+            if rel_path.endswith("-build.sh") or rel_path.endswith("build.sh"):
+                build_scripts.append({
+                    "dir": os.path.join(common.CONTAINERS_DIR, svc_name),
+                    "name": rel_path,
+                })
+        # local image name (no registry) for tagging
+        local = image.split("/")[-1]
+        if container.repo_info.git_repo_dir:
+            copies.append({
+                "rel_src": container.repo_info.git_repo_dir,
+                "dst": os.path.join(common.CONTAINERS_DIR, svc_name),
+            })
+        else:
+            copies.append({
+                "rel_src": ".",
+                "dst": os.path.join(common.CONTAINERS_DIR, svc_name),
+            })
+        images.append({"local": local, "remote": local})
+    if build_scripts:
+        common.write_file(
+            os.path.join(out_dir, "buildimages.sh"),
+            common.render_template(templates.BUILD_IMAGES_SH,
+                                   {"build_scripts": build_scripts}),
+            0o755,
+        )
+        common.write_file(
+            os.path.join(out_dir, "copysources.sh"),
+            common.render_template(templates.COPY_SOURCES_SH, {"copies": copies}),
+            0o755,
+        )
+    if images:
+        common.write_file(
+            os.path.join(out_dir, "pushimages.sh"),
+            common.render_template(templates.PUSH_IMAGES_SH, {
+                "registry_url": ir.kubernetes.registry_url or common.DEFAULT_REGISTRY_URL,
+                "registry_namespace": ir.kubernetes.registry_namespace or ir.name,
+                "images": images,
+            }),
+            0o755,
+        )
+    if manual:
+        common.write_file(
+            os.path.join(out_dir, "Manualimages.md"),
+            common.render_template(templates.MANUAL_IMAGES_MD, {"services": manual}),
+        )
+
+
+def write_objects(objs: list[dict], yaml_dir: str) -> list[str]:
+    """One YAML file per object: <name>-<kind>.yaml (transformer.go:162)."""
+    os.makedirs(yaml_dir, exist_ok=True)
+    written = []
+    for obj in objs:
+        kind = obj.get("kind", "object").lower()
+        name = obj.get("metadata", {}).get("name", "unnamed")
+        fname = f"{common.make_dns_label(name)}-{kind}.yaml"
+        path = os.path.join(yaml_dir, fname)
+        common.write_yaml(path, obj)
+        written.append(path)
+    return written

@@ -1,0 +1,147 @@
+"""CI/CD transformer: Tekton pipeline for building the new images.
+
+Parity: ``internal/transformer/cicdtransformer.go`` + ``internal/
+apiresourceset/tektonapiresourceset.go`` (setupIR :101-240) + the Tekton
+apiresource quad — a git-clone + kaniko Pipeline per project, with the
+EventListener / TriggerBinding / TriggerTemplate chain, registry secret,
+service account and RBAC, written under ``<out>/cicd/``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.apiresource.base import make_obj
+from move2kube_tpu.transformer.base import Transformer, write_objects
+from move2kube_tpu.types.ir import IR
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("transformer.cicd")
+
+
+class CICDTransformer(Transformer):
+    def __init__(self) -> None:
+        self.objs: list[dict] = []
+
+    def transform(self, ir: IR) -> None:
+        proj = common.make_dns_label(ir.name)
+        new_images = [c.image_names[0] for c in ir.containers if c.new and c.image_names]
+        if not new_images:
+            self.objs = []
+            return
+        prefix = proj + "-clone-build-push"
+        pipeline_name = prefix + "-pipeline"
+        sa_name = prefix + "-sa"
+        registry_secret = prefix + "-registry-secret"
+        git_event_secret = prefix + "-git-event-secret"
+
+        tasks = []
+        for i, image in enumerate(new_images):
+            tasks.append({
+                "name": f"build-push-{i}",
+                "taskRef": {"name": "kaniko"},
+                "runAfter": ["clone"] if i == 0 else [f"build-push-{i-1}"],
+                "params": [
+                    {"name": "IMAGE", "value": image},
+                    {"name": "CONTEXT", "value": "."},
+                ],
+                "workspaces": [{"name": "source", "workspace": "shared-data"}],
+            })
+        pipeline = make_obj("Pipeline", "tekton.dev/v1beta1", pipeline_name)
+        pipeline["spec"] = {
+            "params": [
+                {"name": "git-repo-url", "type": "string"},
+                {"name": "git-revision", "type": "string", "default": "main"},
+            ],
+            "workspaces": [{"name": "shared-data"}],
+            "tasks": [{
+                "name": "clone",
+                "taskRef": {"name": "git-clone"},
+                "params": [
+                    {"name": "url", "value": "$(params.git-repo-url)"},
+                    {"name": "revision", "value": "$(params.git-revision)"},
+                ],
+                "workspaces": [{"name": "output", "workspace": "shared-data"}],
+            }] + tasks,
+        }
+
+        trigger_template = make_obj("TriggerTemplate", "triggers.tekton.dev/v1alpha1",
+                                    prefix + "-triggertemplate")
+        trigger_template["spec"] = {
+            "params": [{"name": "git-repo-url"}, {"name": "git-revision"}],
+            "resourcetemplates": [{
+                "apiVersion": "tekton.dev/v1beta1",
+                "kind": "PipelineRun",
+                "metadata": {"generateName": pipeline_name + "-run-"},
+                "spec": {
+                    "serviceAccountName": sa_name,
+                    "pipelineRef": {"name": pipeline_name},
+                    "params": [
+                        {"name": "git-repo-url", "value": "$(tt.params.git-repo-url)"},
+                        {"name": "git-revision", "value": "$(tt.params.git-revision)"},
+                    ],
+                    "workspaces": [{
+                        "name": "shared-data",
+                        "volumeClaimTemplate": {"spec": {
+                            "accessModes": ["ReadWriteOnce"],
+                            "resources": {"requests": {"storage": "1Gi"}},
+                        }},
+                    }],
+                },
+            }],
+        }
+
+        trigger_binding = make_obj("TriggerBinding", "triggers.tekton.dev/v1alpha1",
+                                   prefix + "-triggerbinding")
+        trigger_binding["spec"] = {
+            "params": [
+                {"name": "git-repo-url", "value": "$(body.repository.clone_url)"},
+                {"name": "git-revision", "value": "$(body.head_commit.id)"},
+            ],
+        }
+
+        event_listener = make_obj("EventListener", "triggers.tekton.dev/v1alpha1",
+                                  prefix + "-eventlistener")
+        event_listener["spec"] = {
+            "serviceAccountName": sa_name,
+            "triggers": [{
+                "name": prefix + "-trigger",
+                "bindings": [{"ref": trigger_binding["metadata"]["name"]}],
+                "template": {"ref": trigger_template["metadata"]["name"]}},
+            ],
+        }
+
+        registry_sec = make_obj("Secret", "v1", registry_secret)
+        registry_sec["type"] = "kubernetes.io/dockerconfigjson"
+        registry_sec["stringData"] = {".dockerconfigjson": '{"auths": {}}'}
+        git_sec = make_obj("Secret", "v1", git_event_secret)
+        git_sec["stringData"] = {"secretToken": "m2kt-webhook-token"}
+
+        sa = make_obj("ServiceAccount", "v1", sa_name)
+        sa["secrets"] = [{"name": registry_secret}]
+        role = make_obj("Role", "rbac.authorization.k8s.io/v1", prefix + "-role")
+        role["rules"] = [
+            {"apiGroups": ["triggers.tekton.dev"],
+             "resources": ["eventlisteners", "triggerbindings", "triggertemplates"],
+             "verbs": ["get"]},
+            {"apiGroups": ["tekton.dev"],
+             "resources": ["pipelineruns", "pipelineresources", "taskruns"],
+             "verbs": ["create"]},
+        ]
+        binding = make_obj("RoleBinding", "rbac.authorization.k8s.io/v1",
+                           prefix + "-rolebinding")
+        binding["subjects"] = [{"kind": "ServiceAccount", "name": sa_name}]
+        binding["roleRef"] = {"kind": "Role", "name": role["metadata"]["name"],
+                              "apiGroup": "rbac.authorization.k8s.io"}
+
+        self.objs = [pipeline, trigger_template, trigger_binding, event_listener,
+                     registry_sec, git_sec, sa, role, binding]
+        ir.tekton.pipelines = [pipeline]
+        ir.tekton.event_listeners = [event_listener]
+        ir.tekton.trigger_bindings = [trigger_binding]
+        ir.tekton.trigger_templates = [trigger_template]
+
+    def write_objects(self, out_dir: str, ir: IR) -> None:
+        if self.objs:
+            write_objects(self.objs, os.path.join(out_dir, common.CICD_DIR))

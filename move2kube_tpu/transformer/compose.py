@@ -1,0 +1,50 @@
+"""Compose transformer: IR -> docker-compose.yaml for local validation.
+
+Parity: ``internal/transformer/composetransformer.go:48-103`` — v3.5
+document, sequential published ports starting at 8080.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.transformer.base import Transformer
+from move2kube_tpu.types.ir import IR
+from move2kube_tpu.utils import common
+
+
+class ComposeTransformer(Transformer):
+    def __init__(self) -> None:
+        self.doc: dict = {}
+
+    def transform(self, ir: IR) -> None:
+        services = {}
+        next_port = 8080
+        for name, svc in sorted(ir.services.items()):
+            if not svc.containers:
+                continue
+            c = svc.containers[0]
+            entry: dict = {"image": c.get("image", name + ":latest")}
+            if c.get("command"):
+                entry["entrypoint"] = c["command"]
+            if c.get("args"):
+                entry["command"] = c["args"]
+            env = c.get("env")
+            if env:
+                entry["environment"] = {e["name"]: e.get("value", "") for e in env}
+            ports = []
+            for pf in svc.port_forwardings:
+                ports.append(f"{next_port}:{pf.container_port}")
+                next_port += 1
+            if ports:
+                entry["ports"] = ports
+            if svc.restart_policy == "Never":
+                entry["restart"] = "no"
+            elif svc.restart_policy == "OnFailure":
+                entry["restart"] = "on-failure"
+            services[name] = entry
+        self.doc = {"version": "3.5", "services": services}
+
+    def write_objects(self, out_dir: str, ir: IR) -> None:
+        if self.doc.get("services"):
+            common.write_yaml(os.path.join(out_dir, "docker-compose.yaml"), self.doc)

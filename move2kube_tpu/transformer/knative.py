@@ -1,0 +1,55 @@
+"""Knative transformer.
+
+Parity: ``internal/transformer/knativetransformer.go:46-100`` +
+``internal/apiresourceset/knativeapiresourceset.go`` — one Knative Service
+per IR service, deploy script, README.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.transformer import templates
+from move2kube_tpu.transformer.base import Transformer, write_containers, write_objects
+from move2kube_tpu.types.ir import IR
+from move2kube_tpu.utils import common
+
+
+class KnativeTransformer(Transformer):
+    def __init__(self) -> None:
+        self.objs: list[dict] = []
+
+    def transform(self, ir: IR) -> None:
+        self.objs = []
+        for svc in ir.services.values():
+            if not svc.containers or svc.job:
+                continue
+            obj = {
+                "apiVersion": "serving.knative.dev/v1",
+                "kind": "Service",
+                "metadata": {"name": svc.name},
+                "spec": {"template": {"spec": {
+                    "containers": [dict(c) for c in svc.containers],
+                }}},
+            }
+            self.objs.append(obj)
+        # pass through cached knative objects
+        for obj in ir.cached_objects:
+            if str(obj.get("apiVersion", "")).startswith("serving.knative.dev"):
+                if obj not in self.objs:
+                    self.objs.append(obj)
+
+    def write_objects(self, out_dir: str, ir: IR) -> None:
+        proj = common.make_dns_label(ir.name)
+        write_containers(out_dir, ir)
+        write_objects(self.objs, os.path.join(out_dir, proj))
+        common.write_file(
+            os.path.join(out_dir, "deploy.sh"),
+            common.render_template(templates.DEPLOY_SH, {"yaml_dir": proj}),
+            0o755,
+        )
+        common.write_file(
+            os.path.join(out_dir, "README.md"),
+            common.render_template(templates.KNATIVE_README_MD,
+                                   {"project": ir.name, "yaml_dir": proj}),
+        )

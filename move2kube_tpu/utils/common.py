@@ -108,12 +108,38 @@ _M2KTDumper.add_representer(str, _str_presenter)
 
 
 def to_yaml(obj: Any) -> str:
-    return yaml.dump(obj, Dumper=_M2KTDumper, default_flow_style=False, sort_keys=False)
+    # width: keep Helm {{ ... }} expressions on one line — folded scalars
+    # technically survive Go template parsing but are fragile and unreadable
+    return yaml.dump(obj, Dumper=_M2KTDumper, default_flow_style=False,
+                     sort_keys=False, width=1000)
+
+
+# Parse cache keyed by (path, mtime, size): plan-time consumers (compose
+# finder, metadata loaders, collectors) each scan the same tree; the walks
+# are cheap but re-parsing every YAML 3x is not.
+_yaml_cache: dict[str, tuple[tuple[float, int], Any]] = {}
 
 
 def read_yaml(path: str) -> Any:
+    path = os.path.abspath(path)
+    try:
+        st = os.stat(path)
+        stamp = (st.st_mtime, st.st_size)
+    except OSError:
+        stamp = None
+    import copy
+
+    if stamp is not None:
+        hit = _yaml_cache.get(path)
+        if hit is not None and hit[0] == stamp:
+            return copy.deepcopy(hit[1])  # callers may mutate their copy
     with open(path, "r", encoding="utf-8") as f:
-        return yaml.safe_load(f)
+        doc = yaml.safe_load(f)
+    if stamp is not None:
+        if len(_yaml_cache) > 4096:
+            _yaml_cache.clear()
+        _yaml_cache[path] = (stamp, copy.deepcopy(doc))
+    return doc
 
 
 def write_yaml(path: str, obj: Any) -> None:

@@ -1,0 +1,3 @@
+module github.com/example/sample-go
+
+go 1.22

@@ -1,0 +1,138 @@
+"""End-to-end plan+translate over the bundled samples (schema-level
+validation of emitted YAML) — the harness the reference never had
+(SURVEY.md §4)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SAMPLES = os.path.join(REPO, "samples")
+
+
+def run_cli(*args, cwd):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "move2kube_tpu.cli.main", *args],
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+def load_all_yamls(directory):
+    objs = []
+    for dirpath, _dirs, files in os.walk(directory):
+        for f in files:
+            if f.endswith((".yaml", ".yml")):
+                with open(os.path.join(dirpath, f)) as fh:
+                    objs.extend(d for d in yaml.safe_load_all(fh) if isinstance(d, dict))
+    return objs
+
+
+def kinds(objs):
+    return {o.get("kind") for o in objs}
+
+
+def by_kind(objs, kind):
+    return [o for o in objs if o.get("kind") == kind]
+
+
+def test_plan_cli(tmp_path):
+    res = run_cli("plan", "-s", os.path.join(SAMPLES, "python"), cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    plan = yaml.safe_load(open(tmp_path / "m2kt.plan"))
+    assert plan["kind"] == "Plan"
+    assert "python" in plan["spec"]["inputs"]["services"]
+
+
+def test_translate_python_sample(tmp_path):
+    res = run_cli("translate", "-s", os.path.join(SAMPLES, "python"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    out = tmp_path / "out"
+    # containers: generated Dockerfile + build script
+    dockerfile = out / "containers" / "python" / "Dockerfile.python"
+    assert dockerfile.exists()
+    assert "FROM python" in dockerfile.read_text()
+    assert (out / "buildimages.sh").exists()
+    # k8s yamls
+    objs = load_all_yamls(str(out / "python"))
+    assert kinds(objs) >= {"Deployment", "Service", "Ingress"}
+    dep = by_kind(objs, "Deployment")[0]
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["ports"][0]["containerPort"] == 8080
+    assert dep["spec"]["replicas"] == 2
+    svc = by_kind(objs, "Service")[0]
+    assert svc["spec"]["ports"][0]["port"] == 8080
+    # cicd
+    cicd_objs = load_all_yamls(str(out / "cicd"))
+    assert "Pipeline" in kinds(cicd_objs)
+
+
+def test_translate_dockerfile_sample(tmp_path):
+    res = run_cli("translate", "-s", os.path.join(SAMPLES, "dockerfile-app"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    objs = load_all_yamls(str(tmp_path / "out"))
+    deps = by_kind(objs, "Deployment")
+    assert deps, "expected a Deployment from the Dockerfile service"
+    c = deps[0]["spec"]["template"]["spec"]["containers"][0]
+    assert c["ports"][0]["containerPort"] == 3000  # from EXPOSE
+
+
+def test_translate_compose_sample(tmp_path):
+    res = run_cli("translate", "-s", os.path.join(SAMPLES, "docker-compose"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    objs = load_all_yamls(str(tmp_path / "out"))
+    names = {o["metadata"]["name"]: o for o in objs if o.get("kind") == "Deployment"}
+    assert "web" in names
+    web = names["web"]
+    containers = web["spec"]["template"]["spec"]["containers"]
+    assert containers[0]["image"] == "nginx:1.25"
+    # healthcheck -> readiness probe on api
+    assert "api" in names
+    api_c = names["api"]["spec"]["template"]["spec"]["containers"][0]
+    assert "readinessProbe" in api_c
+    # volumes: named volume -> PVC
+    pvcs = by_kind(objs, "PersistentVolumeClaim")
+    assert any(p["metadata"]["name"] == "webdata" for p in pvcs)
+    # GPU compose service -> TPU workload (Job or JobSet), not a Deployment
+    trainer = [o for o in objs
+               if o.get("metadata", {}).get("name") == "trainer"
+               and o.get("kind") in ("Job", "JobSet")]
+    assert trainer, f"trainer should be a TPU Job/JobSet, kinds: {kinds(objs)}"
+
+
+def test_plan_detects_gpu_training(tmp_path):
+    res = run_cli("plan", "-s", os.path.join(SAMPLES, "gpu-training"), cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    plan = yaml.safe_load(open(tmp_path / "m2kt.plan"))
+    svcs = plan["spec"]["inputs"]["services"]
+    assert "resnet" in svcs
+    opts = svcs["resnet"]
+    jax_opts = [o for o in opts if o["containerBuildType"] == "JaxXla"]
+    assert jax_opts, f"expected JaxXla option, got {[o['containerBuildType'] for o in opts]}"
+    acc = jax_opts[0]["accelerator"]
+    assert acc["distributedBackend"] == "nccl"
+    assert acc["modelFamily"] == "resnet"
+    assert acc["gpuCount"] == 8
+    assert acc["tpuTopology"] == "2x4"
+    # TPU cluster auto-selected
+    assert plan["spec"]["outputs"]["kubernetes"]["targetCluster"]["type"] == "GCP-GKE-TPU"
+
+
+def test_qa_cache_replay(tmp_path):
+    # first run writes the cache; second run replays it
+    res = run_cli("translate", "-s", os.path.join(SAMPLES, "python"),
+                  "-o", "out1", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    cache = tmp_path / "out1" / "m2ktqacache.yaml"
+    assert cache.exists()
+    res2 = run_cli("translate", "-s", os.path.join(SAMPLES, "python"),
+                   "-o", "out2", "--qa-skip", "--qa-cache", str(cache),
+                   cwd=str(tmp_path))
+    assert res2.returncode == 0, res2.stderr
+    assert (tmp_path / "out2" / "python" / "python-deployment.yaml").exists()
