@@ -1,0 +1,38 @@
+"""jax-xla containerizer: rewrite GPU training services into JAX TPU images.
+
+The north-star containerizer (net-new vs the reference; see BASELINE.json):
+directories whose Python sources use CUDA/NCCL/DeepSpeed are claimed here
+and re-emitted as TPU-VM images whose entrypoint is a generated JAX
+training program from the model zoo (``move2kube_tpu.models``), with
+``jax.distributed.initialize`` bootstrap honoring JobSet env indexing.
+
+Detection lives in ``move2kube_tpu.source.gpu_detect``; emission templates
+in ``move2kube_tpu/assets/jax/``.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.containerizer.base import Containerizer
+from move2kube_tpu.types.ir import Container
+from move2kube_tpu.types.plan import ContainerBuildType, PlanService
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("containerizer.jaxxla")
+
+
+class JaxXlaContainerizer(Containerizer):
+    def get_build_type(self) -> str:
+        return ContainerBuildType.JAX_XLA
+
+    def get_target_options(self, plan, directory: str) -> list[str]:
+        from move2kube_tpu.source import gpu_detect
+
+        report = gpu_detect.analyze_directory(directory)
+        if report is None:
+            return []
+        return [report.model_family or "generic"]
+
+    def get_container(self, plan, service: PlanService) -> Container:
+        from move2kube_tpu.containerizer import jax_emit
+
+        return jax_emit.emit_container(service, plan)

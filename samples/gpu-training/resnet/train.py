@@ -1,0 +1,20 @@
+"""Sample CUDA training script (detection target for the TPU translator)."""
+import torch
+import torch.distributed as dist
+import torchvision.models as models
+
+def main():
+    dist.init_process_group(backend="nccl")
+    model = models.resnet50().cuda()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.1)
+    model = torch.nn.parallel.DistributedDataParallel(model)
+    for step in range(100):
+        x = torch.randn(64, 3, 224, 224).cuda()
+        y = torch.randint(0, 1000, (64,)).cuda()
+        loss = torch.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        optimizer.step()
+        optimizer.zero_grad()
+
+if __name__ == "__main__":
+    main()
