@@ -1,0 +1,18 @@
+"""JAX/Flax model zoo: the curated TPU programs the jax-xla containerizer
+emits for detected GPU training workloads (BASELINE configs 2/3/5), and the
+flagship models for bench.py / __graft_entry__.py.
+
+Dependency-light on purpose (jax / flax / optax / numpy only): this package
+is vendored verbatim into emitted training images (containerizer/jax_emit.py).
+
+Families map detected workloads to curated programs (SURVEY.md §7 "template
+zoo" approach — mirror of how the reference containerizes via curated
+per-stack templates rather than general build inference):
+
+- ``resnet``  — torchvision ResNet-50 CUDA scripts -> models.resnet
+- ``bert``    — HF BERT fine-tunes (torch.distributed/NCCL) -> models.bert
+- ``llama``/``gpt`` — DeepSpeed ZeRO-3 decoder LMs -> models.llama (FSDP+TP)
+- ``generic`` — unrecognised: MLP scaffold the user fills in
+"""
+
+from move2kube_tpu.models import bert, llama, resnet, train  # noqa: F401

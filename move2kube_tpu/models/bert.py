@@ -1,0 +1,97 @@
+"""BERT encoder in Flax, TPU-first.
+
+Emission target for detected HF BERT fine-tunes over torch.distributed/NCCL
+(BASELINE config 3: "HF BERT-base fine-tune -> v5e-8 JobSet").
+
+TPU notes: bfloat16 activations, float32 layernorm/softmax accumulation,
+fused QKV projection (one MXU matmul instead of three), sequence lengths
+padded to multiples of 128 to match lane tiling.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BertSelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        qkv = nn.Dense(3 * d_model, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(*t.shape[:-1], self.num_heads, head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        s = s * (head_dim ** -0.5)
+        if mask is not None:
+            s = jnp.where(mask[:, None, None, :], s, -1e30)
+        p = nn.softmax(s, axis=-1).astype(self.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        o = o.reshape(*o.shape[:-2], d_model)
+        return nn.Dense(d_model, dtype=self.dtype, name="out")(o)
+
+
+class BertLayer(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        y = BertSelfAttention(self.num_heads, dtype=self.dtype)(x, mask)
+        x = nn.LayerNorm(dtype=jnp.float32)(x + y)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1], dtype=self.dtype)(y)
+        return nn.LayerNorm(dtype=jnp.float32)(x + y)
+
+
+class BertEncoder(nn.Module):
+    vocab_size: int = 30522
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    mlp_dim: int = 3072
+    max_len: int = 512
+    num_classes: int = 2  # sequence classification head (fine-tune target)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        b, s = input_ids.shape
+        tok = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                       name="tok_embed")(input_ids)
+        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                       name="pos_embed")(jnp.arange(s)[None, :])
+        seg = 0
+        if token_type_ids is not None:
+            seg = nn.Embed(2, self.d_model, dtype=self.dtype,
+                           name="seg_embed")(token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32)(tok + pos + seg)
+        mask = attention_mask if attention_mask is not None else jnp.ones((b, s), bool)
+        for _ in range(self.num_layers):
+            x = BertLayer(self.num_heads, self.mlp_dim, dtype=self.dtype)(x, mask)
+        cls = x[:, 0]
+        pooled = jnp.tanh(nn.Dense(self.d_model, dtype=jnp.float32, name="pooler")(cls.astype(jnp.float32)))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(pooled)
+
+
+def bert_base(num_classes: int = 2, dtype=jnp.bfloat16) -> BertEncoder:
+    return BertEncoder(num_classes=num_classes, dtype=dtype)
+
+
+def bert_tiny(num_classes: int = 2, dtype=jnp.bfloat16) -> BertEncoder:
+    """Small variant for tests/dry-runs."""
+    return BertEncoder(vocab_size=1024, num_layers=2, num_heads=2, d_model=64,
+                       mlp_dim=128, max_len=128, num_classes=num_classes,
+                       dtype=dtype)

@@ -1,0 +1,82 @@
+"""ResNet-50 in Flax, TPU-first.
+
+Emission target for detected torchvision/CUDA ResNet training scripts
+(BASELINE config 2: "PyTorch ResNet-50 CUDA train.py -> jax-xla
+containerizer, single v5e chip").
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bfloat16 compute
+with float32 params/accumulation, batch norm in float32 for stability. Convs
+lower onto the MXU; XLA fuses the BN+ReLU chains into them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import field
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = lambda: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32,
+        )
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features * 4, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = field(default_factory=lambda: [3, 4, 6, 3])
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(self.width * 2 ** i, strides=strides,
+                                    dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], num_classes=num_classes, dtype=dtype)
+
+
+def resnet18_ish(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    """Small variant for tests/dry-runs (still bottleneck blocks)."""
+    return ResNet(stage_sizes=[1, 1, 1, 1], width=16, num_classes=num_classes,
+                  dtype=dtype)

@@ -1,0 +1,178 @@
+"""Shared training machinery: sharded state creation + train steps.
+
+The emitted training programs (containerizer/jax_emit.py templates) and
+bench.py both drive these. Everything compiles once under jit: sharded init
+via ``eval_shape`` (no host-side giant arrays), train steps with donated
+state, sharding-constrained batches, and loss in float32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from move2kube_tpu.parallel.sharding import ShardingRules, infer_param_axes
+
+
+class TrainState(train_state.TrainState):
+    batch_stats: Any = None  # BatchNorm stats (ResNet); None elsewhere
+
+
+def _mesh_context(mesh: Mesh):
+    """Context that makes bare PartitionSpecs resolvable inside traced code
+    (models annotate activations with P(...) without threading the mesh)."""
+    use_mesh = getattr(jax.sharding, "use_mesh", None) or getattr(jax, "set_mesh", None)
+    return use_mesh(mesh) if use_mesh is not None else mesh
+
+
+def _with_mesh(mesh: Mesh, fn: Callable) -> Callable:
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with _mesh_context(mesh):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def cross_entropy_loss(logits, labels) -> jax.Array:
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def lm_loss(logits, input_ids) -> jax.Array:
+    """Next-token prediction loss."""
+    return cross_entropy_loss(logits[:, :-1], input_ids[:, 1:])
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(("data", "fsdp")))
+
+
+def create_sharded_state(
+    rng: jax.Array,
+    model,
+    sample_input: dict,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    rules: ShardingRules | None = None,
+    has_batch_stats: bool = False,
+) -> TrainState:
+    """Initialize params directly into their shards (ZeRO-3-style): shapes
+    come from eval_shape, shardings from the logical-axis heuristic, and the
+    actual init runs under jit with those out_shardings so no device ever
+    materialises the full tree."""
+    rules = rules or ShardingRules.default()
+
+    def init_fn(rng):
+        variables = model.init(rng, **sample_input)
+        return variables
+
+    with _mesh_context(mesh):
+        shapes = jax.eval_shape(init_fn, rng)
+    params_axes = infer_param_axes(shapes["params"])
+    param_shardings = jax.tree.map(
+        lambda axes: NamedSharding(mesh, rules.spec(axes)) if isinstance(axes, tuple)
+        else NamedSharding(mesh, P()),
+        params_axes,
+        is_leaf=lambda x: isinstance(x, tuple) or x is None,
+    )
+    out_shardings = {"params": param_shardings}
+    if has_batch_stats and "batch_stats" in shapes:
+        out_shardings["batch_stats"] = jax.tree.map(
+            lambda _: NamedSharding(mesh, P()), shapes["batch_stats"]
+        )
+    with _mesh_context(mesh):
+        variables = jax.jit(init_fn, out_shardings=out_shardings)(rng)
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        tx=tx,
+        batch_stats=variables.get("batch_stats"),
+    )
+
+
+def make_classifier_train_step(mesh: Mesh, has_batch_stats: bool = False):
+    """Train step for image/sequence classifiers (ResNet, BERT)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, batch: dict):
+        x = jax.lax.with_sharding_constraint(
+            batch["input"], NamedSharding(mesh, P(("data", "fsdp"))))
+        y = batch["label"]
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if has_batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                logits, updates = state.apply_fn(
+                    variables, x, mutable=["batch_stats"])
+                return cross_entropy_loss(logits, y), updates["batch_stats"]
+            logits = state.apply_fn(variables, x)
+            return cross_entropy_loss(logits, y), None
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        state = state.apply_gradients(grads=grads)
+        if has_batch_stats:
+            state = state.replace(batch_stats=new_stats)
+        return state, loss
+
+    return _with_mesh(mesh, step)
+
+
+def make_bert_train_step(mesh: Mesh):
+    """Fine-tune step for BertEncoder (input_ids/attention_mask/label)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, batch: dict):
+        sh = NamedSharding(mesh, P(("data", "fsdp")))
+        ids = jax.lax.with_sharding_constraint(batch["input_ids"], sh)
+        mask = batch.get("attention_mask")
+
+        def loss_fn(params):
+            logits = state.apply_fn({"params": params}, ids, mask)
+            return cross_entropy_loss(logits, batch["label"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    return _with_mesh(mesh, step)
+
+
+def make_lm_train_step(mesh: Mesh, remat: bool = True):
+    """Next-token-prediction step for Llama-class models; rematerialises
+    per-block activations (jax.checkpoint) to trade FLOPs for HBM."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, batch: dict):
+        ids = jax.lax.with_sharding_constraint(
+            batch["input_ids"], NamedSharding(mesh, P(("data", "fsdp"))))
+
+        def loss_fn(params):
+            apply = state.apply_fn
+            if remat:
+                apply = jax.checkpoint(apply)
+            logits = apply({"params": params}, ids)
+            return lm_loss(logits, ids)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    return _with_mesh(mesh, step)
+
+
+def default_optimizer(lr: float = 1e-3, weight_decay: float = 0.0,
+                      warmup_steps: int = 100,
+                      total_steps: int = 10000) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps, max(total_steps, warmup_steps + 1))
+    if weight_decay:
+        return optax.adamw(schedule, weight_decay=weight_decay)
+    return optax.adam(schedule)

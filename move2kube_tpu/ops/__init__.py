@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the hot ops, with jnp fallbacks off-TPU.
+
+XLA fuses most elementwise chains into the MXU matmuls already; kernels
+live here only where fusion can't reach: flash attention (blockwise
+softmax-matmul with online normalisation keeps the [s, s] score matrix out
+of HBM entirely).
+"""
+
+from move2kube_tpu.ops.attention import flash_attention  # noqa: F401

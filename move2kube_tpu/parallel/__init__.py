@@ -1,0 +1,22 @@
+"""Distributed TPU execution: meshes, shardings, collectives, ring attention.
+
+This package (with ``models`` and ``ops``) is dependency-light by design —
+jax / flax / optax / numpy only — because the jax-xla containerizer vendors
+it into every emitted training image (see containerizer/jax_emit.py).
+
+Design follows the scaling-book recipe: pick a Mesh, annotate shardings
+with NamedSharding/PartitionSpec, let XLA insert the collectives, and keep
+ICI-heavy axes (tensor/sequence) innermost so collectives ride ICI, not DCN.
+"""
+
+from move2kube_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    make_mesh,
+    initialize_distributed,
+)
+from move2kube_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    logical_sharding,
+    shard_params,
+    with_logical_constraint,
+)

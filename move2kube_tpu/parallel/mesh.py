@@ -1,0 +1,106 @@
+"""Device mesh construction and multi-host bootstrap.
+
+The mesh axes follow the standard TPU recipe (scaling-book):
+
+- ``data``   — pure data parallelism; gradients all-reduced (psum) over ICI/DCN
+- ``fsdp``   — data parallelism with parameter/optimizer sharding
+               (ZeRO-3 equivalent); params all-gathered per layer
+- ``tensor`` — tensor (megatron-style) model parallelism; activations
+               all-reduced per block, so this axis must sit on ICI
+- ``seq``    — sequence/context parallelism for ring attention
+
+The GPU->TPU translation maps: DDP -> data, DeepSpeed ZeRO-3 -> fsdp,
+Megatron TP -> tensor, DeepSpeed-Ulysses / context parallel -> seq
+(SURVEY.md §5 long-context mapping).
+
+Multi-host bootstrap honors the env the TPU apiresources inject into
+JobSet pods (containerizer/jax_emit.py writes the consumer side).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # jax is imported lazily: the CLI emit path only needs
+    from jax.sharding import Mesh  # MeshConfig/infer_mesh_config (pure python)
+
+
+@dataclass
+class MeshConfig:
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+
+    AXES = ("data", "fsdp", "tensor", "seq")
+
+    def total(self) -> int:
+        return self.data * self.fsdp * self.tensor * self.seq
+
+    def dims(self) -> tuple[int, int, int, int]:
+        return (self.data, self.fsdp, self.tensor, self.seq)
+
+
+def infer_mesh_config(n_devices: int, *, zero_stage: int = 0,
+                      tensor_parallel: int = 1, seq_parallel: int = 1) -> MeshConfig:
+    """Choose mesh dims for a device count + detected GPU parallelism.
+
+    ZeRO>=2 maps the whole data dimension to fsdp; tensor/seq parallel
+    claim their factors first (innermost, so they land on adjacent ICI
+    neighbours); the remainder is data (or fsdp) parallel.
+    """
+    tensor = max(1, tensor_parallel)
+    seq = max(1, seq_parallel)
+    if n_devices % (tensor * seq):
+        tensor = seq = 1  # fall back to pure data parallel
+    rest = n_devices // (tensor * seq)
+    if zero_stage >= 2:
+        return MeshConfig(data=1, fsdp=rest, tensor=tensor, seq=seq)
+    return MeshConfig(data=rest, fsdp=1, tensor=tensor, seq=seq)
+
+
+def make_mesh(config: MeshConfig | None = None, devices=None) -> "Mesh":
+    """Build a 4-axis Mesh; axes of size 1 still exist (cheap, simplifies
+    PartitionSpecs — XLA drops trivial collectives)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    config = config or MeshConfig(data=len(devices))
+    if config.total() != len(devices):
+        raise ValueError(
+            f"mesh {config.dims()} needs {config.total()} devices, have {len(devices)}"
+        )
+    dev_array = np.asarray(devices).reshape(config.dims())
+    return Mesh(dev_array, MeshConfig.AXES)
+
+
+def initialize_distributed() -> None:
+    """Multi-host bootstrap from JobSet/indexed-Job env.
+
+    The TPU apiresources inject:
+      M2KT_COORDINATOR   - headless-service DNS of pod 0 (host:port)
+      M2KT_NUM_HOSTS     - total host count
+      JOB_COMPLETION_INDEX - this host's index (k8s indexed jobs)
+    On GKE TPU node pools jax.distributed can also self-discover; explicit
+    env wins so the same image runs under any indexed-job controller.
+    """
+    import jax
+
+    num_hosts = int(os.environ.get("M2KT_NUM_HOSTS", "1"))
+    if num_hosts <= 1:
+        return
+    coordinator = os.environ.get("M2KT_COORDINATOR", "")
+    index = int(os.environ.get("JOB_COMPLETION_INDEX",
+                               os.environ.get("M2KT_HOST_INDEX", "0")))
+    if coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_hosts,
+            process_id=index,
+        )
+    else:
+        jax.distributed.initialize()

@@ -1,0 +1,100 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+Context parallelism for long sequences (the TPU-native answer to
+DeepSpeed-Ulysses / Megatron CP, SURVEY.md §5): Q stays local, K/V blocks
+rotate around the ``seq`` mesh axis via ``ppermute`` so each step overlaps
+a neighbour exchange with a blockwise attention update. Online-softmax
+accumulation (running max + weighted sums) keeps the result exact.
+
+Used inside ``shard_map`` over a mesh with a non-trivial ``seq`` axis; for
+seq=1 meshes it degrades to one local block (no collectives).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn(q, k, v, bias, scale):
+    """One blockwise attention step -> (unnormalized out, row max, row sum)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)  # [b,h,q,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = False,
+                   scale: float | None = None):
+    """Exact attention with K/V rotating around ``axis_name``.
+
+    Args:
+      q, k, v: [batch, seq_shard, heads, head_dim] local shards.
+      causal: causal masking consistent with the global sequence order
+        (shard i holds positions [i*S, (i+1)*S)).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    axis_size = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    seq_len = q.shape[1]
+
+    def mask_bias(kv_idx):
+        if not causal:
+            return None
+        q_pos = my_idx * seq_len + jnp.arange(seq_len)[:, None]
+        k_pos = kv_idx * seq_len + jnp.arange(seq_len)[None, :]
+        return jnp.where(q_pos >= k_pos, 0.0, -1e30)[None, None]  # [1,1,q,k]
+
+    def step(carry, _):
+        o_acc, m_acc, l_acc, k_cur, v_cur, kv_idx = carry
+        o_b, m_b, l_b = _block_attn(q, k_cur, v_cur, mask_bias(kv_idx), scale)
+        # online softmax merge
+        m_new = jnp.maximum(m_acc, m_b)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_b - m_new)
+        # correction factors [b,h,q,1] -> [b,q,h,1] to match o's layout
+        alpha_q = jnp.transpose(alpha, (0, 2, 1, 3))
+        beta_q = jnp.transpose(beta, (0, 2, 1, 3))
+        o_acc = o_acc * alpha_q + o_b * beta_q
+        l_acc = l_acc * alpha + l_b * beta
+        m_acc = m_new
+        # rotate K/V to the next neighbour on the ring (ICI hop)
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        kv_idx = (kv_idx - 1) % axis_size
+        return (o_acc, m_acc, l_acc, k_nxt, v_nxt, kv_idx), None
+
+    b, s, h, d = q.shape
+    o0 = jnp.zeros((b, s, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
+    carry = (o0, m0, l0, k, v, my_idx)
+    (o, m, l, *_), _ = jax.lax.scan(step, carry, None, length=axis_size)
+    l_q = jnp.transpose(l, (0, 2, 1, 3))  # [b,q,h,1]
+    return (o / jnp.maximum(l_q, 1e-30)).astype(q.dtype)
+
+
+def ring_attention_sharded(mesh: Mesh, q, k, v, *, causal: bool = False):
+    """Convenience wrapper: shard_map ring_attention over the mesh.
+
+    Inputs are [batch, seq, heads, head_dim] global arrays; batch is sharded
+    over (data, fsdp), seq over seq, heads over tensor.
+    """
+    spec = P(("data", "fsdp"), "seq", "tensor", None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False,
+    )
+    def run(ql, kl, vl):
+        return ring_attention(ql, kl, vl, axis_name="seq", causal=causal)
+
+    return run(q, k, v)

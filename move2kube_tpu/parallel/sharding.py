@@ -1,0 +1,126 @@
+"""Logical-axis sharding rules.
+
+Params and activations are annotated with *logical* axis names (``embed``,
+``mlp``, ``heads``, ``batch``, ``length``...); a ``ShardingRules`` table
+maps logical names to mesh axes. This is the GSPMD recipe: annotate,
+``with_sharding_constraint``, let XLA insert collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass
+class ShardingRules:
+    """logical axis -> mesh axis (or None = replicated)."""
+
+    rules: dict[str, str | tuple[str, ...] | None] = field(default_factory=dict)
+
+    @classmethod
+    def default(cls) -> "ShardingRules":
+        """Standard FSDP + TP layout (scaling-book ch. sharding):
+
+        - batch over (data, fsdp): each data-parallel group sees a shard
+        - embed over fsdp: ZeRO-3-style parameter sharding
+        - mlp/heads over tensor: megatron-style TP
+        - length over seq: ring-attention context parallelism
+        """
+        return cls(rules={
+            "batch": ("data", "fsdp"),
+            "length": "seq",
+            "embed": "fsdp",
+            "mlp": "tensor",
+            "heads": "tensor",
+            "kv": None,
+            "vocab": "tensor",
+            "norm": None,
+            "conv_kernel": None,
+            "channels": "fsdp",
+        })
+
+    def spec(self, logical_axes: tuple[str | None, ...]) -> P:
+        return P(*(self.rules.get(a) if a else None for a in logical_axes))
+
+    def sharding(self, mesh: Mesh, logical_axes: tuple[str | None, ...]) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_axes))
+
+
+def logical_sharding(mesh: Mesh, rules: ShardingRules, logical_axes) -> NamedSharding:
+    return rules.sharding(mesh, tuple(logical_axes))
+
+
+def with_logical_constraint(x, mesh: Mesh, rules: ShardingRules, logical_axes):
+    """Constrain an activation's sharding by logical names."""
+    return jax.lax.with_sharding_constraint(x, rules.sharding(mesh, tuple(logical_axes)))
+
+
+def shard_params(params, axes_tree, mesh: Mesh, rules: ShardingRules):
+    """Device_put a param pytree according to a matching tree of logical
+    axis tuples (None entries = replicated)."""
+
+    def place(p, axes):
+        sh = rules.sharding(mesh, axes) if axes else NamedSharding(mesh, P())
+        return jax.device_put(p, sh)
+
+    return jax.tree.map(place, params, axes_tree,
+                        is_leaf=lambda x: x is None)
+
+
+def param_shardings(axes_tree, mesh: Mesh, rules: ShardingRules):
+    """Tree of NamedShardings for jit in_shardings/out_shardings."""
+    return jax.tree.map(
+        lambda axes: rules.sharding(mesh, axes) if axes else NamedSharding(mesh, P()),
+        axes_tree,
+        is_leaf=lambda x: x is None or (isinstance(x, tuple) and all(
+            isinstance(e, (str, type(None))) for e in x)),
+    )
+
+
+def infer_param_axes(params, tp_layers: tuple[str, ...] = ()):
+    """Heuristic logical axes for a flax param tree.
+
+    Works for the model zoo's conventions:
+    - 2D kernels: last dim is the output feature; shard it over fsdp unless
+      the param path names a TP-split layer (gate/up/query/... -> mlp/heads)
+    - embeddings: (vocab, embed)
+    - biases/norm scales: replicated
+    """
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def axes_for(path, p):
+        names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+        joined = "/".join(str(n) for n in names).lower()
+        nd = p.ndim
+        if nd <= 1:
+            return (None,) * nd
+        if "embedding" in joined:
+            return ("vocab", "embed") if nd == 2 else (None,) * nd
+        if nd == 2:
+            if any(t in joined for t in tp_layers) or any(
+                t in joined for t in ("gate", "up_proj", "wi", "query", "key",
+                                      "value", "qkv", "lm_head")
+            ):
+                return ("embed", "mlp")
+            if any(t in joined for t in ("down_proj", "wo", "out_proj", "attn_out")):
+                return ("mlp", "embed")
+            return (None, "embed")  # generic dense: ZeRO-style shard of out dim
+        if nd == 4:  # conv HWIO
+            return (None, None, None, "channels")
+        if nd == 3:  # attention heads (embed, heads, head_dim)
+            return ("embed", "heads", None)
+        return (None,) * nd
+
+    # rebuild a matching tree
+    paths_axes = {tuple(path): axes_for(path, p) for path, p in flat}
+
+    def walk(tree, prefix=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, prefix + (jax.tree_util.DictKey(k),)) for k, v in tree.items()}
+        return paths_axes.get(prefix, (None,) * getattr(tree, "ndim", 0))
+
+    return walk(params)
