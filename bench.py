@@ -63,13 +63,14 @@ def main() -> int:
     final_loss = float(loss)
     dt = time.perf_counter() - t0
     img_s = MEASURE_STEPS * BATCH / dt
+    if final_loss != final_loss:  # NaN: refuse to report a throughput
+        raise RuntimeError(f"training diverged: loss={final_loss}")
     print(json.dumps({
         "metric": "resnet50_train_throughput_v5e1",
         "value": round(img_s, 1),
         "unit": "img/s",
         "vs_baseline": round(img_s / HAND_PORTED_IMG_S, 3),
     }))
-    assert final_loss == final_loss  # NaN guard
     return 0
 
 

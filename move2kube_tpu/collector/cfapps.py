@@ -1,0 +1,104 @@
+"""Cloud Foundry running-apps collector.
+
+Parity: ``internal/collector/cfappscollector.go`` — queries the CF API for
+running applications (env, ports, buildpack, memory, instances) via the
+``cf`` CLI (``cf curl /v2/apps``) and writes a ``CfApps`` yaml into the
+collect output directory. Environment-gated: silently skips when no ``cf``
+session is available or IGNORE_ENVIRONMENT is set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+
+from move2kube_tpu.types import collection as collecttypes
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("collector.cfapps")
+
+
+_curl_cache: dict[str, dict | None] = {}
+
+
+def _cf_curl(path: str) -> dict | None:
+    """One `cf curl` per path per process — multiple collectors hit
+    /v2/apps; a single-shot CLI run never needs a second fetch."""
+    if common.IGNORE_ENVIRONMENT:
+        return None
+    if path in _curl_cache:
+        return _curl_cache[path]
+    result: dict | None = None
+    try:
+        res = subprocess.run(
+            ["cf", "curl", path],
+            capture_output=True, text=True, timeout=120, check=False,
+        )
+        if res.returncode == 0:
+            result = json.loads(res.stdout)
+    except (OSError, subprocess.TimeoutExpired, json.JSONDecodeError):
+        result = None
+    _curl_cache[path] = result
+    return result
+
+
+def _cf_curl_all_pages(path: str) -> dict | None:
+    """Follow v2 pagination (next_url) and return one merged payload."""
+    payload = _cf_curl(path)
+    if payload is None:
+        return None
+    resources = list(payload.get("resources", []) or [])
+    next_url = payload.get("next_url")
+    pages = 1
+    while next_url and pages < 100:  # hard stop against a looping endpoint
+        page = _cf_curl(str(next_url))
+        if page is None:
+            break
+        resources.extend(page.get("resources", []) or [])
+        next_url = page.get("next_url")
+        pages += 1
+    if next_url:
+        log.warning("CF pagination stopped after %d pages; results truncated "
+                    "(next_url=%s)", pages, next_url)
+    return {"resources": resources}
+
+
+def apps_from_v2_payload(payload: dict) -> collecttypes.CfInstanceApps:
+    """Convert a ``/v2/apps`` response document into CfInstanceApps
+    (cfappscollector.go:43 onward; kept separate so tests can feed recorded
+    fixtures instead of a live CF session)."""
+    out = collecttypes.CfInstanceApps()
+    for res in payload.get("resources", []) or []:
+        entity = res.get("entity", {}) or {}
+        env = entity.get("environment_json") or {}
+        out.apps.append(
+            collecttypes.CfApp(
+                name=str(entity.get("name", "")),
+                buildpack=str(entity.get("buildpack") or ""),
+                detected_buildpack=str(entity.get("detected_buildpack") or ""),
+                memory_mb=int(entity.get("memory", 0) or 0),
+                instances=int(entity.get("instances", 1) or 1),
+                ports=[int(p) for p in (entity.get("ports") or []) if p],
+                env={str(k): str(v) for k, v in env.items()},
+            )
+        )
+    return out
+
+
+class CfAppsCollector:
+    def get_annotations(self) -> list[str]:
+        return ["cf", "cloudfoundry"]
+
+    def collect(self, source_dir: str, out_dir: str) -> None:
+        payload = _cf_curl_all_pages("/v2/apps")
+        if payload is None:
+            log.debug("no cf session; skipping CfApps collection")
+            return
+        apps = apps_from_v2_payload(payload)
+        if not apps.apps:
+            return
+        dest = os.path.join(out_dir, "cf", "cfapps.yaml")
+        common.write_yaml(dest, apps.to_dict())
+        log.info("collected %d CF apps -> %s", len(apps.apps), dest)

@@ -1,0 +1,90 @@
+"""CF buildpack -> containerizer-options collector.
+
+Parity: ``internal/collector/cfcontainertypescollector.go`` — maps CF
+buildpacks (from the running instance when a ``cf`` session exists, else
+from ``manifest.yml`` files in the source tree) to candidate
+containerization options and writes a ``CfContainerizers`` yaml.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from move2kube_tpu.collector.cfapps import _cf_curl_all_pages, apps_from_v2_payload
+from move2kube_tpu.source.cfmanifest2kube import find_cf_manifests
+from move2kube_tpu.types import collection as collecttypes
+from move2kube_tpu.types.plan import ContainerBuildType
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("collector.cfcontainertypes")
+
+# Known CF buildpack name fragments -> containerization options. The
+# reference ships an equivalent curated mapping; options are build types
+# our containerizers implement, most specific first.
+BUILDPACK_OPTIONS: dict[str, list[str]] = {
+    "python": [ContainerBuildType.NEW_DOCKERFILE, ContainerBuildType.S2I,
+               ContainerBuildType.CNB],
+    "nodejs": [ContainerBuildType.NEW_DOCKERFILE, ContainerBuildType.S2I,
+               ContainerBuildType.CNB],
+    "java": [ContainerBuildType.NEW_DOCKERFILE, ContainerBuildType.S2I,
+             ContainerBuildType.CNB],
+    "go": [ContainerBuildType.NEW_DOCKERFILE, ContainerBuildType.S2I],
+    "ruby": [ContainerBuildType.NEW_DOCKERFILE, ContainerBuildType.S2I,
+             ContainerBuildType.CNB],
+    "php": [ContainerBuildType.NEW_DOCKERFILE, ContainerBuildType.S2I,
+            ContainerBuildType.CNB],
+    "staticfile": [ContainerBuildType.NEW_DOCKERFILE, ContainerBuildType.CNB],
+    "binary": [ContainerBuildType.MANUAL],
+}
+
+
+def options_for_buildpack(buildpack: str) -> list[str]:
+    bp = buildpack.lower()
+    for frag, opts in BUILDPACK_OPTIONS.items():
+        # word-anchored: 'go' must not match 'django_buildpack'
+        if re.search(rf"(^|[^a-z]){frag}([^a-z]|$)", bp):
+            return list(opts)
+    return [ContainerBuildType.MANUAL]
+
+
+def buildpacks_from_manifests(source_dir: str) -> list[str]:
+    """Buildpack names declared in CF manifest.yml files in the tree
+    (cfcontainertypescollector.go manifest fallback)."""
+    found: list[str] = []
+    for _path, apps in find_cf_manifests(source_dir):
+        for app in apps:
+            for bp in app.get("buildpacks") or []:
+                found.append(str(bp))
+            if app.get("buildpack"):
+                found.append(str(app["buildpack"]))
+    return sorted(set(found))
+
+
+class CFContainerTypesCollector:
+    def get_annotations(self) -> list[str]:
+        return ["cf", "cloudfoundry", "containerizers"]
+
+    def collect(self, source_dir: str, out_dir: str) -> None:
+        buildpacks: list[str] = []
+        payload = _cf_curl_all_pages("/v2/apps")
+        if payload is not None:
+            for app in apps_from_v2_payload(payload).apps:
+                if app.buildpack:
+                    buildpacks.append(app.buildpack)
+                if app.detected_buildpack:
+                    buildpacks.append(app.detected_buildpack)
+        buildpacks.extend(buildpacks_from_manifests(source_dir))
+        buildpacks = sorted(set(buildpacks))
+        if not buildpacks:
+            log.debug("no CF buildpacks found; skipping")
+            return
+        mapping = collecttypes.CfContainerizers(
+            buildpack_containerizers={
+                bp: options_for_buildpack(bp) for bp in buildpacks
+            }
+        )
+        dest = os.path.join(out_dir, "cf", "cfcontainerizers.yaml")
+        common.write_yaml(dest, mapping.to_dict())
+        log.info("mapped %d CF buildpacks -> %s", len(buildpacks), dest)

@@ -2,6 +2,7 @@ from move2kube_tpu.containerizer.base import (  # noqa: F401
     Containerizer,
     get_container,
     get_containerization_options,
+    get_containerizers,
     init_containerizers,
     reset_containerizers,
 )

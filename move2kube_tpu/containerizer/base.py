@@ -47,6 +47,7 @@ def init_containerizers(source_dir: str, extra: list[Containerizer] | None = Non
     from move2kube_tpu.containerizer.reuse_dockerfile import ReuseDockerfileContainerizer
     from move2kube_tpu.containerizer.s2i import S2IContainerizer
     from move2kube_tpu.containerizer.cnb import CNBContainerizer
+    from move2kube_tpu.containerizer.manual import ManualContainerizer
 
     reset_containerizers()
     regs: list[Containerizer] = [
@@ -56,6 +57,7 @@ def init_containerizers(source_dir: str, extra: list[Containerizer] | None = Non
         CNBContainerizer(),
         ReuseContainerizer(),
         ReuseDockerfileContainerizer(),
+        ManualContainerizer(),  # last resort (manualcontainerizer.go)
     ]
     if extra:
         regs.extend(extra)

@@ -1,0 +1,65 @@
+"""Manual containerizer: a placeholder for services the user containerizes
+out of band.
+
+Parity: ``internal/containerizer/manualcontainerizer.go`` — in the
+reference this carries the CF-collected buildpack -> containerizer mapping
+(``m2k_collect`` CfContainerizers files) and otherwise produces a non-new
+container plus an entry in ``Manualimages.md`` telling the user which
+images they still have to build by hand.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.containerizer.base import Containerizer
+from move2kube_tpu.types import collection as collecttypes
+from move2kube_tpu.types.ir import Container
+from move2kube_tpu.types.plan import ContainerBuildType, PlanService
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("containerizer.manual")
+
+
+class ManualContainerizer(Containerizer):
+    """Offers buildpack-derived options from collected CfContainerizers
+    files; emits a no-files container flagged for manual build."""
+
+    def __init__(self) -> None:
+        self.cf_containerizers = collecttypes.CfContainerizers()
+
+    def init(self, source_dir: str) -> None:
+        """Load collected CfContainerizers yamls (manualcontainerizer.go
+        Init). Only files that look like the collect output are parsed —
+        a full-tree YAML parse of every manifest would run twice per
+        translate for nothing."""
+        for path in common.get_files_by_ext(source_dir, [".yaml", ".yml"]):
+            base = os.path.basename(path).lower()
+            if "cfcontainerizer" not in base and common.COLLECT_OUTPUT_DIR not in path:
+                continue
+            try:
+                other = collecttypes.read_cf_containerizers(path)
+            except Exception:  # noqa: BLE001 - not a CfContainerizers file
+                continue
+            self.cf_containerizers.merge(other)
+            log.debug("loaded CF containerizer mapping from %s", path)
+
+    def get_build_type(self) -> str:
+        return ContainerBuildType.MANUAL
+
+    def get_target_options(self, plan, directory: str) -> list[str]:
+        # Never offered by the directory walk — that would add a Manual
+        # option to every any2kube service. CF apps reach Manual through
+        # ``options_for_buildpack`` via the collected mapping.
+        return []
+
+    def options_for_buildpack(self, buildpack: str) -> list[str]:
+        return self.cf_containerizers.options_for(buildpack)
+
+    def get_container(self, plan, service: PlanService) -> Container:
+        image = service.image or service.service_name + ":latest"
+        log.info("service %s marked for manual containerization (image %s)",
+                 service.service_name, image)
+        return Container(image_names=[image], new=False,
+                         build_type=ContainerBuildType.MANUAL)

@@ -15,10 +15,13 @@ log = get_logger("collector")
 
 
 def get_collectors() -> list:
+    from move2kube_tpu.collector.cfapps import CfAppsCollector
+    from move2kube_tpu.collector.cfcontainertypes import CFContainerTypesCollector
     from move2kube_tpu.collector.cluster import ClusterCollector
     from move2kube_tpu.collector.images import ImagesCollector
 
-    return [ClusterCollector(), ImagesCollector()]
+    return [ClusterCollector(), ImagesCollector(),
+            CFContainerTypesCollector(), CfAppsCollector()]
 
 
 def collect(source_dir: str, out_dir: str, annotations: list[str] | None = None) -> None:

@@ -56,9 +56,29 @@ def _load_collected_apps(plan: Plan) -> dict[str, collecttypes.CfApp]:
     return apps
 
 
+def _buildpack_options(buildpack: str) -> list[str]:
+    """Build types the collected CfContainerizers mapping offers for a
+    buildpack (cfcontainertypescollector.go output consumed at plan time).
+    Empty when nothing was collected — we don't guess."""
+    from move2kube_tpu.containerizer.manual import ManualContainerizer
+
+    for c in containerizer.get_containerizers():
+        if isinstance(c, ManualContainerizer):
+            return c.options_for_buildpack(buildpack) if \
+                c.cf_containerizers.buildpack_containerizers else []
+    return []
+
+
 class CfManifestTranslator(Translator):
     def get_translation_type(self) -> str:
         return TranslationType.CFMANIFEST2KUBE
+
+    @staticmethod
+    def _app_buildpacks(app: dict) -> list[str]:
+        bps = [str(b) for b in (app.get("buildpacks") or []) if b]
+        if app.get("buildpack"):
+            bps.append(str(app["buildpack"]))
+        return bps
 
     def get_service_options(self, plan: Plan) -> list[PlanService]:
         services: list[PlanService] = []
@@ -70,6 +90,13 @@ class CfManifestTranslator(Translator):
                 if not os.path.isdir(src_dir):
                     src_dir = app_dir
                 options = containerizer.get_containerization_options(plan, src_dir)
+                # collected buildpack->containerizer mapping
+                # (cfcontainertypescollector output) widens the options:
+                # e.g. a 'binary' buildpack maps to Manual even though no
+                # scanner claims the directory
+                for bp in self._app_buildpacks(app):
+                    for build_type in _buildpack_options(bp):
+                        options.setdefault(build_type, [name])
                 for build_type, target_options in options.items():
                     svc = PlanService(
                         service_name=name,

@@ -147,6 +147,51 @@ class CfApp:
 
 
 @dataclass
+class CfContainerizers:
+    """Buildpack name -> candidate containerization options
+    (parity: types/collection/cfcontainerizers.go:28-50)."""
+
+    buildpack_containerizers: dict[str, list[str]] = field(default_factory=dict)
+
+    def options_for(self, buildpack: str) -> list[str]:
+        return list(self.buildpack_containerizers.get(buildpack, []))
+
+    def merge(self, other: "CfContainerizers") -> None:
+        for bp, opts in other.buildpack_containerizers.items():
+            mine = self.buildpack_containerizers.setdefault(bp, [])
+            for o in opts:
+                if o not in mine:
+                    mine.append(o)
+
+    def to_dict(self) -> dict:
+        doc = common.new_m2kt_doc(CF_CONTAINERIZERS_KIND)
+        doc["spec"] = {
+            "buildpackContainerizers": [
+                {"buildpackName": bp, "containerizationOptions": opts}
+                for bp, opts in sorted(self.buildpack_containerizers.items())
+            ]
+        }
+        return doc
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CfContainerizers":
+        out = cls()
+        for entry in d.get("spec", {}).get("buildpackContainerizers", []):
+            bp = entry.get("buildpackName", "")
+            if bp:
+                out.buildpack_containerizers[bp] = list(
+                    entry.get("containerizationOptions", [])
+                )
+        return out
+
+
+def read_cf_containerizers(path: str) -> CfContainerizers:
+    return CfContainerizers.from_dict(
+        common.read_m2kt_yaml(path, CF_CONTAINERIZERS_KIND)
+    )
+
+
+@dataclass
 class CfInstanceApps:
     apps: list[CfApp] = field(default_factory=list)
 

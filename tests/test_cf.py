@@ -1,0 +1,197 @@
+"""CF collectors + Manual containerizer (SURVEY §2.5, §2.7, §2.11)."""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.collector.cfapps import apps_from_v2_payload
+from move2kube_tpu.collector.cfcontainertypes import (
+    CFContainerTypesCollector,
+    buildpacks_from_manifests,
+    options_for_buildpack,
+)
+from move2kube_tpu.containerizer.manual import ManualContainerizer
+from move2kube_tpu.types import collection as collecttypes
+from move2kube_tpu.types.plan import ContainerBuildType, Plan, PlanService
+from move2kube_tpu.utils import common
+
+V2_APPS_FIXTURE = {
+    "resources": [
+        {
+            "entity": {
+                "name": "billing-api",
+                "buildpack": "python_buildpack",
+                "detected_buildpack": "python",
+                "memory": 512,
+                "instances": 3,
+                "ports": [8080],
+                "environment_json": {"FLASK_ENV": "production"},
+            }
+        },
+        {
+            "entity": {
+                "name": "frontend",
+                "buildpack": None,
+                "detected_buildpack": "staticfile",
+                "memory": 64,
+                "instances": 1,
+                "ports": [],
+                "environment_json": {},
+            }
+        },
+    ]
+}
+
+
+def test_apps_from_v2_payload():
+    apps = apps_from_v2_payload(V2_APPS_FIXTURE)
+    assert len(apps.apps) == 2
+    billing = apps.apps[0]
+    assert billing.name == "billing-api"
+    assert billing.buildpack == "python_buildpack"
+    assert billing.instances == 3
+    assert billing.ports == [8080]
+    assert billing.env == {"FLASK_ENV": "production"}
+    assert apps.apps[1].buildpack == ""  # null buildpack coerced
+
+
+def test_cf_instance_apps_roundtrip(tmp_path):
+    apps = apps_from_v2_payload(V2_APPS_FIXTURE)
+    path = str(tmp_path / "cfapps.yaml")
+    common.write_yaml(path, apps.to_dict())
+    loaded = collecttypes.CfInstanceApps.from_dict(
+        common.read_m2kt_yaml(path, collecttypes.CF_APPS_KIND)
+    )
+    assert [a.name for a in loaded.apps] == ["billing-api", "frontend"]
+    assert loaded.apps[0].memory_mb == 512
+
+
+def test_options_for_buildpack():
+    assert ContainerBuildType.S2I in options_for_buildpack("python_buildpack")
+    assert ContainerBuildType.NEW_DOCKERFILE in options_for_buildpack("nodejs_buildpack")
+    assert options_for_buildpack("weird_custom_thing") == [ContainerBuildType.MANUAL]
+
+
+def test_buildpacks_from_manifests(tmp_path):
+    appdir = tmp_path / "cfapp"
+    appdir.mkdir()
+    (appdir / "manifest.yml").write_text(
+        "applications:\n"
+        "- name: web\n"
+        "  buildpacks: [python_buildpack]\n"
+        "- name: worker\n"
+        "  buildpack: ruby_buildpack\n"
+    )
+    assert buildpacks_from_manifests(str(tmp_path)) == [
+        "python_buildpack", "ruby_buildpack",
+    ]
+
+
+def test_cfcontainertypes_collector_writes_mapping(tmp_path, monkeypatch):
+    appdir = tmp_path / "src" / "cfapp"
+    appdir.mkdir(parents=True)
+    (appdir / "manifest.yml").write_text(
+        "applications:\n- name: web\n  buildpacks: [python_buildpack]\n"
+    )
+    out = tmp_path / "out"
+    out.mkdir()
+    # no live cf session in tests
+    monkeypatch.setattr(
+        "move2kube_tpu.collector.cfcontainertypes._cf_curl_all_pages",
+        lambda _p: None,
+    )
+    CFContainerTypesCollector().collect(str(tmp_path / "src"), str(out))
+    dest = out / "cf" / "cfcontainerizers.yaml"
+    assert dest.exists()
+    mapping = collecttypes.read_cf_containerizers(str(dest))
+    assert ContainerBuildType.S2I in mapping.options_for("python_buildpack")
+
+
+def test_cf_containerizers_merge_and_roundtrip(tmp_path):
+    a = collecttypes.CfContainerizers({"python": ["NewDockerfile"]})
+    b = collecttypes.CfContainerizers({"python": ["S2I"], "go": ["NewDockerfile"]})
+    a.merge(b)
+    assert a.options_for("python") == ["NewDockerfile", "S2I"]
+    path = str(tmp_path / "cfc.yaml")
+    common.write_yaml(path, a.to_dict())
+    loaded = collecttypes.read_cf_containerizers(path)
+    assert loaded.options_for("go") == ["NewDockerfile"]
+
+
+def test_manual_containerizer(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    common.write_yaml(
+        str(src / "cfcontainerizers.yaml"),
+        collecttypes.CfContainerizers({"python_buildpack": ["NewDockerfile"]}).to_dict(),
+    )
+    mc = ManualContainerizer()
+    mc.init(str(src))
+    assert mc.options_for_buildpack("python_buildpack") == ["NewDockerfile"]
+    plan = Plan(name="t", root_dir=str(src))
+    # never offered by directory walk (would flood any2kube plans)
+    assert mc.get_target_options(plan, str(src)) == []
+    svc = PlanService(service_name="web", image="web:1",
+                      container_build_type=ContainerBuildType.MANUAL)
+    container = mc.get_container(plan, svc)
+    assert container.new is False
+    assert container.image_names == ["web:1"]
+    assert not container.new_files
+
+
+def test_manual_containerizer_no_mapping_offers_nothing(tmp_path):
+    mc = ManualContainerizer()
+    mc.init(str(tmp_path))
+    plan = Plan(name="t", root_dir=str(tmp_path))
+    assert mc.get_target_options(plan, str(tmp_path)) == []
+
+
+def test_collected_buildpack_mapping_widens_plan_options(tmp_path):
+    """A 'binary' buildpack app gets a Manual option from the collected
+    CfContainerizers mapping even though no stack scanner claims the dir."""
+    from move2kube_tpu.containerizer import base as czbase
+    from move2kube_tpu.source.cfmanifest2kube import CfManifestTranslator
+
+    src = tmp_path / "src"
+    app = src / "binapp"
+    app.mkdir(parents=True)
+    (app / "manifest.yml").write_text(
+        "applications:\n- name: binsvc\n  buildpack: binary_buildpack\n"
+    )
+    (app / "run.bin").write_text("")
+    common.write_yaml(
+        str(src / "cfcontainerizers.yaml"),
+        collecttypes.CfContainerizers(
+            {"binary_buildpack": [ContainerBuildType.MANUAL]}
+        ).to_dict(),
+    )
+    czbase.init_containerizers(str(src))
+    try:
+        plan = Plan(name="t", root_dir=str(src))
+        services = CfManifestTranslator().get_service_options(plan)
+        build_types = {s.container_build_type for s in services}
+        assert ContainerBuildType.MANUAL in build_types
+    finally:
+        czbase.reset_containerizers()
+
+
+def test_buildpack_word_anchored_matching():
+    # 'go' fragment must not claim django
+    opts = options_for_buildpack("django_buildpack")
+    assert opts == [ContainerBuildType.MANUAL]
+    assert ContainerBuildType.S2I in options_for_buildpack("go_buildpack")
+
+
+def test_cf_pagination_followed(monkeypatch):
+    from move2kube_tpu.collector import cfapps
+
+    pages = {
+        "/v2/apps": {"resources": [{"entity": {"name": "a"}}],
+                     "next_url": "/v2/apps?page=2"},
+        "/v2/apps?page=2": {"resources": [{"entity": {"name": "b"}}],
+                            "next_url": None},
+    }
+    monkeypatch.setattr(cfapps, "_cf_curl", lambda p: pages.get(p))
+    merged = cfapps._cf_curl_all_pages("/v2/apps")
+    apps = apps_from_v2_payload(merged)
+    assert [a.name for a in apps.apps] == ["a", "b"]
