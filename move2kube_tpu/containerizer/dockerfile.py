@@ -31,11 +31,18 @@ CUSTOM_DETECT_SCRIPT = "m2ktdfdetect.sh"
 def _record_source_dir(container, plan, svc_dir: str) -> None:
     """Remember the service's source dir relative to the plan root so
     copysources.sh copies the right subtree next to the build files
-    (transformer/base.py reads repo_info.git_repo_dir)."""
+    (transformer/base.py reads repo_info.git_repo_dir), plus the git
+    remote/branch for CI/CD generation (plan.go GatherGitInfo:194)."""
+    from move2kube_tpu.utils import gitinfo
+
     rel = None
     if plan is not None and getattr(plan, "root_dir", ""):
         rel = common.relpath_under(svc_dir, plan.root_dir)
     container.repo_info.git_repo_dir = rel if rel is not None else "."
+    details = gitinfo.get_git_repo_details(svc_dir)
+    if details is not None:
+        container.repo_info.git_repo_url = details.url
+        container.repo_info.git_repo_branch = details.branch
 
 
 class DockerfileContainerizer(Containerizer):

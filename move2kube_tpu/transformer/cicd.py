@@ -14,7 +14,7 @@ import os
 from move2kube_tpu.apiresource.base import make_obj
 from move2kube_tpu.transformer.base import Transformer, write_objects
 from move2kube_tpu.types.ir import IR
-from move2kube_tpu.utils import common
+from move2kube_tpu.utils import common, gitinfo, sshkeys
 from move2kube_tpu.utils.log import get_logger
 
 log = get_logger("transformer.cicd")
@@ -26,7 +26,8 @@ class CICDTransformer(Transformer):
 
     def transform(self, ir: IR) -> None:
         proj = common.make_dns_label(ir.name)
-        new_images = [c.image_names[0] for c in ir.containers if c.new and c.image_names]
+        new_containers = [c for c in ir.containers if c.new and c.image_names]
+        new_images = [c.image_names[0] for c in new_containers]
         if not new_images:
             self.objs = []
             return
@@ -35,6 +36,18 @@ class CICDTransformer(Transformer):
         sa_name = prefix + "-sa"
         registry_secret = prefix + "-registry-secret"
         git_event_secret = prefix + "-git-event-secret"
+
+        # detected git remotes: default clone URL + per-domain ssh secrets
+        repo_urls = [c.repo_info.git_repo_url for c in new_containers
+                     if c.repo_info.git_repo_url]
+        # both defaults from the same container — mixing a URL from one
+        # repo with a branch from another yields an unclonable revision
+        first_with_url = next((c for c in new_containers
+                               if c.repo_info.git_repo_url), None)
+        default_repo_url = first_with_url.repo_info.git_repo_url \
+            if first_with_url else ""
+        default_branch = (first_with_url.repo_info.git_repo_branch
+                          if first_with_url else "") or "main"
 
         tasks = []
         for i, image in enumerate(new_images):
@@ -49,10 +62,14 @@ class CICDTransformer(Transformer):
                 "workspaces": [{"name": "source", "workspace": "shared-data"}],
             })
         pipeline = make_obj("Pipeline", "tekton.dev/v1beta1", pipeline_name)
+        url_param: dict = {"name": "git-repo-url", "type": "string"}
+        if default_repo_url:
+            url_param["default"] = default_repo_url
         pipeline["spec"] = {
             "params": [
-                {"name": "git-repo-url", "type": "string"},
-                {"name": "git-revision", "type": "string", "default": "main"},
+                url_param,
+                {"name": "git-revision", "type": "string",
+                 "default": default_branch},
             ],
             "workspaces": [{"name": "shared-data"}],
             "tasks": [{
@@ -118,8 +135,23 @@ class CICDTransformer(Transformer):
         git_sec = make_obj("Secret", "v1", git_event_secret)
         git_sec["stringData"] = {"secretToken": "m2kt-webhook-token"}
 
+        # per-git-domain SSH auth secrets so git-clone can pull private
+        # repos (tektonapiresourceset.go createGitSecret:242, sshkeys.go)
+        ssh_secrets: list[dict] = []
+        domains = sorted({gitinfo.domain_of_git_url(u) for u in repo_urls}
+                         - {""})
+        for domain in domains:
+            sec = make_obj("Secret", "v1",
+                           f"{prefix}-git-ssh-{common.make_dns_label(domain)}")
+            sec["type"] = "kubernetes.io/ssh-auth"
+            sec["metadata"].setdefault("annotations", {})[
+                "tekton.dev/git-0"] = domain
+            sec["stringData"] = sshkeys.git_secret_data(domain)
+            ssh_secrets.append(sec)
+
         sa = make_obj("ServiceAccount", "v1", sa_name)
-        sa["secrets"] = [{"name": registry_secret}]
+        sa["secrets"] = [{"name": registry_secret}] + [
+            {"name": s["metadata"]["name"]} for s in ssh_secrets]
         role = make_obj("Role", "rbac.authorization.k8s.io/v1", prefix + "-role")
         role["rules"] = [
             {"apiGroups": ["triggers.tekton.dev"],
@@ -136,7 +168,7 @@ class CICDTransformer(Transformer):
                               "apiGroup": "rbac.authorization.k8s.io"}
 
         self.objs = [pipeline, trigger_template, trigger_binding, event_listener,
-                     registry_sec, git_sec, sa, role, binding]
+                     registry_sec, git_sec, *ssh_secrets, sa, role, binding]
         ir.tekton.pipelines = [pipeline]
         ir.tekton.event_listeners = [event_listener]
         ir.tekton.trigger_bindings = [trigger_binding]

@@ -1,0 +1,135 @@
+"""Git repository introspection for CI/CD generation.
+
+Parity: ``types/plan/plan.go:194-280`` (GatherGitInfo) and the helpers at
+``internal/common/utils.go:636-700`` — find the repo containing a service
+directory and its remote URL/branch, preferring the ``upstream`` remote
+over ``origin``. The reference uses go-git; we parse ``.git/config`` and
+``.git/HEAD`` directly (no subprocess, works in sandboxes without git).
+"""
+
+from __future__ import annotations
+
+import configparser
+import os
+import re
+from dataclasses import dataclass
+
+PREFERRED_REMOTES = ["upstream", "origin"]
+
+
+@dataclass
+class GitRepoDetails:
+    repo_root: str = ""
+    remote_name: str = ""
+    url: str = ""
+    branch: str = ""
+
+
+def find_repo_root(path: str) -> str | None:
+    """Walk up from path to the directory containing ``.git``."""
+    cur = os.path.abspath(path)
+    while True:
+        if os.path.exists(os.path.join(cur, ".git")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def _git_dir(repo_root: str) -> str | None:
+    dotgit = os.path.join(repo_root, ".git")
+    if os.path.isdir(dotgit):
+        return dotgit
+    if os.path.isfile(dotgit):  # worktree / submodule: "gitdir: <path>"
+        try:
+            with open(dotgit, encoding="utf-8") as f:
+                first = f.readline().strip()
+        except OSError:
+            return None
+        if first.startswith("gitdir:"):
+            target = first.split(":", 1)[1].strip()
+            return os.path.normpath(os.path.join(repo_root, target))
+    return None
+
+
+def _config_path(git_dir: str) -> str:
+    """Path of the repo config; linked worktrees (.git/worktrees/<name>)
+    keep the shared config in the main .git dir named by ``commondir``."""
+    cfg = os.path.join(git_dir, "config")
+    if os.path.isfile(cfg):
+        return cfg
+    commondir = os.path.join(git_dir, "commondir")
+    if os.path.isfile(commondir):
+        try:
+            with open(commondir, encoding="utf-8") as f:
+                target = f.read().strip()
+        except OSError:
+            return cfg
+        return os.path.join(os.path.normpath(os.path.join(git_dir, target)),
+                            "config")
+    return cfg
+
+
+def get_remotes(repo_root: str) -> dict[str, str]:
+    """remote name -> url from .git/config."""
+    gd = _git_dir(repo_root)
+    if not gd:
+        return {}
+    # strict=False: duplicate 'url =' lines are legal in git config
+    # (remote set-url --add); interpolation=None: URLs may contain '%'
+    parser = configparser.ConfigParser(strict=False, interpolation=None)
+    remotes: dict[str, str] = {}
+    try:
+        parser.read(_config_path(gd))
+        for section in parser.sections():
+            m = re.match(r'remote "(.+)"', section)
+            if m and parser.has_option(section, "url"):
+                remotes[m.group(1)] = parser.get(section, "url")
+    except (OSError, configparser.Error):
+        return remotes
+    return remotes
+
+
+def get_branch(repo_root: str) -> str:
+    gd = _git_dir(repo_root)
+    if not gd:
+        return ""
+    try:
+        with open(os.path.join(gd, "HEAD"), encoding="utf-8") as f:
+            head = f.read().strip()
+    except OSError:
+        return ""
+    if head.startswith("ref:"):
+        ref = head.split(":", 1)[1].strip()
+        # keep '/' in branch names like feature/foo
+        return ref.removeprefix("refs/heads/")
+    return ""  # detached
+
+
+def get_git_repo_details(path: str) -> GitRepoDetails | None:
+    """Repo info for the service at ``path``, preferring upstream over
+    origin (utils.go:653; GetGitRemoteNames:636)."""
+    root = find_repo_root(path)
+    if not root:
+        return None
+    remotes = get_remotes(root)
+    name, url = "", ""
+    for preferred in PREFERRED_REMOTES:
+        if preferred in remotes:
+            name, url = preferred, remotes[preferred]
+            break
+    if not url and remotes:
+        name = sorted(remotes)[0]
+        url = remotes[name]
+    return GitRepoDetails(repo_root=root, remote_name=name, url=url,
+                          branch=get_branch(root))
+
+
+def domain_of_git_url(url: str) -> str:
+    """Hostname of an ssh/https git remote URL ('' if unparseable)."""
+    if "://" in url:  # scheme://[user@]host[:port]/path
+        m = re.match(r"\w+://(?:[\w.-]+@)?([\w.-]+)", url)
+        return m.group(1) if m else ""
+    m = re.match(r"(?:[\w.-]+@)?([\w.-]+):\S", url)  # scp-like git@host:path
+    return m.group(1) if m else ""

@@ -1,0 +1,127 @@
+"""SSH private-key loading for generated Tekton git secrets.
+
+Parity: ``internal/common/sshkeys/sshkeys.go:50-240`` — enumerate the
+user's ~/.ssh private keys, let the QA engine pick which key to embed for
+a git domain (with a passphrase prompt for encrypted keys), and pair it
+with the domain's known_hosts entries. Everything is environment-gated:
+with IGNORE_ENVIRONMENT set or no ~/.ssh present, secrets are emitted
+with placeholder contents for the user to fill in.
+"""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.qa import engine as qaengine
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.knownhosts import known_hosts_lines, load_known_hosts
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("sshkeys")
+
+NO_KEY = "none (fill in manually)"
+_PEM_MARKERS = ("PRIVATE KEY", "OPENSSH PRIVATE KEY")
+
+
+def list_private_keys(ssh_dir: str | None = None) -> list[str]:
+    """Paths of private key files in ~/.ssh (sshkeys.go loadSSHKeys)."""
+    if common.IGNORE_ENVIRONMENT:
+        return []
+    directory = ssh_dir or os.path.expanduser("~/.ssh")
+    keys: list[str] = []
+    try:
+        entries = sorted(os.listdir(directory))
+    except OSError:
+        return []
+    for name in entries:
+        path = os.path.join(directory, name)
+        if not os.path.isfile(path) or name in ("known_hosts", "config",
+                                                "authorized_keys"):
+            continue
+        if name.endswith(".pub"):
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="ignore") as f:
+                head = f.read(4096)
+        except OSError:
+            continue
+        if any(marker in head for marker in _PEM_MARKERS):
+            keys.append(path)
+    return keys
+
+
+def _is_encrypted(key_text: str) -> bool:
+    return "ENCRYPTED" in key_text or "Proc-Type: 4,ENCRYPTED" in key_text
+
+
+def _decrypt(key_text: str, passphrase: str) -> str:
+    """Best-effort decrypt so the embedded key works without an agent.
+    Falls back to the original (still-encrypted) text."""
+    try:
+        from cryptography.hazmat.primitives import serialization
+
+        key = serialization.load_ssh_private_key(
+            key_text.encode(), password=passphrase.encode())
+        return key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.OpenSSH,
+            serialization.NoEncryption(),
+        ).decode()
+    except Exception as e:  # noqa: BLE001 - wrong pass, PEM format, no lib
+        try:
+            from cryptography.hazmat.primitives import serialization
+
+            key = serialization.load_pem_private_key(
+                key_text.encode(), password=passphrase.encode())
+            return key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            ).decode()
+        except Exception:  # noqa: BLE001
+            log.warning("could not decrypt SSH key (%s); embedding as-is", e)
+            return key_text
+
+
+def get_ssh_key(domain: str, ssh_dir: str | None = None) -> str:
+    """Private key text to embed for a git domain, chosen via QA
+    (sshkeys.go GetSSHKey). '' when the user opts out or none exist."""
+    candidates = list_private_keys(ssh_dir)
+    if not candidates:
+        return ""
+    options = [os.path.basename(p) for p in candidates] + [NO_KEY]
+    answer = qaengine.fetch_select(
+        id=f"m2kt.sshkeys.key.{domain}",
+        desc=f"Select the SSH private key to use for git domain {domain}:",
+        context=["The key is embedded in the generated Tekton git secret."],
+        default=NO_KEY, options=options,
+    )
+    if answer in (NO_KEY, "", None):
+        return ""
+    path = os.path.join(os.path.dirname(candidates[0]), str(answer))
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        log.warning("cannot read SSH key %s: %s", path, e)
+        return ""
+    if _is_encrypted(text):
+        passphrase = qaengine.fetch_password(
+            id=f"m2kt.sshkeys.passphrase.{os.path.basename(path)}",
+            desc=f"Passphrase for SSH key {os.path.basename(path)}:",
+            context=[],
+        ) or ""
+        text = _decrypt(text, str(passphrase))
+    return text
+
+
+def git_secret_data(domain: str, ssh_dir: str | None = None,
+                    known_hosts_path: str | None = None) -> dict[str, str]:
+    """stringData for a kubernetes.io/ssh-auth secret for one git domain."""
+    key = get_ssh_key(domain, ssh_dir)
+    hosts = known_hosts_lines(domain, load_known_hosts(known_hosts_path))
+    return {
+        "ssh-privatekey": key or "<paste the private key for "
+                                 f"{domain} here>",
+        "known_hosts": hosts,
+    }
