@@ -1,0 +1,16 @@
+{% if build_tool == "maven" %}FROM maven:3.9-eclipse-temurin-17 AS build
+WORKDIR /src
+COPY pom.xml .
+RUN mvn -q dependency:go-offline
+COPY . .
+RUN mvn -q package -DskipTests
+{% elif build_tool == "gradle" %}FROM gradle:8-jdk17 AS build
+WORKDIR /src
+COPY . .
+RUN gradle --no-daemon build -x test && mkdir -p /src/target && cp build/libs/*.war /src/target/
+{% elif build_tool == "ant" %}FROM eclipse-temurin:17-jdk AS build
+RUN apt-get update && apt-get install -y --no-install-recommends ant && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY . .
+RUN ant && mkdir -p /src/target && find . -name '*.war' -exec cp {} /src/target/ \;
+{% endif %}

@@ -30,7 +30,9 @@ BUILDERS = ["gcr.io/buildpacks/builder", "paketobuildpacks/builder-jammy-base"]
 
 # stacks known to be supported by the default builders
 _BUILDPACK_STACKS = {
-    "python", "django", "nodejs", "golang", "java-maven", "java-gradle", "ruby", "php",
+    "python", "django", "nodejs", "golang", "java-maven", "java-gradle",
+    "java-ant", "java-war-tomcat", "java-war-liberty", "java-war-jboss",
+    "ruby", "php",
 }
 
 

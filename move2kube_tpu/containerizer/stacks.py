@@ -138,9 +138,11 @@ def detect_java_maven(d: str) -> StackMatch | None:
             artifact_id = m.group(1)
         m = re.search(r"<packaging>([^<]+)</packaging>", text)
         if m:
-            packaging = m.group(1)
+            packaging = m.group(1).strip()
     except OSError:
         pass
+    if packaging == "war":
+        return None  # handled by the war app-server variants
     return StackMatch("java-maven", {
         "artifact_id": artifact_id,
         "packaging": packaging,
@@ -156,6 +158,90 @@ def detect_java_gradle(d: str) -> StackMatch | None:
         "app_name": common.make_dns_label(os.path.basename(d.rstrip(os.sep)) or "app"),
         "port": common.DEFAULT_SERVICE_PORT,
     })
+
+
+def detect_java_ant(d: str) -> StackMatch | None:
+    """Ant builds (parity: internal/assets/dockerfiles/java ant detect)."""
+    build_xml = os.path.join(d, "build.xml")
+    if not os.path.isfile(build_xml):
+        return None
+    app_name = "app"
+    try:
+        m = re.search(r'<project[^>]*\sname="([^"]+)"',
+                      open(build_xml, encoding="utf-8", errors="ignore").read())
+        if m:
+            app_name = m.group(1)
+    except OSError:
+        pass
+    return StackMatch("java-ant", {
+        "app_name": common.make_dns_label(app_name),
+        "port": common.DEFAULT_SERVICE_PORT,
+    })
+
+
+def _war_build_info(d: str) -> dict | None:
+    """Detect a WAR-producing java build: maven <packaging>war</packaging>,
+    gradle war plugin, an ant build, or a prebuilt .war in the tree."""
+    files = _list_files(d)
+    pom = os.path.join(d, "pom.xml")
+    if os.path.isfile(pom):
+        try:
+            text = open(pom, encoding="utf-8", errors="ignore").read()
+        except OSError:
+            text = ""
+        if re.search(r"<packaging>\s*war\s*</packaging>", text):
+            # mvn package names the war artifactId-VERSION.war (or
+            # <finalName>); glob instead of guessing
+            return {"build_tool": "maven", "war_name": "*.war"}
+    for gradle in ("build.gradle", "build.gradle.kts"):
+        path = os.path.join(d, gradle)
+        if os.path.isfile(path):
+            try:
+                text = open(path, encoding="utf-8", errors="ignore").read()
+            except OSError:
+                text = ""
+            if re.search(r"""(apply\s+plugin|id)\s*[:(]?\s*['"]war['"]""", text):
+                return {"build_tool": "gradle", "war_name": "*.war"}
+    if os.path.isfile(os.path.join(d, "build.xml")):
+        try:
+            text = open(os.path.join(d, "build.xml"),
+                        encoding="utf-8", errors="ignore").read()
+        except OSError:
+            text = ""
+        if re.search(r"<war[\s>]", text):  # an actual <war> task element
+            return {"build_tool": "ant", "war_name": "*.war"}
+    wars = [f for f in files if f.endswith(".war")]
+    if wars:
+        return {"build_tool": "none", "war_name": wars[0]}
+    return None
+
+
+# app-server stack -> port it serves on
+WAR_SERVERS = {"java-war-tomcat": 8080, "java-war-liberty": 9080,
+               "java-war-jboss": 8080}
+
+
+def _war_build_stage(info: dict) -> str:
+    """Render the shared maven/gradle/ant build stage used by every
+    app-server template ('' for a prebuilt war)."""
+    path = os.path.join(ASSETS_DIR, "dockerfiles", "_java_war_buildstage.Dockerfile")
+    with open(path, encoding="utf-8") as f:
+        return common.render_template(f.read(), info).strip()
+
+
+def detect_java_war(d: str) -> list[StackMatch]:
+    """All app-server variants for a WAR-producing build, one scan
+    (parity: internal/assets/dockerfiles/java/war-{tomcat,liberty,jboss});
+    tomcat first = preferred default."""
+    info = _war_build_info(d)
+    if info is None:
+        return []
+    info["build_stage"] = _war_build_stage(info)
+    app_name = common.make_dns_label(os.path.basename(d.rstrip(os.sep)) or "app")
+    return [
+        StackMatch(stack, {"app_name": app_name, "port": port, **info})
+        for stack, port in WAR_SERVERS.items()
+    ]
 
 
 def detect_php(d: str) -> StackMatch | None:
@@ -183,13 +269,17 @@ def detect_ruby(d: str) -> StackMatch | None:
     })
 
 
-# Order matters: specific before generic (django before python).
-DETECTORS: list[Callable[[str], StackMatch | None]] = [
+# Order matters: specific before generic (django before python; war
+# app-server variants before plain jar builds). A detector may return a
+# single StackMatch, a list of them, or None.
+DETECTORS: list[Callable[[str], StackMatch | list[StackMatch] | None]] = [
     detect_django,
     detect_golang,
     detect_nodejs,
+    detect_java_war,
     detect_java_maven,
     detect_java_gradle,
+    detect_java_ant,
     detect_ruby,
     detect_php,
     detect_python,
@@ -201,7 +291,9 @@ def detect_stacks(directory: str) -> list[StackMatch]:
     out: list[StackMatch] = []
     for det in DETECTORS:
         m = det(directory)
-        if m is not None:
+        if isinstance(m, list):
+            out.extend(m)
+        elif m is not None:
             out.append(m)
     return out
 

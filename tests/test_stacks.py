@@ -1,0 +1,141 @@
+"""Stack detection + template rendering breadth (SURVEY §2.6 asset tree:
+java ant/war variants, s2i builder coverage)."""
+
+from __future__ import annotations
+
+import os
+
+from move2kube_tpu.containerizer import stacks
+from move2kube_tpu.containerizer.dockerfile import DockerfileContainerizer
+from move2kube_tpu.containerizer.s2i import BUILDERS
+from move2kube_tpu.types.plan import ContainerBuildType, Plan, PlanService
+from move2kube_tpu.utils import common
+
+WAR_POM = """<project>
+  <artifactId>shop-web</artifactId>
+  <packaging>war</packaging>
+</project>
+"""
+
+
+def _render(tmp_path, stack_dir, service="svc"):
+    plan = Plan(name="t", root_dir=str(tmp_path))
+    cz = DockerfileContainerizer()
+    cz.init(str(tmp_path))
+    options = cz.get_target_options(plan, str(stack_dir))
+    assert options, "no stack detected"
+    svc = PlanService(
+        service_name=service,
+        container_build_type=ContainerBuildType.NEW_DOCKERFILE,
+        containerization_target_options=options,
+    )
+    svc.add_source_artifact(PlanService.SOURCE_DIR_ARTIFACT, str(stack_dir))
+    return options, cz.get_container(plan, svc)
+
+
+def test_all_templates_exist_for_detectable_stacks():
+    available = set(stacks.available_stacks())
+    for expected in ("django", "golang", "java-ant", "java-gradle",
+                     "java-maven", "java-war-jboss", "java-war-liberty",
+                     "java-war-tomcat", "nodejs", "php", "python", "ruby"):
+        assert expected in available, expected
+
+
+def test_java_war_maven_prefers_appserver_variants(tmp_path):
+    d = tmp_path / "webapp"
+    d.mkdir()
+    (d / "pom.xml").write_text(WAR_POM)
+    matches = stacks.detect_stacks(str(d))
+    ids = [m.stack for m in matches]
+    assert ids[0] == "java-war-tomcat"  # most preferred first
+    assert "java-war-liberty" in ids and "java-war-jboss" in ids
+    assert "java-maven" not in ids  # jar template would mis-handle a war
+    options, container = _render(tmp_path, d)
+    df = container.new_files["Dockerfile.svc"]
+    assert "FROM maven" in df and "tomcat" in df
+    # maven names the artifact artifactId-VERSION.war -> must glob
+    assert "COPY --from=build /src/target/*.war" in df
+    assert 8080 in container.exposed_ports
+
+
+def test_java_war_liberty_port(tmp_path):
+    d = tmp_path / "webapp"
+    d.mkdir()
+    (d / "pom.xml").write_text(WAR_POM)
+    match = next(m for m in stacks.detect_stacks(str(d))
+                 if m.stack == "java-war-liberty")
+    assert match.params["port"] == 9080
+    df = common.render_template(stacks.read_template("java-war-liberty"),
+                                match.params)
+    assert "open-liberty" in df and "/config/dropins/" in df
+
+
+def test_java_war_prebuilt(tmp_path):
+    d = tmp_path / "prebuilt"
+    d.mkdir()
+    (d / "shop.war").write_text("")
+    match = next(m for m in stacks.detect_stacks(str(d))
+                 if m.stack == "java-war-jboss")
+    assert match.params["build_tool"] == "none"
+    df = common.render_template(stacks.read_template("java-war-jboss"),
+                                match.params)
+    assert "COPY shop.war" in df and "wildfly" in df
+
+
+def test_java_ant(tmp_path):
+    d = tmp_path / "legacy"
+    d.mkdir()
+    (d / "build.xml").write_text('<project name="Billing App"><target name="jar"/></project>')
+    matches = stacks.detect_stacks(str(d))
+    assert matches[0].stack == "java-ant"
+    assert matches[0].params["app_name"] == "billing-app"
+    df = common.render_template(stacks.read_template("java-ant"),
+                                matches[0].params)
+    assert "RUN ant" in df and "billing-app.jar" in df
+
+
+def test_gradle_war_plugin_detected(tmp_path):
+    d = tmp_path / "gweb"
+    d.mkdir()
+    (d / "build.gradle").write_text("plugins { id 'war' }\n")
+    ids = [m.stack for m in stacks.detect_stacks(str(d))]
+    assert "java-war-tomcat" in ids
+    assert "java-gradle" in ids  # plain gradle build still offered
+
+
+def test_ant_war_mention_is_not_a_war_build(tmp_path):
+    d = tmp_path / "antjar"
+    d.mkdir()
+    (d / "build.xml").write_text(
+        '<project name="cli"><!-- ships lib/old.war for tests -->'
+        '<target name="jar"/></project>'
+    )
+    ids = [m.stack for m in stacks.detect_stacks(str(d))]
+    assert "java-war-tomcat" not in ids
+    assert "java-ant" in ids
+
+
+def test_whitespace_war_packaging_excludes_jar_template(tmp_path):
+    d = tmp_path / "wsweb"
+    d.mkdir()
+    (d / "pom.xml").write_text(
+        "<project><artifactId>w</artifactId>"
+        "<packaging>\n  war\n</packaging></project>"
+    )
+    ids = [m.stack for m in stacks.detect_stacks(str(d))]
+    assert "java-maven" not in ids
+    assert "java-war-tomcat" in ids
+
+
+def test_jar_maven_unaffected(tmp_path):
+    d = tmp_path / "jarapp"
+    d.mkdir()
+    (d / "pom.xml").write_text("<project><artifactId>cli</artifactId></project>")
+    ids = [m.stack for m in stacks.detect_stacks(str(d))]
+    assert ids == ["java-maven"]
+
+
+def test_s2i_builders_cover_java_stacks():
+    for stack in ("java-ant", "java-war-tomcat", "java-war-liberty",
+                  "java-war-jboss"):
+        assert stack in BUILDERS
