@@ -9,6 +9,7 @@ NOTES.txt + helminstall.sh), deploy.sh and README.
 from __future__ import annotations
 
 import os
+import shutil
 
 from move2kube_tpu.apiresource.base import convert_objects
 from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
@@ -85,9 +86,10 @@ class K8sTransformer(Transformer):
         )
 
     def _write_helm(self, out_dir: str, ir: IR, proj: str) -> None:
-        """Helm chart scaffold (k8stransformer.go:157-219; operator scaffold
-        is delegated to `operator-sdk` in the reference and omitted unless
-        the tool is present — we emit the chart directly)."""
+        """Helm chart scaffold (k8stransformer.go:157-219) plus a
+        helm-operator scaffold (createOperator:219 — the reference execs
+        `operator-sdk new --type=helm`; we emit the equivalent files
+        directly so no tool is needed)."""
         chart_dir = os.path.join(out_dir, proj)
         common.write_file(
             os.path.join(chart_dir, "Chart.yaml"),
@@ -113,3 +115,37 @@ class K8sTransformer(Transformer):
                                    {"release": proj, "chart_dir": proj}),
             0o755,
         )
+        self._write_operator(out_dir, proj, chart_dir)
+
+    def _write_operator(self, out_dir: str, proj: str, chart_dir: str) -> None:
+        """helm-operator scaffold wrapping the generated chart
+        (k8stransformer.go createOperator:219)."""
+        op_dir = os.path.join(out_dir, "operator")
+        kind = "".join(p.capitalize() for p in proj.split("-"))
+        if not kind or not kind[0].isalpha():
+            kind = "App" + kind  # Kind must match ^[A-Z][a-zA-Z0-9]*$
+        singular = kind.lower()
+        params = {
+            "project": proj,
+            "group": "move2kube-tpu.io",
+            "kind": kind,
+            "singular": singular,
+            "plural": singular + "s",
+            "operator_image": f"{proj}-operator:latest",
+        }
+        files = {
+            ("watches.yaml",): templates.OPERATOR_WATCHES_YAML,
+            ("Dockerfile",): templates.OPERATOR_DOCKERFILE,
+            ("README.md",): templates.OPERATOR_README_MD,
+            ("deploy", "crds", f"{singular}_crd.yaml"): templates.OPERATOR_CRD_YAML,
+            ("deploy", "samples", f"{singular}_cr.yaml"): templates.OPERATOR_CR_YAML,
+            ("deploy", "operator.yaml"): templates.OPERATOR_DEPLOY_YAML,
+            ("deploy", "rbac.yaml"): templates.OPERATOR_RBAC_YAML,
+        }
+        for rel, template in files.items():
+            common.write_template_to_file(
+                template, params, os.path.join(op_dir, *rel))
+        # the operator image embeds the chart: ship a copy beside it
+        dest = os.path.join(op_dir, "helm-charts", proj)
+        if os.path.isdir(chart_dir):
+            shutil.copytree(chart_dir, dest, dirs_exist_ok=True)

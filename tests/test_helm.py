@@ -1,0 +1,70 @@
+"""Helm output mode: chart, parameterized values, operator scaffold
+(SURVEY §2.9 K8sTransformer helm path + createOperator)."""
+
+from __future__ import annotations
+
+import os
+
+import yaml
+
+from move2kube_tpu.engine import planner, translator
+from move2kube_tpu.qa import engine as qaengine
+from move2kube_tpu.types.plan import TargetArtifactType
+
+
+def _flask_tree(tmp_path):
+    src = tmp_path / "src" / "shop"
+    src.mkdir(parents=True)
+    (src / "app.py").write_text("import flask\n")
+    (src / "requirements.txt").write_text("flask\n")
+    return tmp_path / "src"
+
+
+def test_helm_translate_emits_chart_and_operator(tmp_path):
+    src = _flask_tree(tmp_path)
+    out = tmp_path / "out"
+    qaengine.reset_engines()
+    qaengine.start_engine(qa_skip=True)
+    try:
+        plan = planner.create_plan(str(src), name="shop")
+        plan.kubernetes.artifact_type = TargetArtifactType.HELM
+        translator.translate(plan, str(out))
+    finally:
+        qaengine.reset_engines()
+
+    chart = out / "shop"
+    meta = yaml.safe_load((chart / "Chart.yaml").read_text())
+    assert meta["name"] == "shop" and meta["apiVersion"] == "v2"
+    assert (chart / "values.yaml").exists()
+    assert (chart / "templates" / "NOTES.txt").exists()
+    tmpl_yamls = [f for f in os.listdir(chart / "templates")
+                  if f.endswith(".yaml")]
+    assert any("deployment" in f for f in tmpl_yamls)
+    assert (out / "helminstall.sh").exists()
+
+    # helm values are referenced from the parameterized templates
+    values = yaml.safe_load((chart / "values.yaml").read_text())
+    rendered = "".join((chart / "templates" / f).read_text()
+                       for f in tmpl_yamls)
+    assert "{{" in rendered  # parameterized refs survived serialization
+
+    # operator scaffold (operator-sdk new --type=helm equivalent)
+    op = out / "operator"
+    watches = yaml.safe_load((op / "watches.yaml").read_text())
+    assert watches[0]["kind"] == "Shop"
+    assert watches[0]["chart"] == "helm-charts/shop"
+    assert "helm-operator" in (op / "Dockerfile").read_text()
+    crd = yaml.safe_load((op / "deploy" / "crds" / "shop_crd.yaml").read_text())
+    assert crd["kind"] == "CustomResourceDefinition"
+    assert crd["spec"]["names"]["kind"] == "Shop"
+    assert (op / "deploy" / "samples" / "shop_cr.yaml").exists()
+    assert (op / "deploy" / "operator.yaml").exists()
+    rbac_docs = list(yaml.safe_load_all(
+        (op / "deploy" / "rbac.yaml").read_text()))
+    role = next(d for d in rbac_docs if d["kind"] == "Role")
+    all_groups = {g for rule in role["rules"] for g in rule["apiGroups"]}
+    # chart contains Role/RoleBinding templates: operator must manage them
+    assert "rbac.authorization.k8s.io" in all_groups
+    # chart copy embedded beside the operator Dockerfile
+    assert (op / "helm-charts" / "shop" / "Chart.yaml").exists()
+    assert values is not None
