@@ -40,13 +40,30 @@ BUILDPACK_OPTIONS: dict[str, list[str]] = {
 }
 
 
-def options_for_buildpack(buildpack: str) -> list[str]:
+def options_for_buildpack(buildpack: str,
+                          builder_buildpacks: set[str] | None = None) -> list[str]:
+    """Curated mapping, refined by the CNB builders' actual buildpack list
+    when a live provider could read it (parity: the reference vets CNB
+    candidacy via cnb.GetAllBuildpacks, cfcontainertypescollector.go)."""
     bp = buildpack.lower()
     for frag, opts in BUILDPACK_OPTIONS.items():
         # word-anchored: 'go' must not match 'django_buildpack'
         if re.search(rf"(^|[^a-z]){frag}([^a-z]|$)", bp):
-            return list(opts)
+            opts = list(opts)
+            if (builder_buildpacks and ContainerBuildType.CNB in opts
+                    and not any(frag in b for b in builder_buildpacks)):
+                opts.remove(ContainerBuildType.CNB)
+            return opts
     return [ContainerBuildType.MANUAL]
+
+
+def builder_buildpack_ids() -> set[str]:
+    """All buildpack ids baked into the default CNB builders, lowercased;
+    empty when no live provider (docker/pack) is available."""
+    from move2kube_tpu.containerizer.cnb import CNBContainerizer
+
+    listing = CNBContainerizer().get_all_buildpacks()
+    return {bp.lower() for bps in listing.values() for bp in bps}
 
 
 def buildpacks_from_manifests(source_dir: str) -> list[str]:
@@ -80,9 +97,10 @@ class CFContainerTypesCollector:
         if not buildpacks:
             log.debug("no CF buildpacks found; skipping")
             return
+        builder_bps = builder_buildpack_ids()
         mapping = collecttypes.CfContainerizers(
             buildpack_containerizers={
-                bp: options_for_buildpack(bp) for bp in buildpacks
+                bp: options_for_buildpack(bp, builder_bps) for bp in buildpacks
             }
         )
         dest = os.path.join(out_dir, "cf", "cfcontainerizers.yaml")

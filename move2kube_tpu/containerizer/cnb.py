@@ -1,21 +1,16 @@
 """Cloud Native Buildpacks containerizer.
 
 Parity: ``internal/containerizer/cnbcontainerizer.go`` + the ``cnb/``
-provider chain. The reference probes builder support by running the CNB
-lifecycle detector via the docker daemon / pack CLI / runc; all of those
-are environment-gated. We keep the same provider seam but default to a
-static heuristic (stack detection implies buildpack support) so planning
-works with no daemon, and shell out to ``pack`` only when available and
-``IGNORE_ENVIRONMENT`` is False. Results are memoised per directory
-(parity: cnbcache).
+provider chain (cnb_providers.py). The reference probes builder support by
+running the CNB lifecycle detector via the docker daemon / pack CLI /
+runc; we use the same ordered-provider seam (container runtime CLI → pack
+→ static stack heuristic) so planning works with or without a daemon.
+Results are memoised per directory (parity: cnbcache).
 """
 
 from __future__ import annotations
 
-import shutil
-import subprocess
-
-from move2kube_tpu.containerizer import stacks
+from move2kube_tpu.containerizer import cnb_providers
 from move2kube_tpu.containerizer.base import Containerizer
 from move2kube_tpu.containerizer.scripts import CNB_BUILD_SH
 from move2kube_tpu.types.ir import Container
@@ -28,52 +23,50 @@ log = get_logger("containerizer.cnb")
 # parity: hardcoded builders, cnbcontainerizer.go:41
 BUILDERS = ["gcr.io/buildpacks/builder", "paketobuildpacks/builder-jammy-base"]
 
-# stacks known to be supported by the default builders
-_BUILDPACK_STACKS = {
-    "python", "django", "nodejs", "golang", "java-maven", "java-gradle",
-    "java-ant", "java-war-tomcat", "java-war-liberty", "java-war-jboss",
-    "ruby", "php",
-}
-
 
 class CNBContainerizer(Containerizer):
     def __init__(self) -> None:
         self._cache: dict[str, list[str]] = {}
-        self._pack = None  # lazily resolved
+        self._providers: list | None = None
 
     def get_build_type(self) -> str:
         return ContainerBuildType.CNB
 
-    def _pack_available(self) -> bool:
-        if self._pack is None:
-            self._pack = (
-                not common.IGNORE_ENVIRONMENT and shutil.which("pack") is not None
-            )
-        return self._pack
+    @property
+    def providers(self) -> list:
+        if self._providers is None:
+            self._providers = cnb_providers.get_providers()
+        return self._providers
 
     def get_target_options(self, plan, directory: str) -> list[str]:
         if directory in self._cache:
             return self._cache[directory]
         options: list[str] = []
-        matched = {m.stack for m in stacks.detect_stacks(directory)}
-        if matched & _BUILDPACK_STACKS:
-            if self._pack_available():
-                options = [b for b in BUILDERS if self._probe_pack(directory, b)] or list(BUILDERS)
+        # cheap stack-heuristic gate first, so directories with no
+        # buildpack-shaped stack never cost a docker/pack exec probe
+        if cnb_providers.StaticProvider().is_builder_supported(directory, ""):
+            live = [
+                p for p in self.providers
+                if not isinstance(p, cnb_providers.StaticProvider)
+                and p.is_available()
+            ]
+            if live:
+                # refine builder list with the first live probe; a probe
+                # that denies/errors everywhere falls back to the full
+                # list — a broken runtime must not disable CNB
+                options = [
+                    b for b in BUILDERS
+                    if live[0].is_builder_supported(directory, b)
+                ] or list(BUILDERS)
             else:
                 options = list(BUILDERS)
         self._cache[directory] = options
         return options
 
-    def _probe_pack(self, directory: str, builder: str) -> bool:
-        try:
-            res = subprocess.run(
-                ["pack", "build", "--dry-run", "--builder", builder, "--path", directory,
-                 "m2kt-probe"],
-                capture_output=True, timeout=120, check=False,
-            )
-            return res.returncode == 0
-        except (OSError, subprocess.TimeoutExpired):
-            return False
+    def get_all_buildpacks(self) -> dict[str, list[str]]:
+        """Buildpacks baked into the default builders, when a live provider
+        can list them (parity: cnb provider.go GetAllBuildpacks:56)."""
+        return cnb_providers.get_all_buildpacks(self.providers, BUILDERS)
 
     def get_container(self, plan, service: PlanService) -> Container:
         if not service.containerization_target_options:

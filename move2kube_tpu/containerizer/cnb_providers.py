@@ -1,0 +1,188 @@
+"""CNB provider chain: probe whether a buildpacks builder supports a dir.
+
+Parity: ``internal/containerizer/cnb/provider.go:31`` — the reference keeps
+an ordered chain ``[dockerAPIProvider, containerRuntimeProvider,
+packProvider, runcProvider]`` and uses the first available one to (a) run
+the CNB lifecycle detector against a source dir (``IsBuilderSupported``,
+provider.go:68) and (b) list the buildpacks baked into a builder image
+(``GetAllBuildpacks``, provider.go:56).
+
+We keep the same seam with three providers:
+
+- ``ContainerRuntimeProvider`` — docker/podman CLI, runs
+  ``/cnb/lifecycle/detector`` inside the builder image with the source
+  mounted (parity: containerruntimeprovider.go).
+- ``PackProvider`` — the ``pack`` CLI (parity: packprovider.go:53).
+- ``StaticProvider`` — always-available fallback: a stack match from
+  stacks.py implies default-builder support, so planning works with no
+  daemon at all (net-new; replaces the reference's hard dependency on a
+  container runtime at plan time).
+
+There is no dockerAPI/runc provider because neither the docker SDK nor
+runc is a dependency of this environment; the CLI provider covers both
+docker and podman. Option lists are memoised per directory by the caller
+(parity: cnbcache, cnbcontainerizer.go:41).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+
+from move2kube_tpu.utils import common
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("containerizer.cnb.provider")
+
+_EXEC_TIMEOUT = 120
+
+
+def _run(cmd: list[str], timeout: int = _EXEC_TIMEOUT) -> subprocess.CompletedProcess | None:
+    try:
+        return subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, check=False)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
+class ContainerRuntimeProvider:
+    """Run the CNB lifecycle detector via the docker/podman CLI.
+
+    Parity: ``internal/containerizer/cnb/containerruntimeprovider.go``.
+    """
+
+    def __init__(self) -> None:
+        self._runtime: str | None | bool = False  # False = unresolved
+
+    def _get_runtime(self) -> str | None:
+        if self._runtime is False:
+            self._runtime = None
+            if not common.IGNORE_ENVIRONMENT:
+                for cli in ("docker", "podman"):
+                    if not shutil.which(cli):
+                        continue
+                    res = _run([cli, "info"], timeout=15)
+                    if res is not None and res.returncode == 0:
+                        self._runtime = cli
+                        break
+        return self._runtime
+
+    def is_available(self) -> bool:
+        return self._get_runtime() is not None
+
+    def is_builder_supported(self, directory: str, builder: str) -> bool:
+        cli = self._get_runtime()
+        if cli is None:
+            return False
+        # parity: run /cnb/lifecycle/detector with the app mounted at the
+        # CNB workspace path; detector exits 0 iff some buildpack group
+        # detects the source (containerruntimeprovider.go)
+        res = _run([
+            cli, "run", "--rm",
+            "-v", f"{directory}:/workspace:ro",
+            "--entrypoint", "/cnb/lifecycle/detector",
+            builder, "-app", "/workspace",
+        ])
+        return res is not None and res.returncode == 0
+
+    def get_all_buildpacks(self, builders: list[str]) -> dict[str, list[str]]:
+        """Builder image label ``io.buildpacks.builder.metadata`` lists its
+        buildpacks (parity: dockerapiprovider.go label read)."""
+        cli = self._get_runtime()
+        out: dict[str, list[str]] = {}
+        if cli is None:
+            return out
+        for builder in builders:
+            res = _run([
+                cli, "image", "inspect", builder, "--format",
+                '{{ index .Config.Labels "io.buildpacks.builder.metadata" }}',
+            ], timeout=30)
+            if res is None or res.returncode != 0:
+                continue
+            try:
+                meta = json.loads(res.stdout.strip())
+                out[builder] = [
+                    bp.get("id", "") for bp in meta.get("buildpacks", []) if bp.get("id")
+                ]
+            except (json.JSONDecodeError, AttributeError):
+                continue
+        return out
+
+
+class PackProvider:
+    """Probe via the ``pack`` CLI (parity: packprovider.go:53)."""
+
+    def is_available(self) -> bool:
+        return not common.IGNORE_ENVIRONMENT and shutil.which("pack") is not None
+
+    def is_builder_supported(self, directory: str, builder: str) -> bool:
+        res = _run(["pack", "build", "m2kt-probe", "--dry-run",
+                    "--builder", builder, "--path", directory])
+        return res is not None and res.returncode == 0
+
+    def get_all_buildpacks(self, builders: list[str]) -> dict[str, list[str]]:
+        out: dict[str, list[str]] = {}
+        for builder in builders:
+            res = _run(["pack", "builder", "inspect", builder,
+                        "--output", "json"], timeout=60)
+            if res is None or res.returncode != 0:
+                continue
+            try:
+                meta = json.loads(res.stdout)
+                bps = (meta.get("remote_info") or meta.get("local_info") or {}
+                       ).get("buildpacks", [])
+                out[builder] = [bp.get("id", "") for bp in bps if bp.get("id")]
+            except json.JSONDecodeError:
+                continue
+        return out
+
+
+class StaticProvider:
+    """Always-available fallback: stack detection implies support for the
+    default builders. Keeps planning runnable with no container runtime."""
+
+    # stacks known to be supported by the default builders' buildpacks
+    SUPPORTED_STACKS = {
+        "python", "django", "nodejs", "golang", "java-maven", "java-gradle",
+        "java-ant", "java-war-tomcat", "java-war-liberty", "java-war-jboss",
+        "ruby", "php",
+    }
+
+    def is_available(self) -> bool:
+        return True
+
+    def is_builder_supported(self, directory: str, builder: str) -> bool:
+        from move2kube_tpu.containerizer import stacks
+
+        return bool(
+            {m.stack for m in stacks.detect_stacks(directory)} & self.SUPPORTED_STACKS
+        )
+
+    def get_all_buildpacks(self, builders: list[str]) -> dict[str, list[str]]:
+        return {}
+
+
+def get_providers() -> list:
+    """Ordered chain (provider.go:31); live providers first, static last."""
+    return [ContainerRuntimeProvider(), PackProvider(), StaticProvider()]
+
+
+def is_builder_supported(providers: list, directory: str, builder: str) -> bool:
+    """True iff any available provider affirms support. A provider that is
+    unavailable, errors, or denies falls through to the next one — a
+    present-but-broken docker/pack must not disable CNB when the static
+    heuristic would have allowed it."""
+    return any(
+        p.is_available() and p.is_builder_supported(directory, builder)
+        for p in providers
+    )
+
+
+def get_all_buildpacks(providers: list, builders: list[str]) -> dict[str, list[str]]:
+    for p in providers:
+        if p.is_available():
+            bps = p.get_all_buildpacks(builders)
+            if bps:
+                return bps
+    return {}

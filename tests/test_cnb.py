@@ -1,0 +1,111 @@
+"""CNB provider chain (SURVEY §2.5: cnb/provider.go ordered chain,
+memoised builder-support probing, buildpack listing)."""
+
+from __future__ import annotations
+
+from move2kube_tpu.containerizer import cnb_providers
+from move2kube_tpu.containerizer.cnb import BUILDERS, CNBContainerizer
+from move2kube_tpu.types.plan import ContainerBuildType, Plan, PlanService
+
+
+class FakeProvider:
+    """Scriptable provider standing in for docker/pack."""
+
+    def __init__(self, available: bool, supported: bool,
+                 buildpacks: dict | None = None):
+        self.available = available
+        self.supported = supported
+        self.buildpacks = buildpacks or {}
+        self.probes = 0
+
+    def is_available(self):
+        return self.available
+
+    def is_builder_supported(self, directory, builder):
+        self.probes += 1
+        return self.supported
+
+    def get_all_buildpacks(self, builders):
+        return self.buildpacks
+
+
+def test_denying_provider_falls_through():
+    unavailable = FakeProvider(available=False, supported=True)
+    deny = FakeProvider(available=True, supported=False)
+    affirm = FakeProvider(available=True, supported=True)
+    chain = [unavailable, deny, affirm]
+    assert cnb_providers.is_builder_supported(chain, "/src", "b") is True
+    assert unavailable.probes == 0
+    assert deny.probes == 1
+    assert affirm.probes == 1
+    assert cnb_providers.is_builder_supported([deny], "/src", "b") is False
+
+
+def test_broken_live_provider_does_not_disable_cnb(tmp_path):
+    """A present-but-failing docker/pack must not yield worse results than
+    having no runtime at all: options fall back to the full builder list."""
+    (tmp_path / "requirements.txt").write_text("flask\n")
+    (tmp_path / "app.py").write_text("x = 1\n")
+    cz = CNBContainerizer()
+    broken = FakeProvider(available=True, supported=False)
+    cz._providers = [broken, cnb_providers.StaticProvider()]
+    plan = Plan(name="t", root_dir=str(tmp_path))
+    assert cz.get_target_options(plan, str(tmp_path)) == BUILDERS
+
+
+def test_no_stack_match_skips_exec_probes(tmp_path):
+    (tmp_path / "notes.txt").write_text("nothing containerizable\n")
+    cz = CNBContainerizer()
+    live = FakeProvider(available=True, supported=True)
+    cz._providers = [live, cnb_providers.StaticProvider()]
+    plan = Plan(name="t", root_dir=str(tmp_path))
+    assert cz.get_target_options(plan, str(tmp_path)) == []
+    assert live.probes == 0  # gated by the cheap stack heuristic
+
+
+def test_buildpack_listing_falls_through_empty_results():
+    empty = FakeProvider(available=True, supported=True, buildpacks={})
+    full = FakeProvider(available=True, supported=True,
+                        buildpacks={"b": ["google.python"]})
+    assert cnb_providers.get_all_buildpacks([empty, full], ["b"]) == {
+        "b": ["google.python"]
+    }
+
+
+def test_static_provider_detects_python_tree(tmp_path):
+    (tmp_path / "requirements.txt").write_text("flask\n")
+    (tmp_path / "app.py").write_text("print('hi')\n")
+    p = cnb_providers.StaticProvider()
+    assert p.is_available()
+    assert p.is_builder_supported(str(tmp_path), BUILDERS[0])
+    assert not p.is_builder_supported(str(tmp_path / "nothing-here"), BUILDERS[0])
+
+
+def test_containerizer_memoises_probes(tmp_path):
+    (tmp_path / "package.json").write_text('{"name": "web"}')
+    cz = CNBContainerizer()
+    fake = FakeProvider(available=True, supported=True)
+    cz._providers = [fake]
+    plan = Plan(name="t", root_dir=str(tmp_path))
+    first = cz.get_target_options(plan, str(tmp_path))
+    second = cz.get_target_options(plan, str(tmp_path))
+    assert first == second == BUILDERS
+    assert fake.probes == len(BUILDERS)  # cached on the second call
+
+
+def test_get_container_emits_build_script(tmp_path):
+    (tmp_path / "package.json").write_text('{"name": "web"}')
+    cz = CNBContainerizer()
+    cz._providers = [FakeProvider(available=True, supported=True)]
+    plan = Plan(name="t", root_dir=str(tmp_path))
+    svc = PlanService(
+        service_name="web",
+        container_build_type=ContainerBuildType.CNB,
+        containerization_target_options=[BUILDERS[0]],
+    )
+    svc.source_artifacts[PlanService.SOURCE_DIR_ARTIFACT] = [str(tmp_path)]
+    container = cz.get_container(plan, svc)
+    assert container.new
+    script = container.new_files["web-cnb-build.sh"]
+    assert BUILDERS[0] in script
+    assert "pack build" in script
