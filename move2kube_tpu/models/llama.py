@@ -10,12 +10,22 @@ and gate+up projections (bigger MXU matmuls); GQA; rotary embeddings
 computed in float32. Tensor-parallel sharding is annotated with
 ``with_sharding_constraint`` on the activations: column-split QKV/gate-up,
 row-split out/down projections — XLA inserts the psum on the ``tensor``
-axis exactly where Megatron would call all-reduce. Long sequences can route
-attention through ``parallel.ring_attention`` over the ``seq`` axis.
+axis exactly where Megatron would call all-reduce.
+
+Attention is selected by ``LlamaConfig.attn_impl``:
+
+- ``dense``   — plain einsum attention (default; XLA/GSPMD partitions it)
+- ``flash``   — the Pallas fused kernel (ops/attention.py) on TPU
+- ``ring``    — ring attention over the ``seq`` mesh axis (long context)
+- ``ulysses`` — all-to-all head-resharded attention over ``seq``
+
+``ring``/``ulysses`` need an active mesh with a non-trivial ``seq`` axis
+(jax.set_mesh / use_mesh); otherwise they fall back to ``flash``.
 """
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Any
 
@@ -36,6 +46,7 @@ class LlamaConfig:
     max_len: int = 4096
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
+    attn_impl: str = "dense"  # dense | flash | ring | ulysses
 
 
 def llama_8b() -> LlamaConfig:
@@ -92,6 +103,44 @@ class RMSNorm(nn.Module):
         return (norm * scale).astype(x.dtype)
 
 
+def _seq_axis_size() -> int:
+    """Size of the ambient mesh's ``seq`` axis (1 when no mesh is set)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if getattr(mesh, "empty", True) or "seq" not in mesh.axis_names:
+        return 1
+    return mesh.shape["seq"]
+
+
+def _attention(q, k, v, mask, impl: str):
+    """Dispatch on LlamaConfig.attn_impl; q/k/v [b, s, h, d] -> [b, s, h, d].
+
+    ``mask`` is the additive causal mask used by the dense path; the other
+    implementations derive causality themselves. ring/ulysses run under
+    shard_map on the ambient mesh's ``seq`` axis and degrade to flash when
+    that axis is trivial (single chip, seq=1 meshes).
+    """
+    from move2kube_tpu.ops.attention import flash_attention
+
+    head_dim = q.shape[-1]
+    if impl in ("ring", "ulysses") and _seq_axis_size() > 1:
+        from move2kube_tpu.parallel.ring_attention import ring_attention
+        from move2kube_tpu.parallel.ulysses import ulysses_attention
+
+        fn = ring_attention if impl == "ring" else ulysses_attention
+        spec = P(("data", "fsdp"), "seq", "tensor", None)
+        run = jax.shard_map(
+            functools.partial(fn, axis_name="seq", causal=True),
+            in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+        )
+        return run(q, k, v)
+    if impl in ("flash", "ring", "ulysses"):
+        return flash_attention(q, k, v, causal=True)
+    s_logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    s_logits = s_logits * (head_dim ** -0.5) + mask
+    p = jax.nn.softmax(s_logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
 class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
@@ -118,10 +167,7 @@ class LlamaBlock(nn.Module):
         rep = cfg.num_heads // cfg.num_kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-        s_logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-        s_logits = s_logits * (head_dim ** -0.5) + mask
-        p = jax.nn.softmax(s_logits, axis=-1).astype(cfg.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(b, s, q_size)
+        o = _attention(q, k, v, mask, cfg.attn_impl).reshape(b, s, q_size)
         # row-split output projection: XLA inserts the tensor-axis psum here
         o = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype, name="attn_out")(o)
         x = x + o

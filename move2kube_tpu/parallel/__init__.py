@@ -14,6 +14,10 @@ from move2kube_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     initialize_distributed,
 )
+from move2kube_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 from move2kube_tpu.parallel.sharding import (  # noqa: F401
     ShardingRules,
     logical_sharding,

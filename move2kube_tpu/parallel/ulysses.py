@@ -1,0 +1,76 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+The second context-parallel scheme next to ring attention (SURVEY.md §5
+maps DeepSpeed-Ulysses onto the ``seq`` mesh axis). Where ring attention
+rotates K/V blocks around the ring (axis_size ppermute hops), Ulysses does
+two ``all_to_all`` collectives: reshard [batch, seq/P, heads, d] into
+[batch, seq, heads/P, d], run *unsharded* attention on the local head
+subset, and reshard back. On a TPU ICI torus the all-to-all rides the same
+links with one logical exchange each way, so it wins whenever the head
+count divides the seq axis — ring attention remains the fallback for few
+heads or sequences too long to materialize per-device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _full_attention(q, k, v, *, causal: bool, scale: float):
+    """Plain attention on [b, s, h, d] (full sequence, local heads)."""
+    s_len = q.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        q_pos = jnp.arange(s_len)[:, None]
+        k_pos = jnp.arange(s_len)[None, :]
+        logits = jnp.where((q_pos >= k_pos)[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "seq",
+                      causal: bool = False, scale: float | None = None):
+    """Exact attention over sequence-sharded inputs via head all-to-all.
+
+    Args:
+      q, k, v: [batch, seq_shard, heads, head_dim] local shards; ``heads``
+        must be divisible by the ``axis_name`` mesh-axis size.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    axis_size = jax.lax.axis_size(axis_name)
+    if q.shape[2] % axis_size:
+        raise ValueError(
+            f"heads ({q.shape[2]}) not divisible by |{axis_name}| ({axis_size}); "
+            "use ring_attention instead"
+        )
+    # [b, s/P, h, d] -> [b, s, h/P, d]: gather sequence, scatter heads
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            split_axis=2, concat_axis=1, tiled=True)
+    qf, kf, vf = a2a(q), a2a(k), a2a(v)
+    of = _full_attention(qf, kf, vf, causal=causal, scale=scale)
+    # [b, s, h/P, d] -> [b, s/P, h, d]: scatter sequence, gather heads
+    return jax.lax.all_to_all(of, axis_name=axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_attention_sharded(mesh: Mesh, q, k, v, *, causal: bool = False):
+    """Convenience wrapper: shard_map ulysses_attention over the mesh.
+
+    Inputs are [batch, seq, heads, head_dim] global arrays; batch sharded
+    over (data, fsdp), seq over seq, heads over tensor (same layout as
+    ring_attention_sharded, so the two are drop-in interchangeable).
+    """
+    spec = P(("data", "fsdp"), "seq", "tensor", None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False,
+    )
+    def run(ql, kl, vl):
+        return ulysses_attention(ql, kl, vl, axis_name="seq", causal=causal)
+
+    return run(q, k, v)

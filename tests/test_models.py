@@ -127,3 +127,71 @@ def test_flash_attention_fallback_matches():
     out = flash_attention(q, k, v, causal=True)
     ref = _reference_attention(q, k, v, True, d ** -0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_attention_matches_reference():
+    from move2kube_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    mesh = make_mesh(MeshConfig(data=2, fsdp=1, tensor=1, seq=4))
+    b, s, h, d = 2, 64, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
+    out = ulysses_attention_sharded(mesh, q, k, v, causal=True)
+    scale = d ** -0.5
+    sref = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = np.tril(np.ones((s, s), bool))
+    sref = jnp.where(mask[None, None], sref, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sref, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    import pytest
+
+    from move2kube_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, tensor=1, seq=8))
+    b, s, h, d = 1, 32, 4, 8  # 4 heads cannot split over seq=8
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
+    with pytest.raises(ValueError, match="ring_attention"):
+        ulysses_attention_sharded(mesh, q, k, v)
+
+
+def test_llama_context_parallel_attn_matches_dense():
+    """attn_impl=ring/ulysses over a seq=4 mesh must match the dense path."""
+    import dataclasses
+
+    from move2kube_tpu.models.train import _mesh_context
+
+    ids = jnp.asarray(np.random.randint(0, 500, (2, 64)))
+    base = dataclasses.replace(llama.llama_tiny(), dtype=jnp.float32)
+    mesh1 = make_mesh(MeshConfig(), devices=jax.devices()[:1])
+    model = llama.Llama(base)
+    with _mesh_context(mesh1):
+        params = model.init(jax.random.PRNGKey(1), ids)["params"]
+        ref = model.apply({"params": params}, ids)
+
+    mesh = make_mesh(MeshConfig(data=2, fsdp=1, tensor=1, seq=4))
+    for impl in ("ring", "ulysses"):
+        cfg = dataclasses.replace(base, attn_impl=impl)
+        m = llama.Llama(cfg)
+        p = jax.device_put(
+            params, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+        with _mesh_context(mesh):
+            out = jax.jit(lambda pp, ii, mm=m: mm.apply({"params": pp}, ii))(p, ids)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-4,
+                                   err_msg=impl)
+
+
+def test_llama_flash_impl_matches_dense():
+    import dataclasses
+
+    ids = jnp.asarray(np.random.randint(0, 500, (2, 32)))
+    base = dataclasses.replace(llama.llama_tiny(), dtype=jnp.float32)
+    model = llama.Llama(base)
+    params = model.init(jax.random.PRNGKey(1), ids)["params"]
+    ref = model.apply({"params": params}, ids)
+    flash = llama.Llama(dataclasses.replace(base, attn_impl="flash"))
+    out = flash.apply({"params": params}, ids)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-4)
