@@ -1,0 +1,30 @@
+# move2kube-tpu developer targets (parity: reference Makefile:14-110;
+# no binary build step — pure-Python package + vendored JAX model zoo).
+
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test test-fast lint bench dryrun e2e clean
+
+test:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q
+
+test-fast:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow" -x
+
+lint:
+	$(PY) -m compileall -q move2kube_tpu
+	$(PY) -c "import move2kube_tpu.cli.main"
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	$(CPU_ENV) $(PY) -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
+	import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+e2e:
+	$(CPU_ENV) $(PY) -m pytest tests/test_e2e_translate.py tests/test_gpu2tpu_e2e.py -q
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="serve questions over REST on this port")
     tp.add_argument("--qa-cache", default=_env_default("qa_cache", ""),
                     help="replay answers from a previous run's cache file")
+    tp.add_argument("--qa-disable-cli", action="store_true",
+                    default=_env_bool("qa_disable_cli"),
+                    help="never prompt on the terminal; answer over REST "
+                         "(--qa-port, or an OS-assigned port) instead")
     tp.add_argument("--ignore-env", action="store_true", default=False,
                     help="derive nothing from the local environment")
 
@@ -99,9 +103,11 @@ def translate_handler(args) -> int:
     if args.ignore_env:
         common.IGNORE_ENVIRONMENT = True
     qa.reset_engines()
-    interactive = (args.curate or bool(args.qa_port)) and not args.qa_skip
+    interactive = (
+        args.curate or bool(args.qa_port) or args.qa_disable_cli
+    ) and not args.qa_skip
     qa.start_engine(interactive=interactive, qa_skip=args.qa_skip,
-                    qa_port=args.qa_port)
+                    qa_port=args.qa_port, qa_disable_cli=args.qa_disable_cli)
     if args.qa_cache:
         qa.add_cache_engine(args.qa_cache)
 

@@ -64,11 +64,16 @@ def reset_engines() -> None:
 
 
 def start_engine(interactive: bool = False, qa_skip: bool = False,
-                 qa_port: int = 0) -> None:
-    """Install the interactive (or default) engine (engine.go:40-66)."""
+                 qa_port: int = 0, qa_disable_cli: bool = False) -> None:
+    """Install the interactive (or default) engine (engine.go:40-66).
+
+    ``qa_disable_cli`` (parity: --qadisablecli, cmd translate.go) forces
+    REST even without an explicit port: port 0 binds an OS-assigned one
+    (reference: freeport), logged by the engine at startup.
+    """
     if qa_skip or not interactive:
         add_engine(DefaultEngine())
-    elif qa_port:
+    elif qa_port or qa_disable_cli:
         from move2kube_tpu.qa.rest_engine import HTTPRESTEngine
 
         add_engine(HTTPRESTEngine(qa_port))

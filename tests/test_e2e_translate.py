@@ -136,3 +136,26 @@ def test_qa_cache_replay(tmp_path):
                    cwd=str(tmp_path))
     assert res2.returncode == 0, res2.stderr
     assert (tmp_path / "out2" / "python" / "python-deployment.yaml").exists()
+
+
+@pytest.mark.parametrize("sample", [
+    "python", "nodejs", "golang", "java-maven", "java-gradle", "php", "ruby",
+])
+def test_translate_every_stack_sample(tmp_path, sample):
+    """Every bundled single-service stack translates into a buildable
+    Dockerfile + Deployment + Service (parity: the reference's samples/
+    smoke matrix, SURVEY.md §2.14)."""
+    res = run_cli("translate", "-s", os.path.join(SAMPLES, sample),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    out = tmp_path / "out"
+    objs = load_all_yamls(out)
+    assert {"Deployment", "Service"} <= kinds(objs), res.stderr
+    dockerfiles = [
+        os.path.join(dp, f)
+        for dp, _d, files in os.walk(out / "containers")
+        for f in files if f.startswith("Dockerfile")
+    ]
+    assert dockerfiles, "no Dockerfile emitted"
+    content = open(dockerfiles[0]).read()
+    assert content.startswith("FROM "), content[:80]

@@ -135,3 +135,18 @@ def test_fetch_answer_falls_back_to_default():
     qaengine.add_engine(BrokenEngine())
     ans = qaengine.fetch_bool("b", "continue?", [], default=True)
     assert ans is True
+
+
+def test_start_engine_qa_disable_cli_uses_rest():
+    """--qa-disable-cli must install the REST engine even with no explicit
+    port (parity: --qadisablecli + freeport)."""
+    from move2kube_tpu.qa import engine as qaengine
+    from move2kube_tpu.qa.rest_engine import HTTPRESTEngine
+
+    qaengine.reset_engines()
+    try:
+        qaengine.start_engine(interactive=True, qa_disable_cli=True)
+        assert isinstance(qaengine._engines[-1], HTTPRESTEngine)
+        assert qaengine._engines[-1]._server is not None
+    finally:
+        qaengine.reset_engines()
