@@ -60,9 +60,18 @@ def _tpu_resources(svc: Service, workload_kind: str = JOB_SET) -> None:
         res.setdefault("requests", {})["google.com/tpu"] = chips_per_host
         env = c.setdefault("env", [])
         existing = {e.get("name") for e in env}
+        # checkpoint/resume: point the training program at the first
+        # mounted volume so preempted JobSet pods restart from the latest
+        # step (models/checkpoint.py reads M2KT_CKPT_DIR)
+        mounts = c.get("volumeMounts") or []
+        ckpt_dir = (
+            mounts[0].get("mountPath", "").rstrip("/") + "/m2kt-checkpoints"
+            if mounts else ""
+        )
         for name, value in (
             ("M2KT_NUM_HOSTS", str(acc.num_hosts)),
             ("M2KT_COORDINATOR", coordinator if acc.num_hosts > 1 else ""),
+            ("M2KT_CKPT_DIR", ckpt_dir),
         ):
             if value and name not in existing:
                 env.append({"name": name, "value": value})

@@ -1,0 +1,90 @@
+"""Sharded training checkpoint/resume (orbax).
+
+The reference's resumability story is plan + QA-cache files (SURVEY.md §5
+"checkpoint/resume"); for the *generated training programs* the equivalent
+is real model checkpointing: a JobSet pod that is preempted or fails must
+restart from the latest step, not step 0. Orbax handles the TPU-specific
+parts — per-host shard writing (each process persists only its addressable
+shards), async save off the training thread, and restore into an arbitrary
+new sharding layout, so a job can resume on a different mesh shape.
+
+Emitted training entrypoints (assets/jax/train_tpu.py) call
+``restore_or_init`` at startup and ``CheckpointManager.maybe_save`` every
+``M2KT_CKPT_EVERY`` steps, pointed at ``M2KT_CKPT_DIR`` (a GCS bucket or
+ReadWriteMany PVC mount in the JobSet spec).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+# stdlib logging, not utils.log: the jax-xla containerizer vendors only the
+# dependency-light models/parallel/ops packages into emitted images
+log = logging.getLogger("m2kt.checkpoint")
+
+
+def _manager(ckpt_dir: str, max_to_keep: int = 3):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        os.path.abspath(ckpt_dir),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=True,
+        ),
+    )
+
+
+class CheckpointManager:
+    """Thin wrapper owning an orbax CheckpointManager.
+
+    Keeps the emitted training loop to three calls: ``restore_or_init``,
+    ``maybe_save``, ``close``.
+    """
+
+    def __init__(self, ckpt_dir: str, every: int = 100, max_to_keep: int = 3):
+        self.every = max(1, every)
+        self._mngr = _manager(ckpt_dir, max_to_keep)
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore_or_init(self, state):
+        """Return (state, start_step): the latest checkpoint restored into
+        ``state``'s sharding layout, or ``state`` itself at step 0."""
+        import orbax.checkpoint as ocp
+
+        step = self._mngr.latest_step()
+        if step is None:
+            return state, 0
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
+        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        log.info("resumed from checkpoint step %d", step)
+        return restored, step
+
+    def maybe_save(self, step: int, state, force: bool = False) -> bool:
+        """Save when ``step`` hits the cadence (async; returns immediately)."""
+        if not force and step % self.every:
+            return False
+        import orbax.checkpoint as ocp
+
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        return True
+
+    def close(self) -> None:
+        """Block until in-flight async saves land, then release."""
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+
+def from_env(default_every: int = 100) -> CheckpointManager | None:
+    """Build a manager from the env the TPU apiresources inject
+    (M2KT_CKPT_DIR / M2KT_CKPT_EVERY); None when checkpointing is off."""
+    ckpt_dir = os.environ.get("M2KT_CKPT_DIR", "")
+    if not ckpt_dir:
+        return None
+    every = int(os.environ.get("M2KT_CKPT_EVERY", str(default_every)))
+    return CheckpointManager(ckpt_dir, every=every)
