@@ -64,11 +64,23 @@ def emit_container(service: PlanService, plan=None) -> Container:
     if family not in KNOWN_FAMILIES:
         family = "generic"
 
+    # MoE only exists in the decoder-LM family; elsewhere detected expert
+    # settings would shape a mesh the trainer can't use
+    moe_experts = (acc.parallelism.get("experts", 0)
+                   if family in ("llama", "gpt") else 0)
+    # Detected GPU pipeline parallelism is deliberately NOT given a mesh
+    # axis: on a TPU slice the ICI makes FSDP strictly better than a GPipe
+    # bubble for the sizes pp is used at on GPUs, so the pp degree folds
+    # into the data/fsdp remainder (parallel/pipeline.py stays available
+    # for models too deep to FSDP). A pipe axis the emitted trainer didn't
+    # stage over would just replicate work across pp devices.
     mesh = infer_mesh_config(
         max(1, acc.gpu_count),
-        zero_stage=acc.parallelism.get("zero_stage", 0),
+        zero_stage=max(acc.parallelism.get("zero_stage", 0),
+                       2 if acc.parallelism.get("pp", 1) > 1 else 0),
         tensor_parallel=acc.parallelism.get("tp", 1),
         seq_parallel=acc.parallelism.get("sp", 1),
+        expert_parallel=acc.parallelism.get("ep", 1) if moe_experts else 1,
     )
 
     name = common.make_dns_label(service.service_name)
@@ -105,6 +117,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
             "tpu_topology": acc.tpu_topology or "1x1",
             "num_hosts": acc.num_hosts,
             "mesh": mesh,
+            "moe_experts": moe_experts,
             "steps": 100,
             "lr": 3e-4 if family in ("llama", "gpt") else 1e-3,
         }),

@@ -34,6 +34,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+# activation sharding constraint, pruned to the active mesh (shared with
+# moe.py; a no-op when no mesh context is set, so single-chip runs work)
+from move2kube_tpu.parallel.sharding import maybe_shard as _maybe_shard
+
 
 @dataclass(frozen=True)
 class LlamaConfig:
@@ -47,6 +51,8 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
     attn_impl: str = "dense"  # dense | flash | ring | ulysses
+    moe_experts: int = 0      # 0 = dense MLP; >0 = MoE with expert parallelism
+    moe_top_k: int = 2
 
 
 def llama_8b() -> LlamaConfig:
@@ -71,24 +77,6 @@ def _rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
-def _maybe_shard(x, spec: P):
-    """Apply a sharding constraint only when a mesh context is active, so
-    the model also runs unsharded (single chip, no jax.set_mesh)."""
-    mesh = jax.sharding.get_abstract_mesh()
-    if getattr(mesh, "empty", True):
-        return x
-    # only constrain axes that exist in the active mesh
-    names = set(mesh.axis_names)
-    pruned = []
-    for entry in spec:
-        if entry is None:
-            pruned.append(None)
-        elif isinstance(entry, tuple):
-            kept = tuple(a for a in entry if a in names)
-            pruned.append(kept if kept else None)
-        else:
-            pruned.append(entry if entry in names else None)
-    return jax.lax.with_sharding_constraint(x, P(*pruned))
 
 
 class RMSNorm(nn.Module):
@@ -173,6 +161,15 @@ class LlamaBlock(nn.Module):
         x = x + o
 
         h = RMSNorm(name="mlp_norm")(x)
+        if cfg.moe_experts > 0:
+            from move2kube_tpu.models.moe import MoEMlp
+
+            h, aux = MoEMlp(num_experts=cfg.moe_experts, mlp_dim=cfg.mlp_dim,
+                            top_k=cfg.moe_top_k, dtype=cfg.dtype,
+                            name="moe")(h)
+            # surfaced to the trainer via mutable=["losses"] (train.py)
+            self.sow("losses", "moe_aux", aux)
+            return x + h
         # fused gate+up, column-split
         gate_up = nn.Dense(2 * cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
                            name="gate_up")(h)

@@ -73,7 +73,10 @@ def create_sharded_state(
 
     def init_fn(rng):
         variables = model.init(rng, **sample_input)
-        return variables
+        # keep only persistent state: sown collections like MoE "losses"
+        # are per-forward outputs, not state to carry in TrainState
+        return {k: v for k, v in variables.items()
+                if k in ("params", "batch_stats")}
 
     with _mesh_context(mesh):
         shapes = jax.eval_shape(init_fn, rng)
@@ -146,9 +149,15 @@ def make_bert_train_step(mesh: Mesh):
     return _with_mesh(mesh, step)
 
 
-def make_lm_train_step(mesh: Mesh, remat: bool = True):
+def make_lm_train_step(mesh: Mesh, remat: bool = True,
+                       moe_aux_weight: float = 0.01):
     """Next-token-prediction step for Llama-class models; rematerialises
-    per-block activations (jax.checkpoint) to trade FLOPs for HBM."""
+    per-block activations (jax.checkpoint) to trade FLOPs for HBM.
+
+    MoE models sow their load-balancing losses into the ``losses``
+    collection (llama.py LlamaBlock); they are summed into the loss with
+    weight ``moe_aux_weight`` (no-op for dense models: the collection is
+    empty)."""
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: dict):
@@ -156,11 +165,15 @@ def make_lm_train_step(mesh: Mesh, remat: bool = True):
             batch["input_ids"], NamedSharding(mesh, P(("data", "fsdp"))))
 
         def loss_fn(params):
-            apply = state.apply_fn
+            def fwd(p, x):
+                return state.apply_fn({"params": p}, x, mutable=["losses"])
+
             if remat:
-                apply = jax.checkpoint(apply)
-            logits = apply({"params": params}, ids)
-            return lm_loss(logits, ids)
+                fwd = jax.checkpoint(fwd)
+            logits, sown = fwd(params, ids)
+            aux = sum((jnp.sum(v) for v in jax.tree.leaves(sown)),
+                      jnp.float32(0.0))
+            return lm_loss(logits, ids) + moe_aux_weight * aux
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         return state.apply_gradients(grads=grads), loss

@@ -5,13 +5,15 @@ The mesh axes follow the standard TPU recipe (scaling-book):
 - ``data``   — pure data parallelism; gradients all-reduced (psum) over ICI/DCN
 - ``fsdp``   — data parallelism with parameter/optimizer sharding
                (ZeRO-3 equivalent); params all-gathered per layer
+- ``pipe``   — pipeline stages (compiled GPipe schedule, parallel/pipeline.py)
 - ``tensor`` — tensor (megatron-style) model parallelism; activations
                all-reduced per block, so this axis must sit on ICI
-- ``seq``    — sequence/context parallelism for ring attention
+- ``seq``    — sequence/context parallelism (ring / Ulysses attention)
+- ``expert`` — MoE expert parallelism (models/moe.py; all-to-alls on ICI)
 
 The GPU->TPU translation maps: DDP -> data, DeepSpeed ZeRO-3 -> fsdp,
-Megatron TP -> tensor, DeepSpeed-Ulysses / context parallel -> seq
-(SURVEY.md §5 long-context mapping).
+GPipe/Megatron PP -> pipe, Megatron TP -> tensor, DeepSpeed-Ulysses /
+context parallel -> seq, DeepSpeed-MoE EP -> expert (SURVEY.md §5).
 
 Multi-host bootstrap honors the env the TPU apiresources inject into
 JobSet pods (containerizer/jax_emit.py writes the consumer side).
@@ -31,34 +33,54 @@ if TYPE_CHECKING:  # jax is imported lazily: the CLI emit path only needs
 class MeshConfig:
     data: int = 1
     fsdp: int = 1
+    pipe: int = 1    # pipeline stages (parallel/pipeline.py)
     tensor: int = 1
     seq: int = 1
+    expert: int = 1  # MoE expert parallelism (models/moe.py)
 
-    AXES = ("data", "fsdp", "tensor", "seq")
+    # outer -> inner: DCN-tolerant axes (data, pipe) first, ICI-hungry axes
+    # (tensor, seq, expert) innermost so their collectives ride ICI
+    AXES = ("data", "fsdp", "pipe", "tensor", "seq", "expert")
 
     def total(self) -> int:
-        return self.data * self.fsdp * self.tensor * self.seq
+        n = 1
+        for d in self.dims():
+            n *= d
+        return n
 
-    def dims(self) -> tuple[int, int, int, int]:
-        return (self.data, self.fsdp, self.tensor, self.seq)
+    def dims(self) -> tuple[int, ...]:
+        return (self.data, self.fsdp, self.pipe, self.tensor, self.seq,
+                self.expert)
 
 
 def infer_mesh_config(n_devices: int, *, zero_stage: int = 0,
-                      tensor_parallel: int = 1, seq_parallel: int = 1) -> MeshConfig:
+                      tensor_parallel: int = 1, seq_parallel: int = 1,
+                      pipeline_parallel: int = 1,
+                      expert_parallel: int = 1) -> MeshConfig:
     """Choose mesh dims for a device count + detected GPU parallelism.
 
-    ZeRO>=2 maps the whole data dimension to fsdp; tensor/seq parallel
-    claim their factors first (innermost, so they land on adjacent ICI
-    neighbours); the remainder is data (or fsdp) parallel.
+    ZeRO>=2 maps the whole data dimension to fsdp; tensor/seq/expert
+    parallel claim their factors first (innermost, so they land on
+    adjacent ICI neighbours), pipeline next; the remainder is data (or
+    fsdp) parallel. Degrees that don't divide the device count are
+    dropped (fall back towards pure data parallel), mirroring how the
+    detected GPU world may not map 1:1 onto the TPU slice.
     """
     tensor = max(1, tensor_parallel)
     seq = max(1, seq_parallel)
-    if n_devices % (tensor * seq):
-        tensor = seq = 1  # fall back to pure data parallel
-    rest = n_devices // (tensor * seq)
+    expert = max(1, expert_parallel)
+    pipe = max(1, pipeline_parallel)
+    if n_devices % (tensor * seq * expert):
+        tensor = seq = expert = 1
+    inner = tensor * seq * expert
+    if (n_devices // inner) % pipe:
+        pipe = 1
+    rest = n_devices // (inner * pipe)
     if zero_stage >= 2:
-        return MeshConfig(data=1, fsdp=rest, tensor=tensor, seq=seq)
-    return MeshConfig(data=rest, fsdp=1, tensor=tensor, seq=seq)
+        return MeshConfig(data=1, fsdp=rest, pipe=pipe, tensor=tensor,
+                          seq=seq, expert=expert)
+    return MeshConfig(data=rest, fsdp=1, pipe=pipe, tensor=tensor, seq=seq,
+                      expert=expert)
 
 
 def make_mesh(config: MeshConfig | None = None, devices=None) -> "Mesh":

@@ -1,0 +1,128 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis.
+
+The TPU-native replacement for detected GPipe/DeepSpeed/Megatron pipeline
+stages (gpu_detect reports ``pp``; SURVEY.md §2.15 emission mapping).
+Instead of a runtime scheduler pushing microbatches between GPU processes,
+the whole schedule is *compiled*: stages live on the ``pipe`` mesh axis,
+every device runs the same scanned loop under ``shard_map``, and
+activations hop stage→stage with ``ppermute`` (one ICI neighbour exchange
+per tick). XLA overlaps the permute with the next microbatch's compute.
+
+Schedule: GPipe with M microbatches over P stages → M + P - 1 ticks; each
+device computes every tick (bubble ticks produce garbage that is never
+read — branchless, so the loop stays a single compiled ``lax.scan``).
+Differentiable end-to-end: the backward pass of ``ppermute`` is the
+reverse permute, so ``jax.grad`` yields the textbook 1F1B-equivalent
+backward schedule without extra code.
+
+The stage function is typically a block of transformer layers; params for
+stage i live only on pipe index i (see ``stack_stage_params``), giving the
+same per-device memory saving as GPU pipeline parallelism.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn, stage_params, x, *, axis_name: str = "pipe",
+                   num_microbatches: int | None = None):
+    """Run ``stage_fn`` as a P-stage pipeline inside ``shard_map``.
+
+    Args:
+      stage_fn: ``(params, x) -> y`` for one stage; same shape in/out.
+      stage_params: this device's stage parameters (pytree).
+      x: [M, mb, ...] microbatched input, identical on every stage (only
+        stage 0 actually consumes it; replication keeps the loop SPMD).
+      num_microbatches: defaults to x.shape[0].
+
+    Returns [M, mb, ...] outputs (valid on the *last* stage; other stages
+    hold garbage — combine with an out_spec that reads the last stage, or
+    psum-mask as done in ``pipeline_sharded``).
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    stage_idx = jax.lax.axis_index(axis_name)
+    n_micro = num_microbatches or x.shape[0]
+    n_ticks = n_micro + n_stages - 1
+    mb_shape = x.shape[1:]
+
+    # stage i receives from i-1; stage 0's slot is fed from the input
+    shift_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (garbage once t >= n_micro; never read)
+        mb_in = jax.lax.dynamic_index_in_dim(
+            x, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
+        state = jnp.where(stage_idx == 0, mb_in, state)
+        state = stage_fn(stage_params, state)
+        # last stage emits microbatch (t - (P-1)) at ticks t >= P-1
+        out_slot = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        valid = t >= (n_stages - 1)
+        current = jax.lax.dynamic_index_in_dim(outputs, out_slot, axis=0,
+                                               keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, state, current), out_slot, axis=0)
+        # hand activations to the next stage (ICI neighbour hop)
+        state = jax.lax.ppermute(state, axis_name, shift_perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros(mb_shape, x.dtype)
+    out0 = jnp.zeros((n_micro, *mb_shape), x.dtype)
+    (_, outputs), _ = jax.lax.scan(tick, (state0, out0), jnp.arange(n_ticks))
+    return outputs
+
+
+def _mask_to_last_stage(outputs, axis_name: str):
+    """Zero everywhere except the last stage, then psum: every stage ends
+    up holding the last stage's outputs (replicated result)."""
+    n_stages = jax.lax.axis_size(axis_name)
+    stage_idx = jax.lax.axis_index(axis_name)
+    masked = jnp.where(stage_idx == n_stages - 1, outputs,
+                       jnp.zeros_like(outputs))
+    return jax.lax.psum(masked, axis_name)
+
+
+def pipeline_sharded(mesh: Mesh, stage_fn, stacked_params, x,
+                     *, num_microbatches: int):
+    """Convenience wrapper: microbatch, shard over the mesh, run, unbatch.
+
+    Args:
+      stage_fn: ``(params, x) -> y`` one-stage function.
+      stacked_params: pytree with a leading stage axis [P, ...] (see
+        ``stack_stage_params``); sharded so each pipe index holds its slice.
+      x: [batch, ...] global input; batch must divide into
+        ``num_microbatches`` microbatches.
+
+    Returns [batch, ...] outputs, replicated over the pipe axis.
+    """
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible into {num_microbatches} microbatches")
+    xm = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+    param_spec = jax.tree.map(lambda _: P("pipe"), stacked_params)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(param_spec, P()), out_specs=P(),
+        check_vma=False,
+    )
+    def run(params, xs):
+        # shard_map gives a [1, ...] stage slice; drop the stage axis
+        local = jax.tree.map(lambda p: p[0], params)
+        out = pipeline_apply(stage_fn, local, xs, num_microbatches=num_microbatches)
+        return _mask_to_last_stage(out, "pipe")
+
+    out = run(stacked_params, xm)
+    return out.reshape(b, *out.shape[2:])
+
+
+def stack_stage_params(per_stage_params: list):
+    """Stack per-stage param pytrees along a new leading [P, ...] axis, the
+    layout ``pipeline_sharded`` shards over the ``pipe`` axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

@@ -40,6 +40,8 @@ class ShardingRules:
             "norm": None,
             "conv_kernel": None,
             "channels": "fsdp",
+            "experts": "expert",  # MoE expert dim (models/moe.py)
+            "stage": "pipe",      # pipeline stage dim (parallel/pipeline.py)
         })
 
     def spec(self, logical_axes: tuple[str | None, ...]) -> P:
@@ -80,6 +82,27 @@ def param_shardings(axes_tree, mesh: Mesh, rules: ShardingRules):
     )
 
 
+def maybe_shard(x, spec: P):
+    """with_sharding_constraint pruned to the active abstract mesh: axes
+    not present in the mesh drop to None, and with no mesh at all the
+    constraint is skipped — so model code can annotate unconditionally and
+    still run unsharded on a single chip. Shared by llama.py / moe.py."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if getattr(mesh, "empty", True):
+        return x
+    names = set(mesh.axis_names)
+    pruned = []
+    for entry in spec:
+        if entry is None:
+            pruned.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in names)
+            pruned.append(kept if kept else None)
+        else:
+            pruned.append(entry if entry in names else None)
+    return jax.lax.with_sharding_constraint(x, P(*pruned))
+
+
 def infer_param_axes(params, tp_layers: tuple[str, ...] = ()):
     """Heuristic logical axes for a flax param tree.
 
@@ -111,8 +134,13 @@ def infer_param_axes(params, tp_layers: tuple[str, ...] = ()):
             return (None, "embed")  # generic dense: ZeRO-style shard of out dim
         if nd == 4:  # conv HWIO
             return (None, None, None, "channels")
-        if nd == 3:  # attention heads (embed, heads, head_dim)
-            return ("embed", "heads", None)
+        if nd == 3:
+            # MoE expert weights (models/moe.py): [E, d, m] / [E, m, d]
+            if "moe" in joined or "expert" in joined:
+                if any(t in joined for t in ("w_out", "wo", "down")):
+                    return ("experts", "mlp", "embed")
+                return ("experts", "embed", "mlp")
+            return ("embed", "heads", None)  # attention (embed, heads, head_dim)
         return (None,) * nd
 
     # rebuild a matching tree

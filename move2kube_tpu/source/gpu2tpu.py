@@ -68,6 +68,20 @@ class Gpu2TpuTranslator(Translator):
                     script_home = os.path.dirname(script_home)
                 if os.path.abspath(script_home) != absdir:
                     continue
+            # scripts spread over several children: when each child is an
+            # independently valid GPU workload, descend so sibling
+            # trainings become separate services instead of one merged one
+            if not any(os.path.dirname(os.path.abspath(s)) == absdir
+                       for s in report.training_scripts):
+                kids = {
+                    os.path.join(absdir, os.path.relpath(
+                        os.path.abspath(s), absdir).split(os.sep)[0])
+                    for s in report.training_scripts
+                }
+                if len(kids) > 1 and all(
+                    gpu_detect.analyze_directory(k) is not None for k in kids
+                ):
+                    continue
             base = common.make_dns_label(
                 os.path.basename(absdir.rstrip(os.sep)) or plan.name
             )

@@ -76,6 +76,8 @@ class GpuReport:
     zero_stage: int = 0
     tensor_parallel: int = 1
     pipeline_parallel: int = 1
+    expert_parallel: int = 1
+    num_experts: int = 0  # MoE expert count (DeepSpeed-MoE / Megatron)
     model_family: str = ""
     entrypoint: str = ""  # training script path
     training_scripts: list[str] = field(default_factory=list)
@@ -220,6 +222,14 @@ def _analyze_directory_uncached(directory: str) -> GpuReport | None:
                 doc.get("tensor_parallel", {}).get("tp_size", 1)
                 if isinstance(doc.get("tensor_parallel"), dict) else 1
             )
+            # DeepSpeed-MoE config block
+            moe = doc.get("moe")
+            if isinstance(moe, dict):
+                report.num_experts = int(moe.get("num_experts", 0) or 0)
+                report.expert_parallel = max(
+                    report.expert_parallel,
+                    int(moe.get("expert_parallel_size",
+                                moe.get("ep_size", 1)) or 1))
             report.evidence.append(
                 f"{os.path.relpath(cfg, directory)}: deepspeed config (ZeRO-{report.zero_stage})"
             )
@@ -243,6 +253,18 @@ def _analyze_directory_uncached(directory: str) -> GpuReport | None:
         m = re.search(r"--num[_-]gpus[=\s]+(\d+)", text)
         if m:
             report.world_size_hint = max(report.world_size_hint, int(m.group(1)))
+        # Megatron-style parallelism args in launch scripts
+        for pat, attr in (
+            (r"--tensor[_-]model[_-]parallel[_-]size[=\s]+(\d+)", "tensor_parallel"),
+            (r"--pipeline[_-]model[_-]parallel[_-]size[=\s]+(\d+)", "pipeline_parallel"),
+            (r"--expert[_-]model[_-]parallel[_-]size[=\s]+(\d+)", "expert_parallel"),
+            (r"--num[_-]experts[=\s]+(\d+)", "num_experts"),
+        ):
+            m = re.search(pat, text)
+            if m:
+                setattr(report, attr, max(getattr(report, attr), int(m.group(1))))
+                report.evidence.append(
+                    f"{os.path.relpath(sh, directory)}: {attr}={m.group(1)}")
 
     # decide: is this a GPU training workload?
     gpu_frameworks = set(report.frameworks) & {"torch", "tensorflow", "deepspeed", "horovod", "cupy"}
@@ -322,6 +344,10 @@ def report_to_accelerator(report: GpuReport, gpu_count: int = 0) -> AcceleratorI
         parallelism["tp"] = report.tensor_parallel
     if report.pipeline_parallel > 1:
         parallelism["pp"] = report.pipeline_parallel
+    if report.expert_parallel > 1:
+        parallelism["ep"] = report.expert_parallel
+    if report.num_experts:
+        parallelism["experts"] = report.num_experts
     if count > 1:
         parallelism.setdefault("dp", count)
     return AcceleratorInfo(

@@ -1,0 +1,5 @@
+#!/bin/sh
+deepspeed --num_gpus 16 train_llama.py \
+  --tensor-model-parallel-size 2 \
+  --expert-model-parallel-size 4 \
+  --num-experts 8

@@ -1,0 +1,23 @@
+"""DeepSpeed-MoE Llama pretraining (GPU source; translation input)."""
+import deepspeed
+import torch
+import torch.distributed as dist
+from transformers import LlamaForCausalLM, LlamaConfig
+
+
+def main():
+    dist.init_process_group(backend="nccl")
+    torch.cuda.set_device(dist.get_rank() % torch.cuda.device_count())
+    config = LlamaConfig(hidden_size=4096, num_hidden_layers=32)
+    model = LlamaForCausalLM(config).cuda()
+    engine, optimizer, _, _ = deepspeed.initialize(
+        model=model, config="ds_config.json")
+    for step in range(1000):
+        batch = torch.randint(0, 32000, (1, 2048)).cuda()
+        loss = engine(input_ids=batch, labels=batch).loss
+        engine.backward(loss)
+        engine.step()
+
+
+if __name__ == "__main__":
+    main()

@@ -95,3 +95,22 @@ def test_graft_entry():
     )
     assert run.returncode == 0, run.stderr[-2000:]
     assert "dryrun ok" in run.stdout
+
+
+def test_translate_deepspeed_moe(tmp_path):
+    """DeepSpeed-MoE + Megatron args -> MoE Llama trainer with an expert
+    mesh axis (no pipe axis: pp folds into fsdp, jax_emit.py)."""
+    res = run_cli("translate",
+                  "-s", os.path.join(SAMPLES, "gpu-training", "llama-moe"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    cdir = tmp_path / "out" / "containers" / "llama-moe"
+    train_src = (cdir / "train_tpu.py").read_text()
+    assert 'M2KT_MOE_EXPERTS", "8"' in train_src
+    assert "moe_experts" in train_src
+    # mesh: 16 "gpus" -> tp=2, ep=4, zero3 -> fsdp remainder, no pipe axis
+    assert 'M2KT_MESH_TENSOR", "2"' in train_src
+    assert 'M2KT_MESH_EXPERT", "4"' in train_src
+    assert 'M2KT_MESH_PIPE", "1"' in train_src
+    assert 'M2KT_MESH_FSDP", "2"' in train_src
+    assert (cdir / "move2kube_tpu" / "models" / "moe.py").exists()

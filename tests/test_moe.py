@@ -1,0 +1,95 @@
+"""MoE expert parallelism (models/moe.py): routing invariants and
+expert-sharded vs single-device numerical equivalence."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from move2kube_tpu.models.moe import MoEMlp, top_k_routing
+from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def test_routing_respects_capacity():
+    t, e, cap = 16, 4, 3
+    logits = jax.random.normal(jax.random.PRNGKey(0), (t, e))
+    dispatch, combine, aux = top_k_routing(logits, e, 2, cap)
+    # every expert queue holds at most `cap` tokens, one per slot
+    per_slot = np.asarray(dispatch).sum(axis=0)  # [E, C]
+    assert per_slot.max() <= 1.0 + 1e-6
+    assert dispatch.shape == (t, e, cap)
+    # combine weights of surviving tokens sum to <= 1 per token
+    per_token = np.asarray(combine).sum(axis=(1, 2))
+    assert (per_token <= 1.0 + 1e-5).all()
+    assert np.isfinite(float(aux))
+
+
+def test_routing_top1_routes_every_token_with_room():
+    t, e = 8, 4
+    logits = jnp.eye(t, e) * 5.0  # tokens spread over experts
+    dispatch, _combine, _aux = top_k_routing(logits, e, 1, capacity=t)
+    assert float(np.asarray(dispatch).sum()) == t  # nothing dropped
+
+
+def test_moe_expert_sharded_matches_unsharded():
+    from move2kube_tpu.models.train import _mesh_context
+
+    model = MoEMlp(num_experts=4, mlp_dim=32, top_k=2,
+                   capacity_factor=2.0, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+    params = model.init(jax.random.PRNGKey(2), x)["params"]
+    ref, aux_ref = model.apply({"params": params}, x)
+
+    mesh = make_mesh(MeshConfig(data=1, tensor=2, expert=4))
+    p_sh = jax.device_put(
+        params, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    with _mesh_context(mesh):
+        out, aux = jax.jit(lambda p, i: model.apply({"params": p}, i))(p_sh, x)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-4)
+    np.testing.assert_allclose(float(aux_ref), float(aux), atol=1e-5)
+
+
+def test_moe_trains():
+    """Gradients flow through routing + experts (dropped tokens included)."""
+    model = MoEMlp(num_experts=4, mlp_dim=32, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, 16))
+    params = model.init(jax.random.PRNGKey(4), x)["params"]
+
+    def loss_fn(p):
+        y, aux = model.apply({"params": p}, x)
+        return jnp.mean(y ** 2) + 0.01 * aux
+
+    grads = jax.grad(loss_fn)(params)
+    flat = jax.tree.leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+    assert any(float(jnp.abs(g).sum()) > 0 for g in flat)
+
+
+def test_llama_moe_trains_on_expert_mesh():
+    """Full MoE Llama train step on a dp x tp x ep mesh: loss finite and
+    decreasing, aux loss plumbed through the losses collection."""
+    import dataclasses
+
+    import optax
+
+    from move2kube_tpu.models import llama
+    from move2kube_tpu.models import train as m2kt_train
+
+    cfg = dataclasses.replace(llama.llama_tiny(), moe_experts=4, moe_top_k=2,
+                              dtype=jnp.float32)
+    model = llama.Llama(cfg)
+    mesh = make_mesh(MeshConfig(data=2, tensor=2, expert=2))
+    ids = jnp.zeros((4, 16), jnp.int32)
+    state = m2kt_train.create_sharded_state(
+        jax.random.PRNGKey(0), model, {"input_ids": ids}, optax.adamw(1e-3), mesh,
+    )
+    step = m2kt_train.make_lm_train_step(mesh)
+    batch = {"input_ids": jnp.asarray(
+        np.random.default_rng(0).integers(0, 500, (4, 16)))}
+    state, loss1 = step(state, batch)
+    state, loss2 = step(state, batch)
+    assert np.isfinite(float(loss1))
+    assert float(loss2) < float(loss1)
