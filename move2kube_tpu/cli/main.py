@@ -76,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "(--qa-port, or an OS-assigned port) instead")
     tp.add_argument("--ignore-env", action="store_true", default=False,
                     help="derive nothing from the local environment")
+    tp.add_argument("--profile", action="store_true",
+                    default=_env_bool("profile"),
+                    help="write per-stage timings/counters to "
+                         "<out>/m2kt-metrics.json")
 
     cp = sub.add_parser("collect", help="collect metadata from cluster/docker")
     cp.add_argument("--source", "-s", default=_env_default("source", "."))
@@ -136,6 +140,11 @@ def translate_handler(args) -> int:
     qa.set_write_cache(os.path.join(out_dir, common.QA_CACHE_FILE))
     plan = curate_plan(plan)
     translate(plan, out_dir)
+    if args.profile:
+        from move2kube_tpu.utils import trace
+
+        path = trace.write_metrics(out_dir)
+        print(f"run metrics written to {path}")
     print(f"artifacts written to {out_dir}")
     return 0
 

@@ -15,7 +15,7 @@ from move2kube_tpu.metadata import get_loaders
 from move2kube_tpu.metadata import clusters as cluster_profiles
 from move2kube_tpu.source import get_source_loaders
 from move2kube_tpu.types import plan as plantypes
-from move2kube_tpu.utils import common
+from move2kube_tpu.utils import common, trace
 from move2kube_tpu.utils.log import get_logger
 
 log = get_logger("planner")
@@ -28,19 +28,21 @@ def create_plan(root_dir: str, name: str = "") -> plantypes.Plan:
     plan.root_dir = root_dir
     containerizer.init_containerizers(root_dir)
     for translator in get_source_loaders():
-        try:
-            services = translator.get_service_options(plan)
-        except Exception as e:  # noqa: BLE001 - plugin tolerance (planner.go:40-45)
-            log.warning("translator %s failed during planning: %s",
-                        type(translator).__name__, e)
-            continue
+        with trace.span(f"plan.{translator.get_translation_type().lower()}"):
+            try:
+                services = translator.get_service_options(plan)
+            except Exception as e:  # noqa: BLE001 - plugin tolerance (planner.go:40-45)
+                log.warning("translator %s failed during planning: %s",
+                            type(translator).__name__, e)
+                continue
         for svc in services:
             plan.add_service(svc)
-    for loader in get_loaders():
-        try:
-            loader.update_plan(plan)
-        except Exception as e:  # noqa: BLE001
-            log.warning("metadata loader %s failed: %s", type(loader).__name__, e)
+    with trace.span("plan.metadata"):
+        for loader in get_loaders():
+            try:
+                loader.update_plan(plan)
+            except Exception as e:  # noqa: BLE001
+                log.warning("metadata loader %s failed: %s", type(loader).__name__, e)
     return plan
 
 

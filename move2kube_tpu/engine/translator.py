@@ -19,6 +19,7 @@ from move2kube_tpu.transformer.compose import ComposeTransformer
 from move2kube_tpu.types import plan as plantypes
 from move2kube_tpu.types.ir import IR
 from move2kube_tpu.types.plan import TargetArtifactType
+from move2kube_tpu.utils import trace
 from move2kube_tpu.utils.log import get_logger
 
 log = get_logger("translator")
@@ -30,40 +31,50 @@ def translate(plan: plantypes.Plan, out_dir: str) -> IR:
     containerizer.init_containerizers(plan.root_dir)
 
     log.info("translating %d services", len(plan.services))
-    ir = translate_sources(plan)
+    trace.count("services", len(plan.services))
+    with trace.span("translate.sources"):
+        ir = translate_sources(plan)
 
-    for loader in get_loaders():
+    with trace.span("translate.metadata"):
+        for loader in get_loaders():
+            try:
+                loader.load_to_ir(plan, ir)
+            except Exception as e:  # noqa: BLE001
+                log.warning("metadata loader %s failed: %s", type(loader).__name__, e)
+
+    with trace.span("translate.optimize"):
+        ir = optimize(ir)
+
+    with trace.span("translate.compose"):
+        compose_tf = ComposeTransformer()
         try:
-            loader.load_to_ir(plan, ir)
+            compose_tf.transform(ir)
+            compose_tf.write_objects(out_dir, ir)
         except Exception as e:  # noqa: BLE001
-            log.warning("metadata loader %s failed: %s", type(loader).__name__, e)
+            log.warning("compose transformer failed: %s", e)
 
-    ir = optimize(ir)
-
-    compose_tf = ComposeTransformer()
-    try:
-        compose_tf.transform(ir)
-        compose_tf.write_objects(out_dir, ir)
-    except Exception as e:  # noqa: BLE001
-        log.warning("compose transformer failed: %s", e)
-
-    ir = customize(ir)
+    with trace.span("translate.customize"):
+        ir = customize(ir)
 
     if ir.kubernetes.effective_artifact_type() == TargetArtifactType.HELM:
-        ir = parameterize(ir)
+        with trace.span("translate.parameterize"):
+            ir = parameterize(ir)
 
     if any(c.new for c in ir.containers):
-        try:
-            from move2kube_tpu.transformer.cicd import CICDTransformer
+        with trace.span("translate.cicd"):
+            try:
+                from move2kube_tpu.transformer.cicd import CICDTransformer
 
-            cicd = CICDTransformer()
-            cicd.transform(ir)
-            cicd.write_objects(out_dir, ir)
-        except Exception as e:  # noqa: BLE001
-            log.warning("cicd transformer failed: %s", e)
+                cicd = CICDTransformer()
+                cicd.transform(ir)
+                cicd.write_objects(out_dir, ir)
+            except Exception as e:  # noqa: BLE001
+                log.warning("cicd transformer failed: %s", e)
 
-    transformer = get_transformer(ir)
-    transformer.transform(ir)
-    transformer.write_objects(out_dir, ir)
+    with trace.span("translate.write"):
+        transformer = get_transformer(ir)
+        transformer.transform(ir)
+        transformer.write_objects(out_dir, ir)
+    trace.count("containers_built", sum(1 for c in ir.containers if c.new))
     log.info("translation written to %s", out_dir)
     return ir

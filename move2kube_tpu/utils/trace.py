@@ -1,0 +1,89 @@
+"""Run tracing: timing spans + machine-readable metrics for a pipeline run.
+
+Net-new vs the reference, which has only leveled logging (SURVEY.md §5
+"tracing/profiling: absent"). Every pipeline stage runs under ``span()``;
+``write_metrics`` dumps one JSON document per run with wall time and
+counters, so headless/CI invocations can be tracked without scraping logs.
+
+Spans nest: a stage's time includes its children, reported with dotted
+names (``translate.sources.gpu2tpu``). Thread-safe for the QA REST
+engine's server thread (counters take the lock; spans are per-thread).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+_lock = threading.Lock()
+_local = threading.local()
+
+
+class Recorder:
+    def __init__(self) -> None:
+        self.spans: list[dict] = []
+        self.counters: dict[str, int] = {}
+        self.started = time.time()
+
+    def add_span(self, name: str, seconds: float) -> None:
+        with _lock:
+            self.spans.append({"name": name, "seconds": round(seconds, 6)})
+
+    def count(self, name: str, n: int = 1) -> None:
+        with _lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def to_dict(self) -> dict:
+        with _lock:
+            rolled: dict[str, float] = {}
+            for s in self.spans:
+                rolled[s["name"]] = rolled.get(s["name"], 0.0) + s["seconds"]
+            return {
+                "wall_seconds": round(time.time() - self.started, 3),
+                "spans": {k: round(v, 6) for k, v in sorted(rolled.items())},
+                "counters": dict(sorted(self.counters.items())),
+            }
+
+
+_recorder = Recorder()
+
+
+def reset() -> None:
+    global _recorder
+    _recorder = Recorder()
+
+
+def get() -> Recorder:
+    return _recorder
+
+
+@contextmanager
+def span(name: str):
+    """Time a block; nested spans get dotted names."""
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    full = ".".join([*stack, name])
+    stack.append(name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        stack.pop()
+        _recorder.add_span(full, time.perf_counter() - t0)
+
+
+def count(name: str, n: int = 1) -> None:
+    _recorder.count(name, n)
+
+
+def write_metrics(out_dir: str, filename: str = "m2kt-metrics.json") -> str:
+    path = os.path.join(out_dir, filename)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(_recorder.to_dict(), f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path

@@ -1,0 +1,55 @@
+"""Run tracing (utils/trace.py) and the --profile CLI flag."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+from move2kube_tpu.utils import trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_spans_nest_and_roll_up():
+    trace.reset()
+    with trace.span("outer"):
+        with trace.span("inner"):
+            pass
+        with trace.span("inner"):
+            pass
+    trace.count("things", 3)
+    doc = trace.get().to_dict()
+    assert set(doc["spans"]) == {"outer", "outer.inner"}
+    assert doc["spans"]["outer"] >= doc["spans"]["outer.inner"]
+    assert doc["counters"] == {"things": 3}
+
+
+def test_write_metrics(tmp_path):
+    trace.reset()
+    with trace.span("stage"):
+        pass
+    path = trace.write_metrics(str(tmp_path))
+    doc = json.load(open(path))
+    assert "stage" in doc["spans"]
+    assert doc["wall_seconds"] >= 0
+
+
+def test_profile_flag_writes_metrics(tmp_path):
+    src = tmp_path / "app"
+    src.mkdir()
+    (src / "requirements.txt").write_text("flask\n")
+    (src / "app.py").write_text("x = 1\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    res = subprocess.run(
+        [sys.executable, "-m", "move2kube_tpu.cli.main", "translate",
+         "-s", "app", "-o", "out", "--qa-skip", "--profile"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode == 0, res.stderr
+    doc = json.load(open(tmp_path / "out" / "m2kt-metrics.json"))
+    assert "translate.sources" in doc["spans"]
+    assert "translate.write" in doc["spans"]
+    assert doc["counters"]["services"] == 1
+    assert doc["counters"]["containers_built"] >= 1
