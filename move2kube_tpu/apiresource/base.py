@@ -17,6 +17,11 @@ from move2kube_tpu.utils.log import get_logger
 log = get_logger("apiresource")
 
 
+_GROUP_ALIASES = {
+    "extensions": ("networking.k8s.io", "apps"),
+}
+
+
 def obj_name(obj: dict) -> str:
     return obj.get("metadata", {}).get("name", "")
 
@@ -42,6 +47,19 @@ class APIResource:
     def get_supported_kinds(self) -> list[str]:
         raise NotImplementedError
 
+    def get_supported_groups(self) -> set[str] | None:
+        """API groups this resource understands; None = any group. Needed
+        because kind names collide across groups — a serving.knative.dev
+        Service must not be claimed (and version-rewritten) by the core
+        Service resource."""
+        return None
+
+    def owns(self, obj: dict) -> bool:
+        if obj_kind(obj) not in self.get_supported_kinds():
+            return False
+        groups = self.get_supported_groups()
+        return groups is None or group_of(obj.get("apiVersion", "")) in groups
+
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         raise NotImplementedError
 
@@ -57,7 +75,7 @@ class APIResource:
                               cached: list[dict]) -> list[dict]:
         supported = self._supported_on(cluster)
         objs: list[dict] = []
-        mine = [o for o in cached if obj_kind(o) in self.get_supported_kinds()]
+        mine = [o for o in cached if self.owns(o)]
         for obj in self.create_new_resources(ir, supported):
             self._merge_or_add(obj, objs)
         for obj in mine:
@@ -105,8 +123,21 @@ class APIResource:
         if not cluster.api_kind_version_map:
             return [obj]
         if versions:
-            obj["apiVersion"] = versions[0]
-            return [obj]
+            # same-group versions only: "Service v1" supported does NOT
+            # make a serving.knative.dev Service expressible as core v1
+            grp = group_of(obj.get("apiVersion", ""))
+            same_group = [v for v in versions if group_of(v) == grp]
+            if not same_group:
+                # pre-1.16 "extensions" umbrella split into real groups;
+                # upgrading across that rename is a pure apiVersion bump
+                for alias in _GROUP_ALIASES.get(grp, ()):
+                    same_group = [v for v in versions if group_of(v) == alias]
+                    if same_group:
+                        break
+            if same_group:
+                obj["apiVersion"] = same_group[0]
+                return [obj]
+            versions = []  # cross-group only: fall through as unsupported
         if ir.kubernetes.ignore_unsupported_kinds:
             log.warning("dropping unsupported kind %s/%s", kind, obj_name(obj))
             return []
@@ -117,9 +148,6 @@ def convert_objects(ir: IR, resources: list[APIResource]) -> list[dict]:
     """Run every APIResource over the IR + cached objects; pass through
     cached kinds nobody owns (parity: apiresourceset loop)."""
     cluster = ir.target_cluster_spec
-    owned_kinds: set[str] = set()
-    for r in resources:
-        owned_kinds.update(r.get_supported_kinds())
     out: list[dict] = []
     for r in resources:
         try:
@@ -127,7 +155,7 @@ def convert_objects(ir: IR, resources: list[APIResource]) -> list[dict]:
         except Exception as e:  # noqa: BLE001
             log.warning("apiresource %s failed: %s", type(r).__name__, e)
     for obj in ir.cached_objects:
-        if obj_kind(obj) not in owned_kinds:
+        if not any(r.owns(obj) for r in resources):
             out.append(obj)
     _fixup_dangling_pvcs(out, cluster)
     return out

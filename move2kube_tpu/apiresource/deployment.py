@@ -96,6 +96,10 @@ class DeploymentAPIResource(APIResource):
         return [DEPLOYMENT, DEPLOYMENT_CONFIG, REPLICATION_CONTROLLER, POD,
                 DAEMON_SET, JOB, JOB_SET]
 
+    def get_supported_groups(self) -> set[str]:
+        return {"", "apps", "extensions", "batch", "apps.openshift.io",
+                "jobset.x-k8s.io"}
+
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         objs = []
         for svc in ir.services.values():

@@ -17,6 +17,9 @@ class ImageStreamAPIResource(APIResource):
     def get_supported_kinds(self) -> list[str]:
         return [IMAGE_STREAM]
 
+    def get_supported_groups(self) -> set[str]:
+        return {"image.openshift.io"}
+
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         if IMAGE_STREAM not in supported_kinds:
             return []

@@ -17,6 +17,9 @@ class NetworkPolicyAPIResource(APIResource):
     def get_supported_kinds(self) -> list[str]:
         return ["NetworkPolicy"]
 
+    def get_supported_groups(self) -> set[str]:
+        return {"networking.k8s.io", "extensions"}
+
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         networks: set[str] = set()
         for svc in ir.services.values():

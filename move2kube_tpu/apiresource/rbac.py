@@ -13,6 +13,9 @@ class ServiceAccountAPIResource(APIResource):
     def get_supported_kinds(self) -> list[str]:
         return ["ServiceAccount"]
 
+    def get_supported_groups(self) -> set[str]:
+        return {""}
+
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         objs = []
         for sa in ir.service_accounts:
@@ -27,6 +30,9 @@ class RoleAPIResource(APIResource):
     def get_supported_kinds(self) -> list[str]:
         return ["Role"]
 
+    def get_supported_groups(self) -> set[str]:
+        return {"rbac.authorization.k8s.io"}
+
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         objs = []
         for role in ir.roles:
@@ -39,6 +45,9 @@ class RoleAPIResource(APIResource):
 class RoleBindingAPIResource(APIResource):
     def get_supported_kinds(self) -> list[str]:
         return ["RoleBinding"]
+
+    def get_supported_groups(self) -> set[str]:
+        return {"rbac.authorization.k8s.io"}
 
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         objs = []

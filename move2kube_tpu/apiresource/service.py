@@ -28,6 +28,10 @@ class ServiceAPIResource(APIResource):
     def get_supported_kinds(self) -> list[str]:
         return [SERVICE, INGRESS, ROUTE]
 
+    def get_supported_groups(self) -> set[str]:
+        # NOT serving.knative.dev: a Knative "Service" is a different kind
+        return {"", "networking.k8s.io", "extensions", "route.openshift.io"}
+
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         objs: list[dict] = []
         exposed: list[Service] = []

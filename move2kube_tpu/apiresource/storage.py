@@ -28,6 +28,9 @@ class StorageAPIResource(APIResource):
     def get_supported_kinds(self) -> list[str]:
         return [CONFIG_MAP, SECRET, PVC]
 
+    def get_supported_groups(self) -> set[str]:
+        return {""}
+
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         objs = []
         for storage in ir.storages:

@@ -159,3 +159,25 @@ def test_translate_every_stack_sample(tmp_path, sample):
     assert dockerfiles, "no Dockerfile emitted"
     content = open(dockerfiles[0]).read()
     assert content.startswith("FROM "), content[:80]
+
+
+def test_knative_yaml_passes_through_untouched(tmp_path):
+    """A cached serving.knative.dev Service must NOT be claimed by the core
+    Service resource and version-rewritten to v1 (kind-name collision)."""
+    src = tmp_path / "kn"
+    src.mkdir()
+    (src / "service.yaml").write_text(
+        "apiVersion: serving.knative.dev/v1\n"
+        "kind: Service\n"
+        "metadata:\n  name: hello\n"
+        "spec:\n  template:\n    spec:\n      containers:\n"
+        "        - image: gcr.io/knative-samples/helloworld-go\n"
+    )
+    res = run_cli("translate", "-s", "kn", "-o", "out", "--qa-skip",
+                  cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    objs = load_all_yamls(tmp_path / "out" / "kn")
+    knative = [o for o in objs
+               if o.get("apiVersion") == "serving.knative.dev/v1"
+               and o.get("kind") == "Service"]
+    assert knative, f"knative service lost or rewritten: {objs}"
