@@ -7,9 +7,46 @@ Parity with ``types/collection/`` in the reference: ClusterMetadata
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 
 from move2kube_tpu.utils import common
+
+# group preference for kind/version selection (parity: groupOrderPolicy
+# clustercollector.go:365): modern named groups beat the deprecated
+# "extensions" umbrella; unknown groups rank between those.
+_GROUP_ORDER = ["", "apps", "networking.k8s.io", "batch",
+                "rbac.authorization.k8s.io", "storage.k8s.io",
+                "route.openshift.io", "apps.openshift.io",
+                "image.openshift.io", "jobset.x-k8s.io",
+                "serving.knative.dev", "tekton.dev",
+                "triggers.tekton.dev"]
+_VERSION_RE = re.compile(r"^v(\d+)(?:(alpha|beta)(\d+))?$")
+_STAGE_RANK = {"": 2, "beta": 1, "alpha": 0}
+
+
+def _version_key(group_version: str):
+    """Sort key: preferred group first, then GA > beta > alpha, then the
+    higher major/stage number (parity: sortVersionList
+    clustercollector.go:412)."""
+    group, _, version = group_version.rpartition("/")
+    try:
+        group_rank = _GROUP_ORDER.index(group)
+    except ValueError:
+        group_rank = len(_GROUP_ORDER) if group != "extensions" else len(_GROUP_ORDER) + 1
+    m = _VERSION_RE.match(version)
+    if m:
+        major = int(m.group(1))
+        stage = _STAGE_RANK[m.group(2) or ""]
+        stage_num = int(m.group(3) or 0)
+    else:
+        major, stage, stage_num = -1, -1, -1
+    return (group_rank, -stage, -major, -stage_num)
+
+
+def sort_version_list(versions: list[str]) -> list[str]:
+    """Order group/versions by preference; callers take index 0."""
+    return sorted(versions, key=_version_key)
 
 CLUSTER_METADATA_KIND = "ClusterMetadata"
 IMAGES_METADATA_KIND = "ImageMetadata"
@@ -33,8 +70,9 @@ class ClusterMetadataSpec:
 
     def get_supported_versions(self, kind: str) -> list[str]:
         """Preferred group/versions for kind, or [] if unsupported
-        (parity: GetSupportedVersions cluster.go:107)."""
-        return list(self.api_kind_version_map.get(kind, []))
+        (parity: GetSupportedVersions cluster.go:107). Preference-sorted
+        so callers can take [0]."""
+        return sort_version_list(self.api_kind_version_map.get(kind, []))
 
     def supports_kind(self, kind: str) -> bool:
         return bool(self.api_kind_version_map.get(kind))

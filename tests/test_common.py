@@ -68,3 +68,20 @@ def test_is_parent():
     assert common.is_parent("/a/b/c", "/a/b")
     assert common.is_parent("/a/b", "/a/b")
     assert not common.is_parent("/a/bc", "/a/b")
+
+
+def test_sort_version_list_preference():
+    """Parity: sortVersionList/groupOrderPolicy — GA > beta > alpha, higher
+    major first, modern groups before the deprecated extensions group."""
+    from move2kube_tpu.types.collection import sort_version_list
+
+    assert sort_version_list(["v1alpha1", "v1", "v1beta1"]) == [
+        "v1", "v1beta1", "v1alpha1"]
+    assert sort_version_list(["v1", "v2"]) == ["v2", "v1"]
+    assert sort_version_list(
+        ["extensions/v1beta1", "networking.k8s.io/v1"]) == [
+        "networking.k8s.io/v1", "extensions/v1beta1"]
+    assert sort_version_list(["v2beta2", "v2beta1"]) == ["v2beta2", "v2beta1"]
+    # unknown groups still rank ahead of extensions
+    assert sort_version_list(["extensions/v1", "example.io/v1"]) == [
+        "example.io/v1", "extensions/v1"]
