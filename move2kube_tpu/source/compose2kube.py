@@ -39,8 +39,30 @@ log = get_logger("source.compose")
 COMPOSE_NETWORK_ANNOTATION = "move2kube-tpu.io/networks"
 
 
+def _normalize_compose_doc(doc: dict) -> dict | None:
+    """Return a doc with a ``services`` mapping, handling the v1 format
+    where service names are top-level keys (parity: libcompose ParseV2
+    accepts v1; v1v2.go:93). None if the doc isn't compose-shaped."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("services"), dict):
+        return doc
+    if "services" in doc or "version" in doc:
+        return None
+    # v1: every top-level value is a service dict with image/build/etc.
+    vals = [v for v in doc.values() if v is not None]
+    if vals and all(
+        isinstance(v, dict) and ({"image", "build", "ports", "command",
+                                  "environment", "volumes", "links"} & v.keys())
+        for v in vals
+    ):
+        return {"services": doc}
+    return None
+
+
 def find_compose_files(root: str) -> list[str]:
-    """Compose files = yaml with a services mapping (compose2kube.go:122-150)."""
+    """Compose files = yaml with a services mapping, or the v1 bare-service
+    format in a compose-named file (compose2kube.go:122-150)."""
     out = []
     for path in common.get_files_by_ext(root, [".yaml", ".yml"]):
         base = os.path.basename(path).lower()
@@ -49,9 +71,12 @@ def find_compose_files(root: str) -> list[str]:
             doc = common.read_yaml(path)
         except Exception:  # noqa: BLE001
             continue
-        if isinstance(doc, dict) and isinstance(doc.get("services"), dict):
-            if looks_like or "version" in doc:
-                out.append(path)
+        norm = _normalize_compose_doc(doc)
+        if norm is None:
+            continue
+        is_v1 = norm is not doc
+        if looks_like or (not is_v1 and "version" in doc):
+            out.append(path)
     return out
 
 
@@ -229,9 +254,11 @@ class ComposeTranslator(Translator):
         services: list[PlanService] = []
         for compose_file in find_compose_files(plan.root_dir):
             try:
-                doc = common.read_yaml(compose_file)
+                doc = _normalize_compose_doc(common.read_yaml(compose_file))
             except Exception as e:  # noqa: BLE001
                 log.warning("cannot parse %s: %s", compose_file, e)
+                continue
+            if doc is None:
                 continue
             for svc_name, svc_def in (doc.get("services") or {}).items():
                 if not isinstance(svc_def, dict):
@@ -286,7 +313,7 @@ class ComposeTranslator(Translator):
 
     def _convert_file(self, ir: irtypes.IR, compose_file: str,
                       plan_svcs: list[PlanService], plan: Plan) -> None:
-        doc = common.read_yaml(compose_file)
+        doc = _normalize_compose_doc(common.read_yaml(compose_file)) or {}
         compose_dir = os.path.dirname(compose_file)
         wanted = {s.service_name: s for s in plan_svcs}
         top_volumes = doc.get("volumes") or {}

@@ -181,3 +181,29 @@ def test_knative_yaml_passes_through_untouched(tmp_path):
                if o.get("apiVersion") == "serving.knative.dev/v1"
                and o.get("kind") == "Service"]
     assert knative, f"knative service lost or rewritten: {objs}"
+
+
+def test_compose_v1_format(tmp_path):
+    """v1 compose (bare top-level services, no version key) translates
+    (parity: libcompose v1 support, v1v2.go)."""
+    src = tmp_path / "app"
+    src.mkdir()
+    (src / "docker-compose.yml").write_text(
+        "web:\n"
+        "  image: nginx:1.25\n"
+        "  ports:\n    - \"80:80\"\n"
+        "  links:\n    - db\n"
+        "db:\n"
+        "  image: postgres:15\n"
+        "  environment:\n    POSTGRES_PASSWORD: secret\n"
+    )
+    res = run_cli("translate", "-s", "app", "-o", "out", "--qa-skip",
+                  cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    objs = load_all_yamls(tmp_path / "out" / "app")
+    images = {
+        c["image"]
+        for o in by_kind(objs, "Deployment")
+        for c in o["spec"]["template"]["spec"]["containers"]
+    }
+    assert images == {"nginx:1.25", "postgres:15"}
