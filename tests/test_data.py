@@ -1,0 +1,73 @@
+"""Host-sharded input pipeline (models/data.py)."""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from move2kube_tpu.models import data as m2kt_data
+from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshConfig(data=4, fsdp=2))
+
+
+def test_npz_loader_assembles_global_batches(tmp_path, mesh):
+    n, d = 64, 8
+    x = np.arange(n * d, dtype=np.float32).reshape(n, d)
+    y = np.arange(n, dtype=np.int32)
+    np.savez(tmp_path / "train.npz", input=x, label=y)
+    loader = m2kt_data.make_loader(str(tmp_path / "train.npz"), 16, mesh)
+    batch = next(loader)
+    assert batch["input"].shape == (16, d)
+    assert batch["label"].shape == (16,)
+    # global array is sharded over (data, fsdp) = 8 shards of 2 rows
+    assert len(batch["input"].sharding.device_set) == 8
+    # rows correspond to real examples (feature row matches its label)
+    got = np.asarray(batch["input"])
+    labels = np.asarray(batch["label"])
+    np.testing.assert_array_equal(got, x[labels])
+
+
+def test_epoch_reshuffles_without_repeat_within_epoch(tmp_path, mesh):
+    n = 32
+    np.savez(tmp_path / "d.npz", input=np.arange(n, dtype=np.float32),
+             label=np.arange(n, dtype=np.int32))
+    loader = m2kt_data.make_loader(str(tmp_path / "d.npz"), 8, mesh)
+    seen = []
+    for _ in range(n // 8):  # one epoch
+        seen.extend(np.asarray(next(loader)["label"]).tolist())
+    assert sorted(seen) == list(range(n))  # full permutation, no repeats
+    seen2 = [np.asarray(next(loader)["label"]).tolist() for _ in range(n // 8)]
+    assert sorted(sum(seen2, [])) == list(range(n))  # next epoch reshuffled
+    assert sum(seen2, []) != seen
+
+
+def test_jsonl_loader(tmp_path, mesh):
+    rows = [{"input_ids": [i, i + 1, i + 2]} for i in range(16)]
+    path = tmp_path / "tok.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in rows))
+    loader = m2kt_data.make_loader(str(path), 8, mesh)
+    batch = next(loader)
+    assert batch["input_ids"].shape == (8, 3)
+
+
+def test_synthetic_fallback(mesh):
+    loader = m2kt_data.make_loader(
+        "", 4, mesh,
+        synthetic_fn=lambda i: {"input": jnp.full((4, 2), i)})
+    assert float(next(loader)["input"][0, 0]) == 0
+    assert float(next(loader)["input"][0, 0]) == 1
+
+
+def test_indivisible_batch_rejected(tmp_path, mesh):
+    np.savez(tmp_path / "d.npz", input=np.zeros((8, 2)), label=np.zeros(8))
+    with pytest.raises(ValueError, match="divisible|shard"):
+        # single process: batch 3 not the issue; shard too small is
+        m2kt_data.HostShardedLoader(
+            m2kt_data.load_arrays(str(tmp_path / "d.npz")), 16, mesh)
