@@ -122,6 +122,11 @@ def emit_container(service: PlanService, plan=None) -> Container:
             "lr": 3e-4 if family in ("llama", "gpt") else 1e-3,
         }),
     )
+    with open(os.path.join(_ASSETS, "port_weights.py"), encoding="utf-8") as f:
+        container.add_file(
+            "port_weights.py",
+            common.render_template(f.read(), {"family": family}),
+        )
     _vendor_package(container)
     with open(os.path.join(_ASSETS, "Dockerfile"), encoding="utf-8") as f:
         container.add_file("Dockerfile", f.read())

@@ -73,10 +73,13 @@ class BertEncoder(nn.Module):
                        name="tok_embed")(input_ids)
         pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
                        name="pos_embed")(jnp.arange(s)[None, :])
-        seg = 0
-        if token_type_ids is not None:
-            seg = nn.Embed(2, self.d_model, dtype=self.dtype,
-                           name="seg_embed")(token_type_ids)
+        # segment embedding always participates (HF semantics: absent
+        # token_type_ids mean segment 0, whose embedding is learned) — and
+        # the param tree must not depend on which inputs were passed
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        seg = nn.Embed(2, self.d_model, dtype=self.dtype,
+                       name="seg_embed")(token_type_ids)
         x = nn.LayerNorm(dtype=jnp.float32)(tok + pos + seg)
         mask = attention_mask if attention_mask is not None else jnp.ones((b, s), bool)
         for _ in range(self.num_layers):

@@ -54,16 +54,33 @@ class CheckpointManager:
 
     def restore_or_init(self, state):
         """Return (state, start_step): the latest checkpoint restored into
-        ``state``'s sharding layout, or ``state`` itself at step 0."""
+        ``state``'s sharding layout, or ``state`` itself at step 0.
+
+        Two checkpoint shapes are accepted: a full TrainState (periodic
+        saves from the training loop), and a params-only dict written by
+        ``port_weights.py`` (torch weights converted to our layout) — the
+        latter grafts params into the fresh state, keeping new optimizer
+        state, so a GPU fine-tune resumes from its pretrained weights."""
         import orbax.checkpoint as ocp
 
         step = self._mngr.latest_step()
         if step is None:
             return state, 0
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
-        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
-        log.info("resumed from checkpoint step %d", step)
-        return restored, step
+        try:
+            restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+            log.info("resumed from checkpoint step %d", step)
+            return restored, step
+        except (ValueError, KeyError, TypeError):
+            partial = {"params": abstract.params}
+            if getattr(state, "batch_stats", None) is not None:
+                partial["batch_stats"] = abstract.batch_stats
+            restored = self._mngr.restore(step, args=ocp.args.StandardRestore(partial))
+            state = state.replace(params=restored["params"])
+            if restored.get("batch_stats") is not None:
+                state = state.replace(batch_stats=restored["batch_stats"])
+            log.info("loaded ported weights from checkpoint step %d", step)
+            return state, 0
 
     def maybe_save(self, step: int, state, force: bool = False) -> bool:
         """Save when ``step`` hits the cadence (async; returns immediately)."""

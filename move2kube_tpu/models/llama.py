@@ -49,6 +49,7 @@ class LlamaConfig:
     mlp_dim: int = 14336
     max_len: int = 4096
     rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     attn_impl: str = "dense"  # dense | flash | ring | ulysses
     moe_experts: int = 0      # 0 = dense MLP; >0 = MoE with expert parallelism
@@ -139,7 +140,7 @@ class LlamaBlock(nn.Module):
         q_size = cfg.num_heads * head_dim
         kv_size = cfg.num_kv_heads * head_dim
 
-        h = RMSNorm(name="attn_norm")(x)
+        h = RMSNorm(cfg.norm_eps, name="attn_norm")(x)
         # fused QKV projection, column-split over the tensor axis
         qkv = nn.Dense(q_size + 2 * kv_size, use_bias=False, dtype=cfg.dtype,
                        name="qkv")(h)
@@ -160,7 +161,7 @@ class LlamaBlock(nn.Module):
         o = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype, name="attn_out")(o)
         x = x + o
 
-        h = RMSNorm(name="mlp_norm")(x)
+        h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
         if cfg.moe_experts > 0:
             from move2kube_tpu.models.moe import MoEMlp
 
@@ -196,7 +197,7 @@ class Llama(nn.Module):
         ).astype(jnp.float32)[None, None]
         for i in range(cfg.num_layers):
             x = LlamaBlock(cfg, name=f"layer_{i}")(x, positions, causal)
-        x = RMSNorm(name="final_norm")(x)
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x.astype(jnp.float32))
         return logits

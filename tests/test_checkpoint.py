@@ -79,3 +79,24 @@ def test_restore_into_new_process_state(tmp_path, sharded_state):
     b = jax.tree.leaves(restored.params)[0]
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     mngr2.close()
+
+
+def test_ported_params_only_checkpoint_grafts_into_fresh_state(tmp_path,
+                                                               sharded_state):
+    """port_weights.py writes {"params": ...} at step 0; restore_or_init
+    must graft those params into a fresh TrainState (new optimizer state)
+    and start from step 0."""
+    mesh, model, state = sharded_state
+    ported = {"params": jax.tree.map(lambda x: x * 0 + 7.0, state.params)}
+    mngr = ckpt.CheckpointManager(str(tmp_path / "ported"), every=1)
+    mngr.maybe_save(0, ported, force=True)
+    mngr.close()
+
+    mngr2 = ckpt.CheckpointManager(str(tmp_path / "ported"), every=1)
+    restored, step = mngr2.restore_or_init(state)
+    assert step == 0
+    leaf = np.asarray(jax.tree.leaves(restored.params)[0])
+    np.testing.assert_allclose(leaf, np.full_like(leaf, 7.0))
+    # fresh optimizer state is preserved (not restored from the ported dict)
+    assert jax.tree.structure(restored.opt_state) == jax.tree.structure(state.opt_state)
+    mngr2.close()

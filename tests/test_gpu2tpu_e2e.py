@@ -114,3 +114,13 @@ def test_translate_deepspeed_moe(tmp_path):
     assert 'M2KT_MESH_PIPE", "1"' in train_src
     assert 'M2KT_MESH_FSDP", "2"' in train_src
     assert (cdir / "move2kube_tpu" / "models" / "moe.py").exists()
+
+
+def test_emitted_container_includes_weight_porting(tmp_path):
+    res = run_cli("translate", "-s", os.path.join(SAMPLES, "gpu-training"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    cdir = tmp_path / "out" / "containers" / "resnet"
+    port = (cdir / "port_weights.py").read_text()
+    assert 'family = "resnet"' in port
+    assert (cdir / "move2kube_tpu" / "models" / "convert.py").exists()
