@@ -1,0 +1,76 @@
+"""Knative Service apiresource: run Knative workloads on plain clusters.
+
+Parity: ``internal/apiresourceset/knativeapiresourceset.go`` — the
+Knative2Kube direction. A cached ``serving.knative.dev`` Service on a
+cluster that supports the group passes through (version-fixed); on a
+cluster without Knative it lowers into the equivalent core objects:
+Deployment + Service (Knative's scale-to-zero/revisions have no vanilla
+equivalent, so the lowering keeps one revision at replicas=1 and exposes
+the declared container port).
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.apiresource.base import (
+    APIResource,
+    group_of,
+    make_obj,
+    obj_name,
+)
+from move2kube_tpu.types.ir import IR
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("apiresource.knative")
+
+KNATIVE_GROUP = "serving.knative.dev"
+DEFAULT_PORT = 8080
+
+
+class KnativeServiceAPIResource(APIResource):
+    def get_supported_kinds(self) -> list[str]:
+        return ["Service"]
+
+    def get_supported_groups(self) -> set[str]:
+        return {KNATIVE_GROUP}
+
+    def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
+        return []  # creation lives in KnativeTransformer (knative output mode)
+
+    def _supported_on(self, cluster) -> set[str]:
+        if not cluster.api_kind_version_map:
+            return {"Service"}
+        knative = any(
+            group_of(v) == KNATIVE_GROUP
+            for v in cluster.get_supported_versions("Service")
+        )
+        return {"Service"} if knative else set()
+
+    def convert_to_cluster_supported_kinds(
+        self, obj: dict, supported_kinds: set[str], other_objs: list[dict], ir: IR,
+    ) -> list[dict]:
+        if supported_kinds:
+            return [obj]
+        name = obj_name(obj)
+        tmpl = (obj.get("spec", {}).get("template", {}) or {})
+        pod_spec = dict(tmpl.get("spec", {}) or {})
+        containers = pod_spec.get("containers") or []
+        port = DEFAULT_PORT
+        for c in containers:
+            for p in c.get("ports", []) or []:
+                if p.get("containerPort"):
+                    port = int(p["containerPort"])
+                    break
+        labels = {"app": name}
+        deployment = make_obj("Deployment", "apps/v1", name, labels)
+        deployment["spec"] = {
+            "replicas": 1,
+            "selector": {"matchLabels": labels},
+            "template": {"metadata": {"labels": labels}, "spec": pod_spec},
+        }
+        service = make_obj("Service", "v1", name, labels)
+        service["spec"] = {
+            "selector": labels,
+            "ports": [{"name": "http", "port": 80, "targetPort": port}],
+        }
+        log.info("lowered knative service %s to Deployment+Service", name)
+        return [deployment, service]

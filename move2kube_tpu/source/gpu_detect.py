@@ -78,6 +78,9 @@ class GpuReport:
     pipeline_parallel: int = 1
     expert_parallel: int = 1
     num_experts: int = 0  # MoE expert count (DeepSpeed-MoE / Megatron)
+    batch_size_hint: int = 0   # per-device batch from source args/config
+    lr_hint: float = 0.0
+    steps_hint: int = 0
     model_family: str = ""
     entrypoint: str = ""  # training script path
     training_scripts: list[str] = field(default_factory=list)

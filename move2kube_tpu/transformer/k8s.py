@@ -14,6 +14,7 @@ import shutil
 from move2kube_tpu.apiresource.base import convert_objects
 from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
 from move2kube_tpu.apiresource.imagestream import ImageStreamAPIResource
+from move2kube_tpu.apiresource.knative import KnativeServiceAPIResource
 from move2kube_tpu.apiresource.networkpolicy import NetworkPolicyAPIResource
 from move2kube_tpu.apiresource.rbac import (
     RoleAPIResource,
@@ -48,6 +49,7 @@ def k8s_api_resources() -> list:
         ServiceAccountAPIResource(),
         RoleAPIResource(),
         RoleBindingAPIResource(),
+        KnativeServiceAPIResource(),
     ]
 
 

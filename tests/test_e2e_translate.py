@@ -161,9 +161,11 @@ def test_translate_every_stack_sample(tmp_path, sample):
     assert content.startswith("FROM "), content[:80]
 
 
-def test_knative_yaml_passes_through_untouched(tmp_path):
+def test_knative_yaml_lowered_not_mangled(tmp_path):
     """A cached serving.knative.dev Service must NOT be claimed by the core
-    Service resource and version-rewritten to v1 (kind-name collision)."""
+    Service resource and version-rewritten to v1 (kind-name collision).
+    On a cluster without Knative it lowers into Deployment + Service
+    (Knative2Kube, apiresource/knative.py)."""
     src = tmp_path / "kn"
     src.mkdir()
     (src / "service.yaml").write_text(
@@ -177,10 +179,15 @@ def test_knative_yaml_passes_through_untouched(tmp_path):
                   cwd=str(tmp_path))
     assert res.returncode == 0, res.stderr
     objs = load_all_yamls(tmp_path / "out" / "kn")
-    knative = [o for o in objs
-               if o.get("apiVersion") == "serving.knative.dev/v1"
-               and o.get("kind") == "Service"]
-    assert knative, f"knative service lost or rewritten: {objs}"
+    # never a core-v1 Service carrying a knative pod template
+    mangled = [o for o in objs if o.get("apiVersion") == "v1"
+               and o.get("kind") == "Service" and "template" in o.get("spec", {})]
+    assert not mangled, mangled
+    deployments = by_kind(objs, "Deployment")
+    images = [c["image"] for o in deployments
+              for c in o["spec"]["template"]["spec"]["containers"]]
+    assert "gcr.io/knative-samples/helloworld-go" in images
+    assert any(o.get("kind") == "Service" for o in objs)
 
 
 def test_compose_v1_format(tmp_path):
@@ -207,3 +214,23 @@ def test_compose_v1_format(tmp_path):
         for c in o["spec"]["template"]["spec"]["containers"]
     }
     assert images == {"nginx:1.25", "postgres:15"}
+
+
+def test_knative_service_kept_when_cluster_supports_it():
+    """Unit: the knative apiresource passes the object through (with its
+    group intact) when the cluster lists a serving.knative.dev version."""
+    from move2kube_tpu.apiresource.knative import KnativeServiceAPIResource
+    from move2kube_tpu.types.collection import ClusterMetadataSpec
+    from move2kube_tpu.types.ir import IR
+
+    obj = {"apiVersion": "serving.knative.dev/v1", "kind": "Service",
+           "metadata": {"name": "hello"},
+           "spec": {"template": {"spec": {"containers": [{"image": "x"}]}}}}
+    cluster = ClusterMetadataSpec(api_kind_version_map={
+        "Service": ["v1", "serving.knative.dev/v1"],
+        "Deployment": ["apps/v1"],
+    })
+    ir = IR(name="t")
+    ir.cached_objects.append(obj)
+    out = KnativeServiceAPIResource().get_updated_resources(ir, cluster, [obj])
+    assert out == [obj]
