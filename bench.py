@@ -1,34 +1,103 @@
 #!/usr/bin/env python
-"""Benchmark: ResNet-50 training throughput on the attached TPU chip.
+"""Benchmark: translated-workload training throughput on the attached TPU.
 
-This is BASELINE config 2 ("PyTorch ResNet-50 CUDA train.py -> jax-xla
-containerizer, single v5e chip") driven through the same model-zoo code the
-containerizer vendors into emitted images — i.e. it measures what a
-translated workload actually achieves.
+Default mode is BASELINE config 2 ("PyTorch ResNet-50 CUDA train.py ->
+jax-xla containerizer, single v5e chip"); ``--model bert`` measures
+BASELINE config 3 (HF BERT fine-tune, samples/s). Both drive the same
+model-zoo code the containerizer vendors into emitted images — i.e. they
+measure what a translated workload actually achieves.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-The reference (Move2Kube) publishes no performance numbers (BASELINE.md);
-``vs_baseline`` is therefore measured against the BASELINE.json north-star
-criterion — parity with a hand-ported JAX ResNet-50 on v5e-1. The
-hand-ported baseline constant below was set from the first measured run of
-this exact program (it IS the hand-port: straight flax/optax, bf16, no
-framework overhead), so vs_baseline == value / HAND_PORTED_IMG_S.
+The reference (Move2Kube) publishes no performance numbers (BASELINE.md),
+so ``vs_baseline`` is anchored to an external roofline-derived number for
+a well-tuned single-chip JAX run rather than to this program's own first
+run (which made vs_baseline circular in round 1): TPU v5e peak is 197
+bf16 TFLOP/s, and well-tuned models on TPU sustain ~30% MFU. ResNet-50 @
+224x224 is ~12.3 GFLOP/img fwd+bwd (3x the 4.1 GFLOP forward) => anchor
+4805 img/s. BERT-base @ seq 128 is ~6*110e6*128 = 84.5 GFLOP/sample =>
+anchor 700 samples/s. See BENCH_NOTES.md.
 """
 
+import argparse
 import json
 import sys
 import time
 
-HAND_PORTED_IMG_S = 2014.6  # measured r1 on v5e-1 (see BENCH_NOTES.md)
+V5E_PEAK_BF16_FLOPS = 197e12
+ANCHOR_MFU = 0.30  # well-tuned MFU on TPU (see BENCH_NOTES.md)
 
-BATCH = 128
-IMAGE = 224
-WARMUP_STEPS = 3
-MEASURE_STEPS = 20
+RESNET50_FLOPS_PER_IMG = 12.3e9  # fwd+bwd at 224x224 (3x fwd of 4.1 GFLOP)
+BERT_SEQ = 128
+BERT_FLOPS_PER_SAMPLE = 6 * 110e6 * BERT_SEQ  # 6*N*T rule, bert-base N=110M
+
+RESNET_BATCH, RESNET_IMAGE = 256, 224
+BERT_BATCH = 128
+
+SCAN_STEPS = 10          # optimizer steps fused into one device call
+WARMUP_CALLS = 1
+MEASURE_CALLS = 2        # 2 x 10 = 20 measured steps
+
+INIT_RETRIES = 4
+INIT_BACKOFF_S = 20.0
+INIT_PROBE_TIMEOUT_S = 150.0  # first TPU contact can take tens of seconds
 
 
-def main() -> int:
+def _probe_backend_subprocess() -> None:
+    """Touch the backend in a throwaway subprocess first.
+
+    The tunneled TPU plugin has two failure modes (both hit round 1's
+    official artifacts): a fast RuntimeError(UNAVAILABLE), and a plain
+    HANG inside make_c_api_client. A hung C call can't be interrupted
+    in-process, so each retry probes via subprocess with a timeout; only
+    after a probe succeeds do we initialize in-process (which then hits a
+    warmed-up tunnel)."""
+    import subprocess
+
+    subprocess.run(
+        [sys.executable, "-c", "import jax; print(jax.device_count())"],
+        check=True, capture_output=True, timeout=INIT_PROBE_TIMEOUT_S)
+
+
+def _init_devices():
+    """jax backend init with bounded retries (see _probe_backend_subprocess)."""
+    import subprocess
+
+    last: Exception | None = None
+    for attempt in range(INIT_RETRIES):
+        try:
+            _probe_backend_subprocess()
+            import jax
+
+            return jax.device_count()
+        except (RuntimeError, subprocess.SubprocessError) as e:
+            last = e
+            print(f"[bench] backend init failed (attempt {attempt + 1}/"
+                  f"{INIT_RETRIES}): {type(e).__name__}: {e}", file=sys.stderr)
+            time.sleep(INIT_BACKOFF_S * (attempt + 1))
+    raise RuntimeError(f"TPU backend unavailable after {INIT_RETRIES} "
+                       f"attempts: {last}")
+
+
+def _measure(step, state, batches, items_per_step: int):
+    """Timed loop. Timing boundaries force a device->host transfer, NOT
+    block_until_ready: remote-tunnel backends can report ready before
+    execution completes, a transfer cannot lie."""
+    for _ in range(WARMUP_CALLS):
+        state, losses = step(state, batches)
+    float(losses[-1])
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_CALLS):
+        state, losses = step(state, batches)
+    final_loss = float(losses[-1])
+    dt = time.perf_counter() - t0
+    if final_loss != final_loss:  # NaN: refuse to report a throughput
+        raise RuntimeError(f"training diverged: loss={final_loss}")
+    throughput = MEASURE_CALLS * SCAN_STEPS * items_per_step / dt
+    return throughput, final_loss
+
+
+def bench_resnet(n: int) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -38,39 +107,82 @@ def main() -> int:
     from move2kube_tpu.models.resnet import resnet50
     from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
 
-    n = jax.device_count()
+    batch, image = RESNET_BATCH, RESNET_IMAGE
     mesh = make_mesh(MeshConfig(data=n))
     model = resnet50(num_classes=1000)
     state = m2kt_train.create_sharded_state(
         jax.random.PRNGKey(0), model,
-        {"x": jnp.zeros((BATCH, IMAGE, IMAGE, 3), jnp.float32), "train": False},
+        {"x": jnp.zeros((batch, image, image, 3), jnp.bfloat16), "train": False},
         optax.sgd(0.1, momentum=0.9), mesh, has_batch_stats=True,
     )
-    step = m2kt_train.make_classifier_train_step(mesh, has_batch_stats=True)
+    step = m2kt_train.make_classifier_train_step(
+        mesh, has_batch_stats=True, scan_steps=SCAN_STEPS)
     gen = np.random.default_rng(0)
-    batch = {
-        "input": jnp.asarray(gen.random((BATCH, IMAGE, IMAGE, 3), np.float32)),
-        "label": jnp.asarray(gen.integers(0, 1000, BATCH)),
+    # bf16 input batch: halves host->device and HBM traffic vs f32
+    batches = {
+        "input": jnp.asarray(
+            gen.random((SCAN_STEPS, batch, image, image, 3), np.float32),
+            jnp.bfloat16),
+        "label": jnp.asarray(
+            gen.integers(0, 1000, (SCAN_STEPS, batch)), jnp.int32),
     }
-    for _ in range(WARMUP_STEPS):
-        state, loss = step(state, batch)
-    # device->host transfer, NOT block_until_ready: remote-tunnel backends
-    # can report ready before execution completes, a transfer cannot lie
-    float(loss)
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, loss = step(state, batch)
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
-    img_s = MEASURE_STEPS * BATCH / dt
-    if final_loss != final_loss:  # NaN: refuse to report a throughput
-        raise RuntimeError(f"training diverged: loss={final_loss}")
-    print(json.dumps({
+    img_s, loss = _measure(step, state, batches, batch)
+    mfu = img_s * RESNET50_FLOPS_PER_IMG / V5E_PEAK_BF16_FLOPS
+    print(f"[bench] resnet loss={loss:.3f} mfu={mfu:.1%}", file=sys.stderr)
+    anchor = V5E_PEAK_BF16_FLOPS * ANCHOR_MFU / RESNET50_FLOPS_PER_IMG
+    return {
         "metric": "resnet50_train_throughput_v5e1",
         "value": round(img_s, 1),
         "unit": "img/s",
-        "vs_baseline": round(img_s / HAND_PORTED_IMG_S, 3),
-    }))
+        "vs_baseline": round(img_s / anchor, 3),
+    }
+
+
+def bench_bert(n: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from move2kube_tpu.models import train as m2kt_train
+    from move2kube_tpu.models.bert import bert_base
+    from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    batch = BERT_BATCH
+    mesh = make_mesh(MeshConfig(data=n))
+    model = bert_base(num_classes=2)
+    ids0 = jnp.zeros((batch, BERT_SEQ), jnp.int32)
+    state = m2kt_train.create_sharded_state(
+        jax.random.PRNGKey(0), model, {"input_ids": ids0},
+        optax.adamw(2e-5), mesh,
+    )
+    step = m2kt_train.make_bert_train_step(mesh, scan_steps=SCAN_STEPS)
+    gen = np.random.default_rng(0)
+    batches = {
+        "input_ids": jnp.asarray(
+            gen.integers(0, 30522, (SCAN_STEPS, batch, BERT_SEQ)), jnp.int32),
+        "attention_mask": jnp.ones((SCAN_STEPS, batch, BERT_SEQ), bool),
+        "label": jnp.asarray(gen.integers(0, 2, (SCAN_STEPS, batch)), jnp.int32),
+    }
+    samples_s, loss = _measure(step, state, batches, batch)
+    mfu = samples_s * BERT_FLOPS_PER_SAMPLE / V5E_PEAK_BF16_FLOPS
+    print(f"[bench] bert loss={loss:.3f} mfu={mfu:.1%}", file=sys.stderr)
+    anchor = V5E_PEAK_BF16_FLOPS * ANCHOR_MFU / BERT_FLOPS_PER_SAMPLE
+    return {
+        "metric": "bert_finetune_throughput_v5e1",
+        "value": round(samples_s, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_s / anchor, 3),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", choices=("resnet", "bert"), default="resnet")
+    args = parser.parse_args()
+    n = _init_devices()
+    result = bench_resnet(n) if args.model == "resnet" else bench_bert(n)
+    print(json.dumps(result))
     return 0
 
 
