@@ -18,7 +18,12 @@ log = get_logger("apiresource")
 
 
 _GROUP_ALIASES = {
+    # pre-1.16 "extensions" umbrella <-> its split-out groups, both
+    # directions: upgrade old objects to modern groups AND downgrade
+    # modern objects for clusters that only advertise extensions/*
     "extensions": ("networking.k8s.io", "apps"),
+    "networking.k8s.io": ("extensions",),
+    "apps": ("extensions",),
 }
 
 
@@ -32,6 +37,54 @@ def obj_kind(obj: dict) -> str:
 
 def group_of(api_version: str) -> str:
     return api_version.rsplit("/", 1)[0] if "/" in api_version else ""
+
+
+def _convert_ingress_backend_v1beta1_to_v1(b: dict | None) -> dict | None:
+    if not b or "service" in b:
+        return b
+    port = b.get("servicePort")
+    svc: dict = {"name": b.get("serviceName", "")}
+    if port is not None:
+        svc["port"] = {"name" if isinstance(port, str) else "number": port}
+    return {"service": svc}
+
+
+def _convert_ingress_backend_v1_to_v1beta1(b: dict | None) -> dict | None:
+    if not b or "service" not in b:
+        return b
+    svc = b.get("service") or {}
+    port = (svc.get("port") or {})
+    out: dict = {"serviceName": svc.get("name", "")}
+    sp = port.get("number", port.get("name"))
+    if sp is not None:
+        out["servicePort"] = sp
+    return out
+
+
+def convert_ingress_spec(obj: dict, to_group: str) -> None:
+    """Rewrite an Ingress spec between networking.k8s.io/v1 and
+    extensions/v1beta1 schemas in place: the backend shape and pathType
+    changed across the group rename, so an apiVersion bump alone emits
+    schema-invalid yaml."""
+    spec = obj.get("spec") or {}
+    modern = to_group == "networking.k8s.io"
+    conv = (_convert_ingress_backend_v1beta1_to_v1 if modern
+            else _convert_ingress_backend_v1_to_v1beta1)
+    if modern and "backend" in spec:
+        spec["defaultBackend"] = conv(spec.pop("backend"))
+    elif not modern and "defaultBackend" in spec:
+        spec["backend"] = conv(spec.pop("defaultBackend"))
+    if not modern and "ingressClassName" in spec:
+        cls = spec.pop("ingressClassName")
+        obj.setdefault("metadata", {}).setdefault("annotations", {})[
+            "kubernetes.io/ingress.class"] = cls
+    for rule in spec.get("rules") or []:
+        for path in (rule.get("http") or {}).get("paths") or []:
+            path["backend"] = conv(path.get("backend"))
+            if modern:
+                path.setdefault("pathType", "ImplementationSpecific")
+            else:
+                path.pop("pathType", None)
 
 
 def make_obj(kind: str, api_version: str, name: str, labels: dict | None = None) -> dict:
@@ -129,10 +182,13 @@ class APIResource:
             same_group = [v for v in versions if group_of(v) == grp]
             if not same_group:
                 # pre-1.16 "extensions" umbrella split into real groups;
-                # upgrading across that rename is a pure apiVersion bump
+                # crossing that rename is an apiVersion bump for most
+                # kinds, plus a spec rewrite for Ingress
                 for alias in _GROUP_ALIASES.get(grp, ()):
                     same_group = [v for v in versions if group_of(v) == alias]
                     if same_group:
+                        if kind == "Ingress":
+                            convert_ingress_spec(obj, group_of(same_group[0]))
                         break
             if same_group:
                 obj["apiVersion"] = same_group[0]

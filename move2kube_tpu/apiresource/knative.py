@@ -54,12 +54,10 @@ class KnativeServiceAPIResource(APIResource):
         tmpl = (obj.get("spec", {}).get("template", {}) or {})
         pod_spec = dict(tmpl.get("spec", {}) or {})
         containers = pod_spec.get("containers") or []
-        port = DEFAULT_PORT
-        for c in containers:
-            for p in c.get("ports", []) or []:
-                if p.get("containerPort"):
-                    port = int(p["containerPort"])
-                    break
+        port = next(
+            (int(p["containerPort"]) for c in containers
+             for p in c.get("ports", []) or [] if p.get("containerPort")),
+            DEFAULT_PORT)  # first declared port across ALL containers wins
         labels = {"app": name}
         deployment = make_obj("Deployment", "apps/v1", name, labels)
         deployment["spec"] = {

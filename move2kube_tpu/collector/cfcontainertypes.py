@@ -50,8 +50,11 @@ def options_for_buildpack(buildpack: str,
         # word-anchored: 'go' must not match 'django_buildpack'
         if re.search(rf"(^|[^a-z]){frag}([^a-z]|$)", bp):
             opts = list(opts)
+            # same word-anchored match as above: frag 'go' must not hit
+            # builder ids like 'google.python'
             if (builder_buildpacks and ContainerBuildType.CNB in opts
-                    and not any(frag in b for b in builder_buildpacks)):
+                    and not any(re.search(rf"(^|[^a-z]){frag}([^a-z]|$)", b)
+                                for b in builder_buildpacks)):
                 opts.remove(ContainerBuildType.CNB)
             return opts
     return [ContainerBuildType.MANUAL]

@@ -18,7 +18,31 @@ match the torch model's on the same inputs.
 
 from __future__ import annotations
 
+import re
+
 import numpy as np
+
+_LAYER_RES = {
+    # regex, not fixed split positions: keys may be bare
+    # ('encoder.layer.0...', 'layers.0...') or prefixed
+    # ('bert.encoder.layer.0...', 'model.layers.0...')
+    "bert": re.compile(r"(?:^|\.)encoder\.layer\.(\d+)\."),
+    "llama": re.compile(r"(?:^|\.)layers\.(\d+)\."),
+    "gpt": re.compile(r"(?:^|\.)layers\.(\d+)\."),
+}
+
+
+def infer_num_layers(state_dict: dict, family: str) -> int:
+    """Count transformer blocks in a torch/HF state_dict by key pattern."""
+    pat = _LAYER_RES.get(family)
+    if pat is None:
+        raise ValueError(f"no layer pattern for model family {family!r}")
+    ids = [int(m.group(1)) for k in state_dict if (m := pat.search(k))]
+    if not ids:
+        raise ValueError(
+            f"no {family!r} layer keys found in state_dict "
+            f"(looked for {pat.pattern!r})")
+    return 1 + max(ids)
 
 
 def _np(t) -> np.ndarray:

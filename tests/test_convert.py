@@ -135,3 +135,22 @@ def test_resnet_converter_matches_flax_tree_structure():
     ref_s = jax.tree_util.tree_structure(variables["batch_stats"])
     got_s = jax.tree_util.tree_structure(stats)
     assert ref_s == got_s
+
+
+def test_infer_num_layers_bare_and_prefixed():
+    """ADVICE r1: bare (un-prefixed) state_dicts crashed the fixed-position
+    key split; the regex must handle both forms."""
+    bare_bert = {f"encoder.layer.{i}.attention.self.query.weight": 0
+                 for i in range(4)}
+    pre_bert = {f"bert.encoder.layer.{i}.output.dense.bias": 0
+                for i in range(12)}
+    bare_llama = {f"layers.{i}.self_attn.q_proj.weight": 0 for i in range(2)}
+    pre_llama = {f"model.layers.{i}.mlp.gate_proj.weight": 0 for i in range(32)}
+    assert m2kt_convert.infer_num_layers(bare_bert, "bert") == 4
+    assert m2kt_convert.infer_num_layers(pre_bert, "bert") == 12
+    assert m2kt_convert.infer_num_layers(bare_llama, "llama") == 2
+    assert m2kt_convert.infer_num_layers(pre_llama, "gpt") == 32
+    with pytest.raises(ValueError, match="no layer pattern"):
+        m2kt_convert.infer_num_layers(bare_bert, "resnet")
+    with pytest.raises(ValueError, match="layer keys"):
+        m2kt_convert.infer_num_layers({"fc.weight": 0}, "bert")
