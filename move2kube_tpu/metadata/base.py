@@ -10,6 +10,7 @@ from __future__ import annotations
 import os
 
 from move2kube_tpu.metadata import clusters
+from move2kube_tpu.source import kube2kube
 from move2kube_tpu.types import collection as collecttypes
 from move2kube_tpu.types.ir import IR
 from move2kube_tpu.types.plan import Plan, PlanService, TargetCluster, TranslationType
@@ -43,8 +44,11 @@ class ClusterMDLoader(Loader):
             log.info("found collected cluster metadata %s (%s)", cm.name, path)
         if not plan.kubernetes.target_cluster.type and not plan.kubernetes.target_cluster.path:
             # default: TPU cluster when the plan has GPU training services
+            # (detected CUDA sources OR GPU-requesting k8s/compose inputs,
+            # which carry AcceleratorInfo instead of the GPU2TPU type)
             has_tpu = any(
                 s.translation_type == TranslationType.GPU2TPU
+                or s.accelerator is not None
                 for svcs in plan.services.values() for s in svcs
             )
             plan.kubernetes.target_cluster = TargetCluster(
@@ -72,6 +76,7 @@ class K8sFilesLoader(Loader):
     """Parity: internal/metadata/k8sfiles.go:35-95."""
 
     def update_plan(self, plan: Plan) -> None:
+        max_gpus = 0
         for path in common.get_files_by_ext(plan.root_dir, [".yaml", ".yml"]):
             try:
                 import yaml
@@ -86,8 +91,13 @@ class K8sFilesLoader(Loader):
                 and not str(d.get("apiVersion", "")).startswith("move2kube-tpu.io")
                 and not isinstance(d.get("services"), dict)  # not a compose file
             ]
-            if k8s_docs and path not in plan.k8s_files:
+            if not k8s_docs:
+                continue
+            if path not in plan.k8s_files:
                 plan.k8s_files.append(path)
+            # scan every file (also on re-plan of an existing plan file)
+            max_gpus = max(max_gpus, max(
+                (kube2kube.k8s_doc_gpu_count(d) for d in k8s_docs), default=0))
         if plan.k8s_files:
             # register a kube2kube service so translate picks the files up
             svc = PlanService(
@@ -97,6 +107,17 @@ class K8sFilesLoader(Loader):
             )
             for f in plan.k8s_files:
                 svc.add_source_artifact(PlanService.K8S_ARTIFACT, f)
+            if max_gpus:
+                # record the GPU->TPU mapping in the plan so curation shows
+                # it and ClusterMDLoader targets the TPU cluster profile
+                from move2kube_tpu.source import gpu_detect
+                from move2kube_tpu.types.plan import AcceleratorInfo
+
+                acc_type, topo, hosts = gpu_detect.map_gpu_to_tpu(max_gpus)
+                svc.accelerator = AcceleratorInfo(
+                    gpu_count=max_gpus, gpu_vendor="nvidia.com/gpu",
+                    tpu_accelerator=acc_type, tpu_topology=topo,
+                    num_hosts=hosts)
             plan.add_service(svc)
 
     def load_to_ir(self, plan: Plan, ir: IR) -> None:
@@ -120,4 +141,6 @@ class QACacheLoader(Loader):
 
 
 def get_loaders() -> list[Loader]:
-    return [ClusterMDLoader(), K8sFilesLoader(), QACacheLoader()]
+    # K8sFilesLoader before ClusterMDLoader: the cluster default depends on
+    # whether registered services carry accelerator info (GPU k8s inputs)
+    return [K8sFilesLoader(), ClusterMDLoader(), QACacheLoader()]

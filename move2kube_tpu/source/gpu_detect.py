@@ -321,9 +321,9 @@ def map_gpu_to_tpu(gpu_count: int, zero_stage: int = 0) -> tuple[str, str, int]:
 
     ZeRO-3 / model-parallel workloads (sharded params) prefer v5p for HBM
     capacity and 3D torus ICI; everything else maps to v5e pod slices.
+    Counts are clamped to [1, 256] (the largest supported topology).
     """
-    if gpu_count <= 0:
-        gpu_count = 1
+    gpu_count = min(max(gpu_count, 1), 256)
     for threshold, (acc, topo, hosts) in _TOPOLOGY_TABLE:
         if gpu_count <= threshold:
             if zero_stage >= 3 and threshold >= 8:

@@ -3,18 +3,138 @@
 Parity: ``internal/source/kube2kube.go`` — planning is handled by the
 K8sFilesLoader metadata loader; translate re-reads the plan's k8s yamls
 into ``ir.cached_objects`` so the apiresource engine converts them to
-cluster-supported kinds/versions at write time.
+cluster-supported kinds/versions at write time (the reference's kube
+planner/translator seam is ``k8sapiresourceset.go:81-115``).
+
+Net-new (north star): workloads whose pod spec requests ``nvidia.com/gpu``
+are *not* passed through — they are lifted into IR services carrying
+AcceleratorInfo so the deployment apiresource re-emits them as TPU
+JobSets with ``google.com/tpu`` resources, exactly like GPU compose
+services (compose2kube.py) and detected CUDA sources.
 """
 
 from __future__ import annotations
 
 from move2kube_tpu.source.base import Translator
 from move2kube_tpu.types import ir as irtypes
-from move2kube_tpu.types.plan import Plan, PlanService, TranslationType
+from move2kube_tpu.types.plan import AcceleratorInfo, Plan, PlanService, TranslationType
 from move2kube_tpu.utils import common
 from move2kube_tpu.utils.log import get_logger
 
 log = get_logger("source.kube2kube")
+
+# kinds whose spec.template holds the pod spec
+_TEMPLATED_KINDS = {"Deployment", "StatefulSet", "ReplicaSet", "DaemonSet",
+                    "Job", "ReplicationController", "DeploymentConfig"}
+# GPU-machine node-selector/toleration keys that must not survive the move
+# to TPU node pools
+_GPU_NODE_KEYS = ("nvidia.com", "gke-accelerator", "gpu")
+
+
+def _pod_template(obj: dict) -> dict | None:
+    kind = obj.get("kind")
+    if kind == "Pod":
+        return {"metadata": obj.get("metadata", {}), "spec": obj.get("spec", {})}
+    if kind == "CronJob":
+        return (obj.get("spec", {}).get("jobTemplate", {})
+                .get("spec", {}).get("template"))
+    if kind in _TEMPLATED_KINDS:
+        return obj.get("spec", {}).get("template")
+    return None
+
+
+def _strip_gpu_resources(container: dict) -> dict:
+    c = dict(container)
+    resources = dict(c.get("resources") or {})
+    for section in ("limits", "requests"):
+        vals = {k: v for k, v in (resources.get(section) or {}).items()
+                if "gpu" not in k.lower()}
+        if vals:
+            resources[section] = vals
+        else:
+            resources.pop(section, None)
+    if resources:
+        c["resources"] = resources
+    else:
+        c.pop("resources", None)
+    return c
+
+
+def _pod_count(obj: dict) -> int:
+    """Concurrent pods a workload runs: replicas for replicated kinds,
+    parallelism for (Cron)Jobs."""
+    spec = obj.get("spec", {}) or {}
+    if obj.get("kind") == "CronJob":
+        spec = spec.get("jobTemplate", {}).get("spec", {}) or {}
+    if obj.get("kind") in ("Job", "CronJob"):
+        return int(spec.get("parallelism") or 1)
+    return int(spec.get("replicas") or 1)
+
+
+def k8s_doc_gpu_count(obj: dict) -> int:
+    """Total GPUs a k8s workload requests (per-pod GPUs x concurrent pods)."""
+    from move2kube_tpu.source import gpu_detect
+
+    template = _pod_template(obj)
+    if not template:
+        return 0
+    containers = (template.get("spec") or {}).get("containers") or []
+    per_pod = sum(
+        gpu_detect.gpu_resources_from_k8s_container(c) for c in containers)
+    return per_pod * max(1, _pod_count(obj))
+
+
+def tpu_service_from_gpu_workload(obj: dict) -> irtypes.Service | None:
+    """Lift a GPU-requesting k8s workload into a TPU-bound IR service.
+
+    Returns None when the object has no pod template or requests no GPUs.
+    The returned service carries AcceleratorInfo + job=True, which the
+    deployment apiresource turns into a JobSet with google.com/tpu.
+    """
+    from move2kube_tpu.source import gpu_detect
+
+    total_gpus = k8s_doc_gpu_count(obj)
+    if not total_gpus:
+        return None
+    template = _pod_template(obj)
+    pod = template.get("spec", {}) or {}
+    containers = pod.get("containers") or []
+    acc_type, topology, hosts = gpu_detect.map_gpu_to_tpu(total_gpus)
+
+    name = common.make_dns_label(
+        obj.get("metadata", {}).get("name") or "gpu-workload")
+    svc = irtypes.Service(name=name)
+    # pod-template labels too: Services in the same yaml select on them
+    # and pass through via cached_objects expecting pods to still match
+    svc.labels = {**(obj.get("metadata", {}).get("labels") or {}),
+                  **(template.get("metadata", {}).get("labels") or {})}
+    svc.annotations = dict(obj.get("metadata", {}).get("annotations") or {})
+    svc.containers = [_strip_gpu_resources(c) for c in containers]
+    svc.init_containers = list(pod.get("initContainers") or [])
+    svc.volumes = list(pod.get("volumes") or [])
+    svc.service_account_name = pod.get("serviceAccountName", "")
+    svc.image_pull_secrets = [
+        s.get("name", "") for s in pod.get("imagePullSecrets") or []]
+    svc.security_context = dict(pod.get("securityContext") or {})
+    svc.node_selector = {
+        k: v for k, v in (pod.get("nodeSelector") or {}).items()
+        if not any(g in k.lower() for g in _GPU_NODE_KEYS)}
+    svc.tolerations = [
+        t for t in pod.get("tolerations") or []
+        if not any(g in (t.get("key") or "").lower() for g in _GPU_NODE_KEYS)]
+    svc.accelerator = AcceleratorInfo(
+        gpu_count=total_gpus,
+        gpu_vendor="nvidia.com/gpu",
+        distributed_backend="nccl" if total_gpus > 1 else "",
+        tpu_accelerator=acc_type,
+        tpu_topology=topology,
+        num_hosts=hosts,
+    )
+    svc.job = True
+    svc.restart_policy = "Never"
+    log.info("k8s %s %s requests %d GPU(s) -> TPU %s %s (%d host(s))",
+             obj.get("kind"), name, total_gpus, acc_type, topology, hosts)
+    return svc
 
 
 def load_k8s_yamls(paths: list[str]) -> list[dict]:
@@ -46,5 +166,10 @@ class KubeTranslator(Translator):
             paths.extend(svc.source_artifacts.get(PlanService.K8S_ARTIFACT, []))
         if not paths:
             paths = plan.k8s_files
-        ir.cached_objects.extend(load_k8s_yamls(paths))
+        for obj in load_k8s_yamls(paths):
+            svc = tpu_service_from_gpu_workload(obj)
+            if svc is not None:
+                ir.add_service(svc)  # re-emitted as a TPU JobSet
+            else:
+                ir.cached_objects.append(obj)
         return ir

@@ -1,0 +1,2 @@
+#!/bin/sh
+torchrun --nproc_per_node=8 train_bert.py

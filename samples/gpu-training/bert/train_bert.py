@@ -1,0 +1,30 @@
+"""Sample HF BERT fine-tune over torch.distributed/NCCL (detection target:
+BASELINE config 3 — "BERT NCCL fine-tune -> v5e-8 JobSet")."""
+import torch
+import torch.distributed as dist
+from transformers import AutoModelForSequenceClassification, AutoTokenizer
+
+
+def main():
+    dist.init_process_group(backend="nccl")
+    rank = dist.get_rank()
+    torch.cuda.set_device(rank % torch.cuda.device_count())
+    tok = AutoTokenizer.from_pretrained("bert-base-uncased")
+    model = AutoModelForSequenceClassification.from_pretrained(
+        "bert-base-uncased", num_labels=2).cuda()
+    model = torch.nn.parallel.DistributedDataParallel(model)
+    optimizer = torch.optim.AdamW(model.parameters(), lr=2e-5)
+    texts = ["a fine movie"] * 32
+    for step in range(200):
+        batch = tok(texts, return_tensors="pt", padding="max_length",
+                    max_length=128)
+        batch = {k: v.cuda() for k, v in batch.items()}
+        labels = torch.randint(0, 2, (len(texts),)).cuda()
+        loss = model(**batch, labels=labels).loss
+        loss.backward()
+        optimizer.step()
+        optimizer.zero_grad()
+
+
+if __name__ == "__main__":
+    main()

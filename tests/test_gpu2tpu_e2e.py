@@ -124,3 +124,29 @@ def test_emitted_container_includes_weight_porting(tmp_path):
     port = (cdir / "port_weights.py").read_text()
     assert 'family = "resnet"' in port
     assert (cdir / "move2kube_tpu" / "models" / "convert.py").exists()
+
+
+def test_translate_bert_finetune(tmp_path):
+    """BASELINE config 3: HF BERT NCCL fine-tune -> v5e-8 JobSet with a
+    family=bert training program."""
+    res = run_cli("translate", "-s", os.path.join(SAMPLES, "gpu-training", "bert"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    out = tmp_path / "out"
+
+    jobset = yaml.safe_load(open(out / "bert" / "bert-jobset.yaml"))
+    assert jobset["kind"] == "JobSet"
+    job_spec = jobset["spec"]["replicatedJobs"][0]["template"]["spec"]
+    assert job_spec["parallelism"] == 2  # v5e-8 = 2x4 topology, 2 hosts
+    pod = job_spec["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+    assert pod["containers"][0]["resources"]["limits"]["google.com/tpu"] == 4
+
+    cdir = out / "containers" / "bert"
+    train_src = (cdir / "train_tpu.py").read_text()
+    assert "bert_base" in train_src
+    assert "make_bert_train_step" in train_src
+    assert 'M2KT_MESH_DATA", "8"' in train_src  # pure DDP -> 8-way data
+    assert (cdir / "move2kube_tpu" / "models" / "bert.py").exists()
+    port = (cdir / "port_weights.py").read_text()
+    assert 'family = "bert"' in port  # fine-tune resumes from GPU weights
