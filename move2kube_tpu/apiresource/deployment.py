@@ -49,11 +49,13 @@ def _tpu_resources(svc: Service, workload_kind: str = JOB_SET) -> None:
     if acc is None:
         return
     chips_per_host = _chips_per_host(acc.tpu_topology, acc.num_hosts)
+    num_slices = max(1, acc.num_slices)
     svc.subdomain = svc.name  # headless service publishes the pod DNS names
     if workload_kind == JOB_SET:
         coordinator = f"{svc.name}-workers-0-0.{svc.name}:8476"
     else:
         coordinator = f"{svc.name}-0.{svc.name}:8476"
+    multihost = acc.num_hosts > 1 or num_slices > 1
     for c in svc.containers:
         res = c.setdefault("resources", {})
         res.setdefault("limits", {})["google.com/tpu"] = chips_per_host
@@ -70,11 +72,27 @@ def _tpu_resources(svc: Service, workload_kind: str = JOB_SET) -> None:
         )
         for name, value in (
             ("M2KT_NUM_HOSTS", str(acc.num_hosts)),
-            ("M2KT_COORDINATOR", coordinator if acc.num_hosts > 1 else ""),
+            ("M2KT_COORDINATOR", coordinator if multihost else ""),
             ("M2KT_CKPT_DIR", ckpt_dir),
         ):
             if value and name not in existing:
                 env.append({"name": name, "value": value})
+        if num_slices > 1 and workload_kind == JOB_SET:
+            # multi-slice: DP gradients ride DCN between slices (megascale);
+            # each replicatedJob replica is one slice, its index published
+            # by the JobSet controller as the job-index annotation
+            slice_id_ref = {"fieldRef": {"fieldPath":
+                "metadata.annotations['jobset.sigs.k8s.io/job-index']"}}
+            for name, entry in (
+                ("M2KT_NUM_SLICES", {"value": str(num_slices)}),
+                ("M2KT_SLICE_ID", {"valueFrom": slice_id_ref}),
+                ("MEGASCALE_NUM_SLICES", {"value": str(num_slices)}),
+                ("MEGASCALE_SLICE_ID", {"valueFrom": slice_id_ref}),
+                ("MEGASCALE_COORDINATOR_ADDRESS",
+                 {"value": f"{svc.name}-workers-0-0.{svc.name}:8080"}),
+            ):
+                if name not in existing:
+                    env.append({"name": name, **entry})
     svc.node_selector.setdefault("cloud.google.com/gke-tpu-accelerator",
                                  acc.tpu_accelerator or "tpu-v5-lite-podslice")
     svc.node_selector.setdefault("cloud.google.com/gke-tpu-topology",
@@ -190,7 +208,7 @@ class DeploymentAPIResource(APIResource):
             "failurePolicy": {"maxRestarts": 3},
             "replicatedJobs": [{
                 "name": "workers",
-                "replicas": 1,  # one slice; multi-slice scales this
+                "replicas": max(1, acc.num_slices),  # one Job replica per slice
                 "template": {"spec": job_spec},
             }],
         }

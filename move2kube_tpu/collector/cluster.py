@@ -1,22 +1,38 @@
 """Cluster collector: introspect the live target cluster.
 
-Parity: ``internal/collector/clustercollector.go`` — prefers the discovery
-API; we have no client-go, so the primary path shells out to ``kubectl
-api-resources`` / ``api-versions`` (collectUsingCLI :491) and also gathers
-storage classes and (net-new) TPU node-pool capability from node labels
-(``cloud.google.com/gke-tpu-accelerator``).
+Parity: ``internal/collector/clustercollector.go`` — the reference prefers
+the client-go discovery API (collectUsingAPI :301) and falls back to
+kubectl exec (collectUsingCLI :491). We have no client-go; the primary
+path here talks to the *same* discovery REST endpoints through
+``kubectl get --raw /apis`` + ``/api`` (APIGroupList / APIResourceList
+JSON), gathering every group's preferred version and full version list,
+then orders each kind's group/versions by preference
+(sortGroupVersionByPreferrence :148 + groupOrderPolicy :365 +
+sortVersionList :412 — our policy lives in types/collection.py). The
+fallback parses ``kubectl api-resources`` / ``api-versions`` output.
+
+Also gathers storage classes and (net-new) TPU node-pool capability from
+``cloud.google.com/gke-tpu-accelerator`` node labels.
+
+The kubectl runner is injectable so tests drive the whole pipeline from
+recorded fixtures (the reference leaves this layer untested; SURVEY §4).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
+from typing import Callable
 
 from move2kube_tpu.types import collection as collecttypes
+from move2kube_tpu.types.collection import sort_version_list
 from move2kube_tpu.utils import common
 from move2kube_tpu.utils.log import get_logger
 
 log = get_logger("collector.cluster")
+
+Runner = Callable[..., "str | None"]
 
 
 def _kubectl(*args: str) -> str | None:
@@ -32,38 +48,181 @@ def _kubectl(*args: str) -> str | None:
 
 
 class ClusterCollector:
+    def __init__(self, runner: Runner | None = None):
+        self._run = runner or _kubectl
+
     def get_annotations(self) -> list[str]:
         return ["k8s", "cluster"]
 
-    def collect(self, source_dir: str, out_dir: str) -> None:
-        out = _kubectl("api-resources", "--no-headers")
+    # -- discovery-API path (collectUsingAPI :301) ---------------------------
+
+    def _discovery_groups(self) -> tuple[list[str], dict[str, str]] | None:
+        """-> (group/versions in preference order, group -> preferred gv).
+
+        Preference order per group: preferred version first, remaining
+        versions stage-sorted (GA > beta > alpha) — the same shape
+        getPreferredResourceUsingAPI builds from ServerGroups.
+        """
+        apis_raw = self._run("get", "--raw", "/apis")
+        core_raw = self._run("get", "--raw", "/api")
+        if apis_raw is None or core_raw is None:
+            # partial discovery is worse than none: recording a kind map
+            # without (say) the core group would flag every Service as
+            # cluster-unsupported at emission — fall back to the CLI path
+            return None
+        gv_order: list[str] = []
+        preferred: dict[str, str] = {}
+        try:
+            core_versions = json.loads(core_raw).get("versions", [])
+        except (ValueError, AttributeError):
+            core_versions = []
+        for v in core_versions:  # core group "" — always most preferred
+            if v not in gv_order:
+                gv_order.append(v)
+        if core_versions:
+            preferred[""] = core_versions[0]
+        try:
+            groups = json.loads(apis_raw).get("groups", [])
+        except (ValueError, AttributeError):
+            groups = []
+        for group in groups:
+            pref = (group.get("preferredVersion") or {}).get("groupVersion", "")
+            versions = [v.get("groupVersion", "")
+                        for v in group.get("versions", []) if v.get("groupVersion")]
+            if pref:
+                preferred[group.get("name", "")] = pref
+            ordered = ([pref] if pref in versions else []) + sort_version_list(
+                [v for v in versions if v != pref])
+            for gv in ordered:
+                if gv not in gv_order:
+                    gv_order.append(gv)
+        return (gv_order, preferred) if gv_order else None
+
+    def _kinds_for_group_version(self, gv: str) -> list[str]:
+        path = f"/apis/{gv}" if "/" in gv else f"/api/{gv}"
+        raw = self._run("get", "--raw", path)
+        if raw is None:
+            # reference behavior (getKindsForGroups): a single erroring
+            # group-version (e.g. a down aggregated APIService) is logged
+            # and skipped, not fatal
+            log.warning("discovery of %s failed; skipping that group/version", gv)
+            return []
+        try:
+            resources = json.loads(raw).get("resources", [])
+        except ValueError:
+            return []
+        # skip subresources (pods/log, deployments/scale)
+        return sorted({r["kind"] for r in resources
+                       if r.get("kind") and "/" not in r.get("name", "")})
+
+    def collect_using_api(self) -> dict[str, list[str]] | None:
+        found = self._discovery_groups()
+        if found is None:
+            return None
+        gv_order, preferred = found
+        kind_map: dict[str, list[str]] = {}
+        # one kubectl exec per group/version: fetch concurrently (a real
+        # cluster has 30-60 of these; serial would block collect for ~10s)
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            kinds_per_gv = list(pool.map(self._kinds_for_group_version, gv_order))
+        for gv, kinds in zip(gv_order, kinds_per_gv):
+            for kind in kinds:
+                versions = kind_map.setdefault(kind, [])
+                if gv not in versions:
+                    versions.append(gv)
+        for kind, versions in kind_map.items():
+            kind_map[kind] = self._order_kind_versions(versions, preferred)
+        return kind_map or None
+
+    @staticmethod
+    def _order_kind_versions(versions: list[str],
+                             preferred: dict[str, str]) -> list[str]:
+        """Group-preference policy + per-group preferred-version-first
+        (parity: groupOrderPolicy :365 + sortGroupVersionByPreferrence)."""
+        policy_sorted = sort_version_list(versions)
+        out: list[str] = []
+        for gv in policy_sorted:
+            group = gv.rsplit("/", 1)[0] if "/" in gv else ""
+            pref = preferred.get(group)
+            if pref in versions and pref not in out:
+                out.append(pref)
+            if gv not in out:
+                out.append(gv)
+        return out
+
+    # -- CLI fallback (collectUsingCLI :491) ---------------------------------
+
+    def collect_using_cli(self) -> dict[str, list[str]] | None:
+        out = self._run("api-resources", "--no-headers")
         if out is None:
-            log.info("kubectl unavailable; skipping cluster collection")
-            return
-        spec = collecttypes.ClusterMetadataSpec()
+            return None
+        kind_map: dict[str, list[str]] = {}
+        kind_groups: dict[str, set[str]] = {}
         for line in out.splitlines():
             parts = line.split()
-            if len(parts) < 4:
+            # NAME [SHORTNAMES] APIVERSION NAMESPACED KIND — NAMESPACED is
+            # the only boolean column; anchor on it instead of counting
+            try:
+                ns_idx = next(i for i, p in enumerate(parts)
+                              if p in ("true", "false"))
+            except StopIteration:
                 continue
-            # NAME [SHORTNAMES] APIVERSION NAMESPACED KIND
-            kind = parts[-1]
-            api_version = parts[-3]
-            spec.api_kind_version_map.setdefault(kind, [])
-            if api_version not in spec.api_kind_version_map[kind]:
-                spec.api_kind_version_map[kind].append(api_version)
-        sc_out = _kubectl("get", "storageclass", "-o", "name")
+            if ns_idx < 1 or ns_idx + 1 >= len(parts):
+                continue
+            api_version = parts[ns_idx - 1]
+            kind = parts[ns_idx + 1]
+            group = api_version.rsplit("/", 1)[0] if "/" in api_version else ""
+            versions = kind_map.setdefault(kind, [])
+            if api_version not in versions:
+                versions.append(api_version)
+            kind_groups.setdefault(kind, set()).add(group)
+        if not kind_map:
+            return None
+        # api-resources shows only each group's preferred version; fill in
+        # the rest of the group's versions from `kubectl api-versions`
+        av = self._run("api-versions")
+        all_gvs = [l.strip() for l in av.splitlines() if l.strip()] if av else []
+        for kind, groups in kind_groups.items():
+            for gv in all_gvs:
+                group = gv.rsplit("/", 1)[0] if "/" in gv else ""
+                if group in groups and gv not in kind_map[kind]:
+                    kind_map[kind].append(gv)
+        # preferred (= first seen from api-resources) stays first; the
+        # backfill is policy-sorted behind it
+        return {k: v[:1] + sort_version_list(v[1:]) for k, v in kind_map.items()}
+
+    # -- driver --------------------------------------------------------------
+
+    def collect_spec(self) -> collecttypes.ClusterMetadataSpec | None:
+        kind_map = self.collect_using_api()
+        if kind_map is None:
+            log.info("discovery API unavailable; trying kubectl api-resources")
+            kind_map = self.collect_using_cli()
+        if kind_map is None:
+            return None
+        spec = collecttypes.ClusterMetadataSpec(api_kind_version_map=kind_map)
+        sc_out = self._run("get", "storageclass", "-o", "name")
         if sc_out:
             spec.storage_classes = [
                 line.split("/", 1)[-1] for line in sc_out.splitlines() if line
             ]
         # net-new: TPU node pools
-        tpu_out = _kubectl(
+        tpu_out = self._run(
             "get", "nodes",
             "-o", r"jsonpath={range .items[*]}{.metadata.labels.cloud\.google\.com/gke-tpu-accelerator}{'\n'}{end}",
         )
         if tpu_out:
             spec.tpu_accelerators = sorted({l for l in tpu_out.splitlines() if l})
-        ctx = _kubectl("config", "current-context") or "cluster"
+        return spec
+
+    def collect(self, source_dir: str, out_dir: str) -> None:
+        spec = self.collect_spec()
+        if spec is None:
+            log.info("kubectl unavailable; skipping cluster collection")
+            return
+        ctx = self._run("config", "current-context") or "cluster"
         name = common.make_dns_label(ctx.strip())
         cm = collecttypes.ClusterMetadata(name=name, spec=spec)
         path = os.path.join(out_dir, "clusters", name + ".yaml")

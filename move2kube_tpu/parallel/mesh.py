@@ -104,25 +104,30 @@ def initialize_distributed() -> None:
     """Multi-host bootstrap from JobSet/indexed-Job env.
 
     The TPU apiresources inject:
-      M2KT_COORDINATOR   - headless-service DNS of pod 0 (host:port)
-      M2KT_NUM_HOSTS     - total host count
-      JOB_COMPLETION_INDEX - this host's index (k8s indexed jobs)
+      M2KT_COORDINATOR   - headless-service DNS of slice-0 pod 0 (host:port)
+      M2KT_NUM_HOSTS     - host count per slice
+      M2KT_NUM_SLICES / M2KT_SLICE_ID - multi-slice (DCN) coordinates;
+        megascale DCN transport is configured separately via the
+        MEGASCALE_* env the JobSet carries
+      JOB_COMPLETION_INDEX - this host's index within its slice
     On GKE TPU node pools jax.distributed can also self-discover; explicit
     env wins so the same image runs under any indexed-job controller.
     """
     import jax
 
     num_hosts = int(os.environ.get("M2KT_NUM_HOSTS", "1"))
-    if num_hosts <= 1:
+    num_slices = int(os.environ.get("M2KT_NUM_SLICES", "1"))
+    if num_hosts * num_slices <= 1:
         return
     coordinator = os.environ.get("M2KT_COORDINATOR", "")
     index = int(os.environ.get("JOB_COMPLETION_INDEX",
                                os.environ.get("M2KT_HOST_INDEX", "0")))
+    slice_id = int(os.environ.get("M2KT_SLICE_ID", "0") or 0)
     if coordinator:
         jax.distributed.initialize(
             coordinator_address=coordinator,
-            num_processes=num_hosts,
-            process_id=index,
+            num_processes=num_hosts * num_slices,
+            process_id=slice_id * num_hosts + index,
         )
     else:
         jax.distributed.initialize()

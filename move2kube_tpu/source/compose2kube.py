@@ -514,8 +514,8 @@ class ComposeTranslator(Translator):
         # net-new: GPU service -> TPU accelerator info (BASELINE config 4)
         gpu_count = _gpu_info_from_service(svc_def)
         if gpu_count:
-            gpu_count = min(gpu_count, 256)
-            acc_type, topology, hosts = gpu_detect.map_gpu_to_tpu(gpu_count)
+            acc_type, topology, hosts, num_slices = (
+                gpu_detect.map_gpu_to_tpu_multislice(gpu_count))
             from move2kube_tpu.types.plan import AcceleratorInfo
 
             svc.accelerator = AcceleratorInfo(
@@ -525,6 +525,7 @@ class ComposeTranslator(Translator):
                 tpu_accelerator=acc_type,
                 tpu_topology=topology,
                 num_hosts=hosts,
+                num_slices=num_slices,
             )
             # GPU compose services become TPU pod-slice workloads (JobSet)
             svc.job = True

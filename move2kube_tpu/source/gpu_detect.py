@@ -336,10 +336,34 @@ def map_gpu_to_tpu(gpu_count: int, zero_stage: int = 0) -> tuple[str, str, int]:
     return (_V5P, "4x8x8", 64)
 
 
+MAX_SLICE_CHIPS = 256  # largest single-slice topology in the table
+MAX_SLICES = 8
+
+
+def map_gpu_to_tpu_multislice(
+    gpu_count: int, zero_stage: int = 0,
+) -> tuple[str, str, int, int]:
+    """-> (accelerator, per-slice topology, hosts per slice, num_slices).
+
+    Workloads beyond the largest single slice span multiple
+    DCN-connected slices (SURVEY §5: megascale/DCN emission obligation):
+    data parallelism rides DCN between slices, everything else stays on
+    ICI within a slice.
+    """
+    gpu_count = max(1, gpu_count)
+    if gpu_count <= MAX_SLICE_CHIPS:
+        acc, topo, hosts = map_gpu_to_tpu(gpu_count, zero_stage)
+        return acc, topo, hosts, 1
+    num_slices = min(-(-gpu_count // MAX_SLICE_CHIPS), MAX_SLICES)
+    acc, topo, hosts = map_gpu_to_tpu(MAX_SLICE_CHIPS, zero_stage)
+    return acc, topo, hosts, num_slices
+
+
 def report_to_accelerator(report: GpuReport, gpu_count: int = 0) -> AcceleratorInfo:
     """Convert an analysis report into plan AcceleratorInfo."""
     count = gpu_count or report.world_size_hint or 1
-    acc_type, topology, hosts = map_gpu_to_tpu(count, report.zero_stage)
+    acc_type, topology, hosts, num_slices = map_gpu_to_tpu_multislice(
+        count, report.zero_stage)
     parallelism: dict[str, int] = {}
     if report.zero_stage:
         parallelism["zero_stage"] = report.zero_stage
@@ -364,6 +388,7 @@ def report_to_accelerator(report: GpuReport, gpu_count: int = 0) -> AcceleratorI
         tpu_accelerator=acc_type,
         tpu_topology=topology,
         num_hosts=hosts,
+        num_slices=num_slices,
     )
 
 

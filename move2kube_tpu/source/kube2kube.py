@@ -99,7 +99,8 @@ def tpu_service_from_gpu_workload(obj: dict) -> irtypes.Service | None:
     template = _pod_template(obj)
     pod = template.get("spec", {}) or {}
     containers = pod.get("containers") or []
-    acc_type, topology, hosts = gpu_detect.map_gpu_to_tpu(total_gpus)
+    acc_type, topology, hosts, num_slices = (
+        gpu_detect.map_gpu_to_tpu_multislice(total_gpus))
 
     name = common.make_dns_label(
         obj.get("metadata", {}).get("name") or "gpu-workload")
@@ -129,11 +130,12 @@ def tpu_service_from_gpu_workload(obj: dict) -> irtypes.Service | None:
         tpu_accelerator=acc_type,
         tpu_topology=topology,
         num_hosts=hosts,
+        num_slices=num_slices,
     )
     svc.job = True
     svc.restart_policy = "Never"
-    log.info("k8s %s %s requests %d GPU(s) -> TPU %s %s (%d host(s))",
-             obj.get("kind"), name, total_gpus, acc_type, topology, hosts)
+    log.info("k8s %s %s requests %d GPU(s) -> TPU %s %s x%d slice(s)",
+             obj.get("kind"), name, total_gpus, acc_type, topology, num_slices)
     return svc
 
 

@@ -84,8 +84,9 @@ class AcceleratorInfo:
     model_family: str = ""  # resnet | bert | llama | generic
     entrypoint: str = ""  # detected training script, abs path in memory
     tpu_accelerator: str = ""  # e.g. tpu-v5-lite-podslice
-    tpu_topology: str = ""  # e.g. 2x4
-    num_hosts: int = 1
+    tpu_topology: str = ""  # e.g. 2x4 (per slice)
+    num_hosts: int = 1  # hosts per slice
+    num_slices: int = 1  # >1 = multi-slice (DCN-connected pod slices)
 
     _CAMEL = {
         "gpu_count": "gpuCount",
@@ -98,6 +99,7 @@ class AcceleratorInfo:
         "tpu_accelerator": "tpuAccelerator",
         "tpu_topology": "tpuTopology",
         "num_hosts": "numHosts",
+        "num_slices": "numSlices",
     }
 
     def to_dict(self) -> dict:

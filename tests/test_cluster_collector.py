@@ -1,0 +1,163 @@
+"""Cluster collector against recorded kubectl fixtures — the layer the
+reference leaves untested (clustercollector.go has no tests; SURVEY §4).
+Covers the discovery-API path (kubectl get --raw), the CLI fallback, and
+the full collect() -> ClusterMetadata yaml round trip."""
+
+import json
+
+import yaml
+
+from move2kube_tpu.collector.cluster import ClusterCollector
+from move2kube_tpu.types import collection as collecttypes
+
+APIS = {
+    "groups": [
+        {
+            "name": "apps",
+            "preferredVersion": {"groupVersion": "apps/v1"},
+            "versions": [
+                {"groupVersion": "apps/v1"},
+                {"groupVersion": "apps/v1beta2"},
+                {"groupVersion": "apps/v1beta1"},
+            ],
+        },
+        {
+            "name": "networking.k8s.io",
+            "preferredVersion": {"groupVersion": "networking.k8s.io/v1"},
+            "versions": [
+                {"groupVersion": "networking.k8s.io/v1"},
+                {"groupVersion": "networking.k8s.io/v1beta1"},
+            ],
+        },
+        {
+            "name": "extensions",
+            "preferredVersion": {"groupVersion": "extensions/v1beta1"},
+            "versions": [{"groupVersion": "extensions/v1beta1"}],
+        },
+        {
+            "name": "jobset.x-k8s.io",
+            "preferredVersion": {"groupVersion": "jobset.x-k8s.io/v1alpha2"},
+            "versions": [{"groupVersion": "jobset.x-k8s.io/v1alpha2"}],
+        },
+    ]
+}
+
+RESOURCES = {
+    "/api/v1": ["Pod", "Service", "ConfigMap", "Secret",
+                "PersistentVolumeClaim", "ReplicationController"],
+    "/apis/apps/v1": ["Deployment", "DaemonSet", "StatefulSet", "ReplicaSet"],
+    "/apis/apps/v1beta2": ["Deployment", "DaemonSet"],
+    "/apis/apps/v1beta1": ["Deployment"],
+    "/apis/networking.k8s.io/v1": ["Ingress", "NetworkPolicy"],
+    "/apis/networking.k8s.io/v1beta1": ["Ingress"],
+    "/apis/extensions/v1beta1": ["Ingress", "Deployment"],
+    "/apis/jobset.x-k8s.io/v1alpha2": ["JobSet"],
+}
+
+
+def fake_discovery_runner(*args):
+    if args[:2] == ("get", "--raw"):
+        path = args[2]
+        if path == "/apis":
+            return json.dumps(APIS)
+        if path == "/api":
+            return json.dumps({"versions": ["v1"]})
+        if path in RESOURCES:
+            return json.dumps({"resources": [
+                {"name": k.lower() + "s", "kind": k} for k in RESOURCES[path]
+            ] + [{"name": "deployments/scale", "kind": "Scale"}]})
+        return None
+    if args == ("get", "storageclass", "-o", "name"):
+        return "storageclass.storage.k8s.io/standard\nstorageclass.storage.k8s.io/premium-rwo\n"
+    if args[0] == "get" and args[1] == "nodes":
+        return "tpu-v5-lite-podslice\n\ntpu-v5-lite-podslice\n"
+    if args == ("config", "current-context"):
+        return "gke_proj_us-central1_tpu-cluster\n"
+    return None
+
+
+def test_discovery_api_full_version_lists():
+    c = ClusterCollector(runner=fake_discovery_runner)
+    kind_map = c.collect_using_api()
+    # full per-kind version lists, not just the preferred one
+    assert kind_map["Deployment"] == [
+        "apps/v1", "apps/v1beta2", "apps/v1beta1", "extensions/v1beta1"]
+    assert kind_map["Ingress"] == [
+        "networking.k8s.io/v1", "networking.k8s.io/v1beta1",
+        "extensions/v1beta1"]
+    assert kind_map["JobSet"] == ["jobset.x-k8s.io/v1alpha2"]
+    assert kind_map["Pod"] == ["v1"]
+    assert "Scale" not in kind_map  # subresources skipped
+
+
+def test_discovery_preferred_version_first():
+    # flip the preferred version: the server prefers apps/v1beta2
+    apis = json.loads(json.dumps(APIS))
+    apis["groups"][0]["preferredVersion"] = {"groupVersion": "apps/v1beta2"}
+
+    def runner(*args):
+        if args[:2] == ("get", "--raw") and args[2] == "/apis":
+            return json.dumps(apis)
+        return fake_discovery_runner(*args)
+
+    kind_map = ClusterCollector(runner=runner).collect_using_api()
+    assert kind_map["Deployment"][0] == "apps/v1beta2"
+
+
+def test_cli_fallback_backfills_group_versions():
+    def runner(*args):
+        if args[:2] == ("get", "--raw"):
+            return None  # discovery blocked (RBAC)
+        if args == ("api-resources", "--no-headers"):
+            return (
+                "deployments  deploy  apps/v1  true  Deployment\n"
+                "ingresses  ing  networking.k8s.io/v1  true  Ingress\n"
+                "pods  po  v1  true  Pod\n"
+                "malformed line without namespaced\n"
+            )
+        if args == ("api-versions",):
+            return "apps/v1\napps/v1beta1\nnetworking.k8s.io/v1\nnetworking.k8s.io/v1beta1\nv1\n"
+        return None
+
+    c = ClusterCollector(runner=runner)
+    assert c.collect_using_api() is None
+    kind_map = c.collect_using_cli()
+    # preferred (from api-resources) first, rest of the group backfilled
+    assert kind_map["Deployment"] == ["apps/v1", "apps/v1beta1"]
+    assert kind_map["Ingress"] == ["networking.k8s.io/v1",
+                                   "networking.k8s.io/v1beta1"]
+    assert kind_map["Pod"] == ["v1"]
+
+
+def test_cli_fallback_no_shortnames_column():
+    def runner(*args):
+        if args[:2] == ("get", "--raw"):
+            return None
+        if args == ("api-resources", "--no-headers"):
+            # some kinds print no SHORTNAMES column
+            return "bindings   v1  true  Binding\n"
+        return None
+
+    kind_map = ClusterCollector(runner=runner).collect_using_cli()
+    assert kind_map == {"Binding": ["v1"]}
+
+
+def test_collect_writes_cluster_metadata(tmp_path):
+    ClusterCollector(runner=fake_discovery_runner).collect(
+        str(tmp_path), str(tmp_path / "m2kt_collect"))
+    out = tmp_path / "m2kt_collect" / "clusters"
+    files = list(out.glob("*.yaml"))
+    assert len(files) == 1
+    doc = yaml.safe_load(files[0].read_text())
+    cm = collecttypes.ClusterMetadata.from_dict(doc)
+    assert cm.spec.supports_kind("JobSet")
+    assert cm.spec.supports_tpu()
+    assert cm.spec.tpu_accelerators == ["tpu-v5-lite-podslice"]
+    assert cm.spec.storage_classes == ["standard", "premium-rwo"]
+    assert cm.spec.get_supported_versions("Deployment")[0] == "apps/v1"
+
+
+def test_collect_skips_when_kubectl_unavailable(tmp_path):
+    ClusterCollector(runner=lambda *a: None).collect(
+        str(tmp_path), str(tmp_path / "m2kt_collect"))
+    assert not (tmp_path / "m2kt_collect").exists()
