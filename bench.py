@@ -1,26 +1,43 @@
 #!/usr/bin/env python
 """Benchmark: translated-workload training throughput on the attached TPU.
 
-Default mode is BASELINE config 2 ("PyTorch ResNet-50 CUDA train.py ->
-jax-xla containerizer, single v5e chip"); ``--model bert`` measures
-BASELINE config 3 (HF BERT fine-tune, samples/s). Both drive the same
-model-zoo code the containerizer vendors into emitted images — i.e. they
-measure what a translated workload actually achieves.
+Measures BASELINE config 2 (PyTorch ResNet-50 CUDA train.py -> jax-xla
+containerizer, single v5e chip, img/s) as the primary metric and BASELINE
+config 3 (HF BERT fine-tune, samples/s) plus a Pallas flash-attention
+numeric check in the ``extra`` field — all from ONE plain ``python
+bench.py`` invocation. Both model phases drive the same model-zoo code the
+containerizer vendors into emitted images, i.e. they measure what a
+translated workload actually achieves.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints exactly ONE JSON line on stdout:
+  {"metric", "value", "unit", "vs_baseline", "extra": {...}}
+and NEVER exits non-zero for backend trouble: on total failure the line
+carries value 0 and ``extra.status`` explaining why (rounds 1 and 2 both
+died rc=1 with no artifact; this harness treats every phase as retryable).
+
+Architecture: the parent process (this file, no args) NEVER imports jax.
+It spawns a child (``--child phase,phase``) that does backend init,
+compile and the timed loop, and prints one ``RESULT {json}`` line per
+completed phase (flushed immediately). The tunneled TPU plugin has two
+failure modes — fast RuntimeError(UNAVAILABLE) and a plain hang inside
+make_c_api_client — and a hung C call cannot be interrupted in-process,
+so the parent enforces a timeout per child, harvests whatever RESULT
+lines arrived, and retries only the missing phases until a wall-clock
+deadline (default 440s, driver kills around 560s).
 
 The reference (Move2Kube) publishes no performance numbers (BASELINE.md),
 so ``vs_baseline`` is anchored to an external roofline-derived number for
 a well-tuned single-chip JAX run rather than to this program's own first
-run (which made vs_baseline circular in round 1): TPU v5e peak is 197
-bf16 TFLOP/s, and well-tuned models on TPU sustain ~30% MFU. ResNet-50 @
-224x224 is ~12.3 GFLOP/img fwd+bwd (3x the 4.1 GFLOP forward) => anchor
-4805 img/s. BERT-base @ seq 128 is ~6*110e6*128 = 84.5 GFLOP/sample =>
-anchor 700 samples/s. See BENCH_NOTES.md.
+run: TPU v5e peak is 197 bf16 TFLOP/s and well-tuned models sustain ~30%
+MFU. ResNet-50 @ 224x224 is ~12.3 GFLOP/img fwd+bwd => anchor 4805 img/s.
+BERT-base @ seq 128 is ~84.5 GFLOP/sample => anchor 700 samples/s. See
+BENCH_NOTES.md.
 """
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -31,52 +48,37 @@ RESNET50_FLOPS_PER_IMG = 12.3e9  # fwd+bwd at 224x224 (3x fwd of 4.1 GFLOP)
 BERT_SEQ = 128
 BERT_FLOPS_PER_SAMPLE = 6 * 110e6 * BERT_SEQ  # 6*N*T rule, bert-base N=110M
 
-RESNET_BATCH, RESNET_IMAGE = 256, 224
-BERT_BATCH = 128
+RESNET_BATCH = int(os.environ.get("M2KT_BENCH_RESNET_BATCH", "256"))
+RESNET_IMAGE = int(os.environ.get("M2KT_BENCH_RESNET_IMAGE", "224"))
+BERT_BATCH = int(os.environ.get("M2KT_BENCH_BERT_BATCH", "128"))
 
-SCAN_STEPS = 10          # optimizer steps fused into one device call
+# optimizer steps fused into one device call (lax.scan)
+SCAN_STEPS = int(os.environ.get("M2KT_BENCH_SCAN_STEPS", "10"))
 WARMUP_CALLS = 1
-MEASURE_CALLS = 2        # 2 x 10 = 20 measured steps
+MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "2"))
 
-INIT_RETRIES = 4
-INIT_BACKOFF_S = 20.0
-INIT_PROBE_TIMEOUT_S = 150.0  # first TPU contact can take tens of seconds
+PHASES = ("resnet", "bert", "pallas")
+# single source of truth for each phase's reported metric name + unit,
+# shared by the measurement functions and the parent's failure fallback
+PHASE_METRICS = {
+    "resnet": ("resnet50_train_throughput_v5e1", "img/s"),
+    "bert": ("bert_finetune_throughput_v5e1", "samples/s"),
+    "pallas": ("pallas_flash_attention_tflops_v5e1", "TFLOP/s"),
+}
+BUDGET_S = float(os.environ.get("M2KT_BENCH_BUDGET_S", "440"))
+CHILD_TIMEOUT_S = float(os.environ.get("M2KT_BENCH_CHILD_TIMEOUT_S", "240"))
+RETRY_BACKOFF_S = 15.0
 
-
-def _probe_backend_subprocess() -> None:
-    """Touch the backend in a throwaway subprocess first.
-
-    The tunneled TPU plugin has two failure modes (both hit round 1's
-    official artifacts): a fast RuntimeError(UNAVAILABLE), and a plain
-    HANG inside make_c_api_client. A hung C call can't be interrupted
-    in-process, so each retry probes via subprocess with a timeout; only
-    after a probe succeeds do we initialize in-process (which then hits a
-    warmed-up tunnel)."""
-    import subprocess
-
-    subprocess.run(
-        [sys.executable, "-c", "import jax; print(jax.device_count())"],
-        check=True, capture_output=True, timeout=INIT_PROBE_TIMEOUT_S)
+RESNET_ANCHOR = V5E_PEAK_BF16_FLOPS * ANCHOR_MFU / RESNET50_FLOPS_PER_IMG
+BERT_ANCHOR = V5E_PEAK_BF16_FLOPS * ANCHOR_MFU / BERT_FLOPS_PER_SAMPLE
 
 
-def _init_devices():
-    """jax backend init with bounded retries (see _probe_backend_subprocess)."""
-    import subprocess
+# --------------------------------------------------------------------------
+# Child: real measurement. Runs in a subprocess the parent can kill.
+# --------------------------------------------------------------------------
 
-    last: Exception | None = None
-    for attempt in range(INIT_RETRIES):
-        try:
-            _probe_backend_subprocess()
-            import jax
-
-            return jax.device_count()
-        except (RuntimeError, subprocess.SubprocessError) as e:
-            last = e
-            print(f"[bench] backend init failed (attempt {attempt + 1}/"
-                  f"{INIT_RETRIES}): {type(e).__name__}: {e}", file=sys.stderr)
-            time.sleep(INIT_BACKOFF_S * (attempt + 1))
-    raise RuntimeError(f"TPU backend unavailable after {INIT_RETRIES} "
-                       f"attempts: {last}")
+def _emit(result: dict) -> None:
+    print("RESULT " + json.dumps(result), flush=True)
 
 
 def _measure(step, state, batches, items_per_step: int):
@@ -129,12 +131,14 @@ def bench_resnet(n: int) -> dict:
     img_s, loss = _measure(step, state, batches, batch)
     mfu = img_s * RESNET50_FLOPS_PER_IMG / V5E_PEAK_BF16_FLOPS
     print(f"[bench] resnet loss={loss:.3f} mfu={mfu:.1%}", file=sys.stderr)
-    anchor = V5E_PEAK_BF16_FLOPS * ANCHOR_MFU / RESNET50_FLOPS_PER_IMG
+    metric, unit = PHASE_METRICS["resnet"]
     return {
-        "metric": "resnet50_train_throughput_v5e1",
+        "phase": "resnet",
+        "metric": metric,
         "value": round(img_s, 1),
-        "unit": "img/s",
-        "vs_baseline": round(img_s / anchor, 3),
+        "unit": unit,
+        "mfu": round(mfu, 4),
+        "vs_baseline": round(img_s / RESNET_ANCHOR, 3),
     }
 
 
@@ -167,23 +171,202 @@ def bench_bert(n: int) -> dict:
     samples_s, loss = _measure(step, state, batches, batch)
     mfu = samples_s * BERT_FLOPS_PER_SAMPLE / V5E_PEAK_BF16_FLOPS
     print(f"[bench] bert loss={loss:.3f} mfu={mfu:.1%}", file=sys.stderr)
-    anchor = V5E_PEAK_BF16_FLOPS * ANCHOR_MFU / BERT_FLOPS_PER_SAMPLE
+    metric, unit = PHASE_METRICS["bert"]
     return {
-        "metric": "bert_finetune_throughput_v5e1",
+        "phase": "bert",
+        "metric": metric,
         "value": round(samples_s, 1),
-        "unit": "samples/s",
-        "vs_baseline": round(samples_s / anchor, 3),
+        "unit": unit,
+        "mfu": round(mfu, 4),
+        "vs_baseline": round(samples_s / BERT_ANCHOR, 3),
     }
+
+
+def bench_pallas(n: int) -> dict:
+    """Prove the Pallas flash-attention kernel on silicon: run the TPU
+    kernel directly (no fallback), compare against the jnp reference, and
+    report achieved TFLOP/s."""
+    import jax
+    import jax.numpy as jnp
+
+    from move2kube_tpu.ops.attention import (
+        _flash_attention_tpu, _reference_attention)
+
+    metric, unit = PHASE_METRICS["pallas"]
+    if jax.default_backend() != "tpu":
+        return {"phase": "pallas", "metric": metric, "value": 0,
+                "unit": unit, "vs_baseline": 0.0,
+                "status": "skipped_not_tpu", "backend": jax.default_backend()}
+
+    b, s, h, d = 4, 1024, 8, 64
+    scale = d ** -0.5
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+               for key in keys)
+    kernel = jax.jit(lambda q, k, v: _flash_attention_tpu(q, k, v, True, scale))
+    ref = jax.jit(lambda q, k, v: _reference_attention(q, k, v, True, scale))
+    out = kernel(q, k, v)
+    expect = ref(q, k, v)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - expect.astype(jnp.float32))))
+    # bf16 inputs, f32 accumulation: online-softmax reassociation keeps the
+    # error at the bf16 resolution of the output (~1/128 of max |o|<=~1).
+    # `not (err <= tol)` so NaN fails instead of slipping past `err > tol`
+    tol = 2e-2
+    if not (err <= tol):
+        raise RuntimeError(f"pallas kernel mismatch: max_abs_err={err}")
+    iters = 20
+    float(jnp.sum(kernel(q, k, v)))  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = kernel(q, k, v)
+    float(jnp.sum(out))
+    dt = time.perf_counter() - t0
+    # causal fwd flops: 2 matmuls * 2 flops/MAC * b*h*s*s*d, halved by mask
+    flops = 2 * 2 * b * h * s * s * d / 2
+    tflops = flops * iters / dt / 1e12
+    print(f"[bench] pallas max_abs_err={err:.4f} {tflops:.1f} TFLOP/s",
+          file=sys.stderr)
+    return {"phase": "pallas", "metric": metric, "value": round(tflops, 2),
+            "unit": unit,
+            "vs_baseline": round(tflops * 1e12 / (V5E_PEAK_BF16_FLOPS
+                                                  * ANCHOR_MFU), 3),
+            "pallas_ok": True, "max_abs_err": round(err, 5)}
+
+
+def run_child(phases: list[str]) -> int:
+    """Measure the requested phases, emitting one RESULT line per success.
+
+    Exit code is advisory (parent trusts RESULT lines, not rc): 0 iff all
+    requested phases succeeded."""
+    try:
+        import jax
+
+        n = jax.device_count()
+        print(f"[bench] backend={jax.default_backend()} devices={n}",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - report init failure and bail
+        print(f"[bench] backend init failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    fns = {"resnet": bench_resnet, "bert": bench_bert, "pallas": bench_pallas}
+    ok = True
+    for phase in phases:
+        try:
+            _emit(fns[phase](n))
+        except Exception as e:  # noqa: BLE001 - next phase may still work
+            ok = False
+            print("PHASEFAIL " + json.dumps(
+                {"phase": phase,
+                 "error": f"{type(e).__name__}: {e}"[:300]}), flush=True)
+            print(f"[bench] phase {phase} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return 0 if ok else 1
+
+
+# --------------------------------------------------------------------------
+# Parent: orchestration. No jax import anywhere on this path.
+# --------------------------------------------------------------------------
+
+MAX_PHASE_FAILS = 2  # in-child exceptions per phase before giving up on it
+
+
+def _harvest(text: str, results: dict, fails: dict) -> None:
+    for line in text.splitlines():
+        if line.startswith("RESULT "):
+            try:
+                r = json.loads(line[len("RESULT "):])
+                results[r["phase"]] = r
+            except (json.JSONDecodeError, KeyError):
+                pass
+        elif line.startswith("PHASEFAIL "):
+            try:
+                f = json.loads(line[len("PHASEFAIL "):])
+                fails.setdefault(f["phase"], []).append(f.get("error", ""))
+            except (json.JSONDecodeError, KeyError):
+                pass
+
+
+def _spawn(phases: list[str], timeout: float, results: dict, fails: dict,
+           errors: list) -> None:
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", ",".join(phases)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+        out, err, what = proc.stdout, proc.stderr, f"rc={proc.returncode}"
+    except subprocess.TimeoutExpired as e:
+        def _s(b):
+            return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
+        out, err, what = _s(e.stdout), _s(e.stderr), f"timeout={timeout:.0f}s"
+    _harvest(out, results, fails)
+    errors.append(what)
+    tail = err.strip().splitlines()[-6:]
+    for line in tail:
+        print(f"[bench-child] {line}", file=sys.stderr)
+    print(f"[bench] child {what}: have {sorted(results)}", file=sys.stderr)
+
+
+def run_parent(requested: list[str]) -> int:
+    t_start = time.perf_counter()
+    deadline = t_start + BUDGET_S
+    results: dict = {}
+    fails: dict = {}    # phase -> list of in-child error strings
+    errors: list = []   # per-child-attempt outcome (rc / timeout)
+    attempt = 0
+    while True:
+        # a phase that raised inside a *live* child MAX_PHASE_FAILS times
+        # is deterministic (fixed seeds) — drop it; keep retrying phases
+        # that never ran (hang / init failure produce no PHASEFAIL line)
+        missing = [p for p in requested if p not in results
+                   and len(fails.get(p, ())) < MAX_PHASE_FAILS]
+        if not missing:
+            break
+        remaining = deadline - time.perf_counter()
+        if remaining < 30:
+            print(f"[bench] budget exhausted with {missing} missing",
+                  file=sys.stderr)
+            break
+        if attempt:
+            time.sleep(min(RETRY_BACKOFF_S, max(0.0, remaining - 30)))
+        attempt += 1
+        print(f"[bench] attempt {attempt}: phases={missing} "
+              f"remaining={remaining:.0f}s", file=sys.stderr)
+        _spawn(missing, min(CHILD_TIMEOUT_S, remaining - 10), results, fails,
+               errors)
+
+    primary_phase = requested[0]
+    extra = {k: v for k, v in results.items() if k != primary_phase}
+    for phase, errs in fails.items():
+        if phase not in results:
+            extra[phase] = {"status": "failed", "error": errs[-1]}
+    extra["attempts"] = attempt
+    extra["wall_s"] = round(time.perf_counter() - t_start, 1)
+    if primary_phase in results:
+        primary = dict(results[primary_phase])
+        primary.pop("phase", None)
+    else:
+        extra["status"] = ("phase_failed" if primary_phase in fails
+                           else "backend_unavailable")
+        extra["attempt_log"] = errors[-4:]
+        metric, unit = PHASE_METRICS[primary_phase]
+        primary = {"metric": metric, "value": 0, "unit": unit,
+                   "vs_baseline": 0.0}
+    primary["extra"] = extra
+    print(json.dumps(primary))
+    return 0
 
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--model", choices=("resnet", "bert"), default="resnet")
+    parser.add_argument("--child", default=None,
+                        help="comma-separated phases to measure in-process")
+    parser.add_argument("--model", choices=PHASES, default=None,
+                        help="restrict the parent to one phase")
     args = parser.parse_args()
-    n = _init_devices()
-    result = bench_resnet(n) if args.model == "resnet" else bench_bert(n)
-    print(json.dumps(result))
-    return 0
+    if args.child:
+        return run_child(args.child.split(","))
+    requested = list(PHASES) if args.model is None else [args.model]
+    return run_parent(requested)
 
 
 if __name__ == "__main__":

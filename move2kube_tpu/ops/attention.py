@@ -14,6 +14,7 @@ mathematically identical jnp implementation.
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -125,6 +126,8 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None
     if on_tpu and s % 128 == 0 and k.shape[1] % 128 == 0 and d % 64 == 0:
         try:
             return _flash_attention_tpu(q, k, v, causal, scale)
-        except Exception:  # noqa: BLE001 - fall back rather than fail
-            pass
+        except Exception as e:  # noqa: BLE001 - fall back rather than fail
+            logging.getLogger(__name__).warning(
+                "pallas flash attention failed (%s: %s); falling back to "
+                "jnp reference attention", type(e).__name__, e)
     return _reference_attention(q, k, v, causal, scale)

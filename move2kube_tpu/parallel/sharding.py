@@ -109,7 +109,8 @@ def infer_param_axes(params, tp_layers: tuple[str, ...] = ()):
     Works for the model zoo's conventions:
     - 2D kernels: last dim is the output feature; shard it over fsdp unless
       the param path names a TP-split layer (gate/up/query/... -> mlp/heads)
-    - embeddings: (vocab, embed)
+    - embeddings: (vocab, None) — vocab-parallel only; feature dim
+      replicated (see inline comment)
     - biases/norm scales: replicated
     """
 
@@ -122,7 +123,13 @@ def infer_param_axes(params, tp_layers: tuple[str, ...] = ()):
         if nd <= 1:
             return (None,) * nd
         if "embedding" in joined:
-            return ("vocab", "embed") if nd == 2 else (None,) * nd
+            # vocab-dim sharding only: sharding the feature dim too would
+            # force the backward scatter-add cotangent ([batch, len, embed],
+            # batch-sharded) into a feature-sharded layout — GSPMD can only
+            # do that reshard by full rematerialization (seen in the r2
+            # multichip dryrun); vocab-parallel alone partitions the scatter
+            # by masking with no activation reshard
+            return ("vocab", None) if nd == 2 else (None,) * nd
         if nd == 2:
             if any(t in joined for t in tp_layers) or any(
                 t in joined for t in ("gate", "up_proj", "wi", "query", "key",

@@ -1,0 +1,118 @@
+"""Parent-side bench harness tests (no jax, no subprocess).
+
+The child side (actual measurement) is exercised on hardware by the
+driver; here we pin down the orchestration contract the verdicts demanded:
+always exactly one parseable JSON line, partial results survive child
+death, deterministic phase failures don't burn the retry budget.
+"""
+
+import json
+
+import bench
+
+
+class FakeTime:
+    """Virtual clock so the retry loop's wall-clock budget runs instantly."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def perf_counter(self):
+        return self.now
+
+    def sleep(self, s):
+        self.now += s
+
+
+def run_parent_with(monkeypatch, capsys, script, requested=("resnet", "bert", "pallas")):
+    """Run bench.run_parent with _spawn replaced by a scripted fake.
+
+    ``script`` is a list of child-stdout strings, one per expected spawn;
+    extra spawns get empty output (simulated hang/crash). Each fake spawn
+    advances the virtual clock by 100s, so a hang-forever scenario exhausts
+    the 350s budget after a handful of attempts instead of spinning.
+    """
+    clock = FakeTime()
+    calls = []
+
+    def fake_spawn(phases, timeout, results, fails, errors):
+        idx = len(calls)
+        calls.append(list(phases))
+        clock.sleep(100.0)
+        out = script[idx] if idx < len(script) else ""
+        bench._harvest(out, results, fails)
+        errors.append("rc=0" if idx < len(script) else "timeout")
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    monkeypatch.setattr(bench, "time", clock)
+    monkeypatch.setattr(bench, "RETRY_BACKOFF_S", 15.0)
+    monkeypatch.setattr(bench, "BUDGET_S", 350.0)
+    rc = bench.run_parent(list(requested))
+    line = capsys.readouterr().out.strip()
+    return rc, json.loads(line), calls
+
+
+def _result(phase, value=100.0):
+    return "RESULT " + json.dumps({
+        "phase": phase, "metric": f"{phase}_metric", "value": value,
+        "unit": "u", "vs_baseline": 0.5})
+
+
+def _fail(phase, error="RuntimeError: boom"):
+    return "PHASEFAIL " + json.dumps({"phase": phase, "error": error})
+
+
+def test_all_phases_one_attempt(monkeypatch, capsys):
+    script = ["\n".join([_result("resnet"), _result("bert"), _result("pallas")])]
+    rc, out, calls = run_parent_with(monkeypatch, capsys, script)
+    assert rc == 0
+    assert out["metric"] == "resnet_metric" and out["value"] == 100.0
+    assert out["extra"]["bert"]["value"] == 100.0
+    assert out["extra"]["pallas"]["phase"] == "pallas"
+    assert calls == [["resnet", "bert", "pallas"]]
+
+
+def test_partial_results_survive_and_retry_only_missing(monkeypatch, capsys):
+    script = [_result("resnet"),                      # child died after resnet
+              "\n".join([_result("bert"), _result("pallas")])]
+    rc, out, calls = run_parent_with(monkeypatch, capsys, script)
+    assert out["metric"] == "resnet_metric"
+    assert calls == [["resnet", "bert", "pallas"], ["bert", "pallas"]]
+    assert out["extra"]["attempts"] == 2
+
+
+def test_deterministic_phase_failure_stops_after_two_strikes(monkeypatch, capsys):
+    script = ["\n".join([_result("resnet"), _result("bert"), _fail("pallas")]),
+              _fail("pallas"),
+              _fail("pallas")]  # must never be requested a third time
+    rc, out, calls = run_parent_with(monkeypatch, capsys, script)
+    assert out["metric"] == "resnet_metric"
+    assert calls == [["resnet", "bert", "pallas"], ["pallas"]]
+    assert out["extra"]["pallas"]["status"] == "failed"
+    assert "boom" in out["extra"]["pallas"]["error"]
+
+
+def test_total_failure_still_emits_parseable_json(monkeypatch, capsys):
+    rc, out, calls = run_parent_with(monkeypatch, capsys, script=[])
+    assert rc == 0
+    assert out["metric"] == "resnet50_train_throughput_v5e1"
+    assert out["value"] == 0 and out["vs_baseline"] == 0.0
+    assert out["extra"]["status"] == "backend_unavailable"
+    # 350s budget / (100s spawn + 15s backoff) -> exactly 3 hang attempts
+    assert len(calls) == 3
+
+
+def test_single_phase_request_keeps_its_own_metric(monkeypatch, capsys):
+    script = [_result("bert", 250.0)]
+    rc, out, calls = run_parent_with(monkeypatch, capsys, script,
+                                     requested=("bert",))
+    assert out["metric"] == "bert_metric" and out["value"] == 250.0
+    assert "resnet" not in out["extra"]
+
+
+def test_primary_phase_failure_reports_phase_failed(monkeypatch, capsys):
+    script = [_fail("resnet"), _fail("resnet")]
+    rc, out, calls = run_parent_with(monkeypatch, capsys, script,
+                                     requested=("resnet",))
+    assert out["value"] == 0
+    assert out["extra"]["status"] == "phase_failed"
