@@ -68,18 +68,31 @@ def emit_container(service: PlanService, plan=None) -> Container:
     # settings would shape a mesh the trainer can't use
     moe_experts = (acc.parallelism.get("experts", 0)
                    if family in ("llama", "gpt") else 0)
-    # Detected GPU pipeline parallelism is deliberately NOT given a mesh
-    # axis: on a TPU slice the ICI makes FSDP strictly better than a GPipe
-    # bubble for the sizes pp is used at on GPUs, so the pp degree folds
-    # into the data/fsdp remainder (parallel/pipeline.py stays available
-    # for models too deep to FSDP). A pipe axis the emitted trainer didn't
-    # stage over would just replicate work across pp devices.
+    # Detected GPU pipeline parallelism: when the workload also uses
+    # ZeRO>=2, the pp degree folds into fsdp — on a TPU slice the ICI
+    # makes FSDP strictly better than a GPipe bubble at the sizes pp is
+    # used at on GPUs. WITHOUT ZeRO sharding (classic Megatron/GPipe
+    # decoder runs whose model is too deep to data-shard), the staged
+    # execution is kept: the mesh gets a real pipe axis and the emitted
+    # trainer runs the compiled GPipe schedule (models/llama_pipe.py).
+    pp = acc.parallelism.get("pp", 1)
+    zero = acc.parallelism.get("zero_stage", 0)
+    # pp must divide the device count, or infer_mesh_config would drop the
+    # pipe axis and (with zero<2 passed through) leave a fully replicated
+    # pure-DP trainer for a model the pipe path exists for because it is
+    # too deep to replicate — fold into ZeRO/fsdp instead in that case
+    use_pipe = (family in ("llama", "gpt") and pp > 1 and zero < 2
+                and not moe_experts and max(1, acc.gpu_count) % pp == 0)
+    # On the pipe path detected tp/sp fold into data parallelism: inside
+    # the GPipe shard_map the mesh axes are manual, so block-level TP
+    # would need hand-written collective matmuls rather than GSPMD
+    # annotations; every device still does useful (data-parallel) work.
     mesh = infer_mesh_config(
         max(1, acc.gpu_count),
-        zero_stage=max(acc.parallelism.get("zero_stage", 0),
-                       2 if acc.parallelism.get("pp", 1) > 1 else 0),
-        tensor_parallel=acc.parallelism.get("tp", 1),
-        seq_parallel=acc.parallelism.get("sp", 1),
+        zero_stage=zero if use_pipe else max(zero, 2 if pp > 1 else 0),
+        tensor_parallel=1 if use_pipe else acc.parallelism.get("tp", 1),
+        seq_parallel=1 if use_pipe else acc.parallelism.get("sp", 1),
+        pipeline_parallel=pp if use_pipe else 1,
         expert_parallel=acc.parallelism.get("ep", 1) if moe_experts else 1,
     )
 

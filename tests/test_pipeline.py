@@ -75,3 +75,70 @@ def test_pipeline_rejects_indivisible_batch():
     x = jnp.zeros((6, DIM))
     with pytest.raises(ValueError, match="microbatches"):
         pipeline_sharded(mesh, stage_fn, stacked, x, num_microbatches=4)
+
+
+def test_pipeline_batch_axes_shards_microbatches():
+    """batch_axes composes dp x pp: same numbers, batch sharded over data."""
+    mesh = make_mesh(MeshConfig(data=2, pipe=N_STAGES))
+    per_stage = make_params(jax.random.PRNGKey(0))
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, DIM))
+    out = pipeline_sharded(mesh, stage_fn, stacked, x, num_microbatches=4,
+                           batch_axes=("data", "fsdp"))
+    ref = serial_apply(per_stage, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_batch_axes_rejects_too_small_batch():
+    import pytest
+
+    mesh = make_mesh(MeshConfig(data=4, pipe=2))
+    stacked = stack_stage_params(make_params(jax.random.PRNGKey(0))[:2])
+    x = jnp.zeros((4, DIM))  # 4 microbatches of 1 can't shard over data=4
+    with pytest.raises(ValueError, match="batch axes"):
+        pipeline_sharded(mesh, stage_fn, stacked, x, num_microbatches=4,
+                         batch_axes=("data", "fsdp"))
+
+
+def test_staged_llama_matches_dense_forward():
+    """llama_pipe: the compiled-GPipe staged Llama reproduces the plain
+    Llama forward (f32 to keep rounding-order noise out) and trains."""
+    import dataclasses
+
+    import optax
+
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.models.llama_pipe import (
+        apply_pipeline_lm,
+        create_pipeline_lm_state,
+        make_pipeline_lm_train_step,
+    )
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32)  # 2 layers
+    num_stages, n_micro = 2, 4
+    mesh = make_mesh(MeshConfig(data=4, pipe=num_stages))
+    bsz = 16  # bpd 1 x data 4 x microbatches 4
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 500, (bsz, 32)))
+    state = create_pipeline_lm_state(
+        jax.random.PRNGKey(0), cfg, num_stages,
+        jnp.zeros((bsz, 32), jnp.int32), optax.adamw(1e-3), mesh)
+
+    # stage params shard over pipe; regroup them into the flat layout
+    p = state.params
+    assert "pipe" in str(jax.tree.leaves(p["stages"])[0].sharding.spec)
+    flat = {"embed": p["embed"], "final_norm": p["final_norm"],
+            "lm_head": p["lm_head"]}
+    for s in range(num_stages):
+        flat[f"layer_{s}"] = jax.tree.map(lambda a, s=s: a[s],
+                                          p["stages"]["block_0"])
+
+    logits_pipe = apply_pipeline_lm(cfg, num_stages, mesh, p, ids,
+                                    num_microbatches=n_micro, remat=False)
+    logits_ref = Llama(cfg).apply({"params": flat}, ids)
+    np.testing.assert_allclose(np.asarray(logits_pipe),
+                               np.asarray(logits_ref), atol=1e-4)
+
+    step = make_pipeline_lm_train_step(cfg, num_stages, mesh,
+                                       num_microbatches=n_micro)
+    state, loss = step(state, {"input_ids": ids})
+    assert bool(jnp.isfinite(loss))
