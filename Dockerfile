@@ -1,0 +1,20 @@
+# Container image for the move2kube-tpu CLI tool.
+# Parity: reference Dockerfile:1-30 (2-stage build; builder compiles, the
+# runtime stage carries only the installed tool). The Python equivalent
+# builds a wheel in the first stage and installs it into a slim runtime.
+FROM python:3.11-slim AS build
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY move2kube_tpu ./move2kube_tpu
+RUN pip install --no-cache-dir build && python -m build --wheel --outdir /dist
+
+FROM python:3.11-slim
+LABEL org.opencontainers.image.title="move2kube-tpu" \
+      org.opencontainers.image.description="Re-platform apps onto Kubernetes with a TPU-first target"
+# kubectl is the only external binary the collectors shell out to; the
+# image stays usable without it (collect degrades gracefully)
+COPY --from=build /dist/*.whl /tmp/
+RUN pip install --no-cache-dir /tmp/*.whl && rm /tmp/*.whl
+WORKDIR /workspace
+ENTRYPOINT ["m2kt"]
+CMD ["--help"]

@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast lint bench dryrun e2e clean
+.PHONY: test test-fast lint ci bench dryrun e2e clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -12,9 +12,15 @@ test:
 test-fast:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow" -x
 
+# AST linter (scripts/lint.py; parity with the reference's golangci-lint
+# gate, Makefile:82-101) + bytecode compile + import smoke
 lint:
-	$(PY) -m compileall -q move2kube_tpu
+	$(PY) -m compileall -q -x 'assets/' move2kube_tpu scripts
+	$(PY) scripts/lint.py move2kube_tpu tests scripts bench.py __graft_entry__.py
 	$(PY) -c "import move2kube_tpu.cli.main"
+
+# what .github/workflows/build.yml runs
+ci: lint test dryrun
 
 bench:
 	$(PY) bench.py

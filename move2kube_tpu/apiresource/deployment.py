@@ -106,6 +106,10 @@ def _chips_per_host(topology: str, num_hosts: int) -> int:
             chips *= int(dim)
         return max(1, chips // max(1, num_hosts))
     except (ValueError, AttributeError):
+        log.warning(
+            "malformed TPU topology %r; falling back to 4 chips per host "
+            "(google.com/tpu resource limits may not match the node pool)",
+            topology)
         return 4
 
 

@@ -87,7 +87,8 @@ class ServiceAPIResource(APIResource):
         host = ir.values.ingress_host or ""
         paths = []
         for svc in exposed:
-            port = svc.port_forwardings[0].service_port if svc.port_forwardings else common.DEFAULT_SERVICE_PORT
+            port = (svc.port_forwardings[0].service_port
+                    if svc.port_forwardings else common.DEFAULT_SERVICE_PORT)
             paths.append({
                 "path": svc.service_rel_path or "/" + svc.name,
                 "pathType": "Prefix",
@@ -110,7 +111,8 @@ class ServiceAPIResource(APIResource):
         return obj
 
     def _create_route(self, svc: Service) -> dict:
-        port = svc.port_forwardings[0].service_port if svc.port_forwardings else common.DEFAULT_SERVICE_PORT
+        port = (svc.port_forwardings[0].service_port
+                if svc.port_forwardings else common.DEFAULT_SERVICE_PORT)
         obj = make_obj(ROUTE, "route.openshift.io/v1", svc.name,
                        {SELECTOR_LABEL: svc.name})
         obj["spec"] = {

@@ -211,7 +211,8 @@ class ClusterCollector:
         # net-new: TPU node pools
         tpu_out = self._run(
             "get", "nodes",
-            "-o", r"jsonpath={range .items[*]}{.metadata.labels.cloud\.google\.com/gke-tpu-accelerator}{'\n'}{end}",
+            "-o", (r"jsonpath={range .items[*]}{.metadata.labels"
+                  r".cloud\.google\.com/gke-tpu-accelerator}{'\n'}{end}"),
         )
         if tpu_out:
             spec.tpu_accelerators = sorted({l for l in tpu_out.splitlines() if l})

@@ -7,8 +7,12 @@ the CNB lifecycle detector against a source dir (``IsBuilderSupported``,
 provider.go:68) and (b) list the buildpacks baked into a builder image
 (``GetAllBuildpacks``, provider.go:56).
 
-We keep the same seam with three providers:
+We keep the same seam with four providers:
 
+- ``DockerAPIProvider`` — talks to the docker daemon REST API directly
+  over its unix socket with stdlib ``http.client`` (no docker SDK, no
+  CLI binary needed; parity: dockerapiprovider.go:104-300 — daemon-API
+  detector run + builder-label buildpack listing).
 - ``ContainerRuntimeProvider`` — docker/podman CLI, runs
   ``/cnb/lifecycle/detector`` inside the builder image with the source
   mounted (parity: containerruntimeprovider.go).
@@ -18,17 +22,21 @@ We keep the same seam with three providers:
   daemon at all (net-new; replaces the reference's hard dependency on a
   container runtime at plan time).
 
-There is no dockerAPI/runc provider because neither the docker SDK nor
-runc is a dependency of this environment; the CLI provider covers both
-docker and podman. Option lists are memoised per directory by the caller
-(parity: cnbcache, cnbcontainerizer.go:41).
+There is no runc provider (runc isn't a dependency of this environment;
+the daemon-API and CLI providers cover dockerd/podman setups). Option
+lists are memoised per directory by the caller (parity: cnbcache,
+cnbcontainerizer.go:41).
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import os
 import shutil
+import socket
 import subprocess
+import urllib.parse
 
 from move2kube_tpu.utils import common
 from move2kube_tpu.utils.log import get_logger
@@ -37,6 +45,9 @@ log = get_logger("containerizer.cnb.provider")
 
 _EXEC_TIMEOUT = 120
 
+# builder image label listing the buildpack order (CNB platform spec)
+BUILDER_METADATA_LABEL = "io.buildpacks.builder.metadata"
+
 
 def _run(cmd: list[str], timeout: int = _EXEC_TIMEOUT) -> subprocess.CompletedProcess | None:
     try:
@@ -44,6 +55,139 @@ def _run(cmd: list[str], timeout: int = _EXEC_TIMEOUT) -> subprocess.CompletedPr
                               timeout=timeout, check=False)
     except (OSError, subprocess.TimeoutExpired):
         return None
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    """HTTP over an AF_UNIX socket (the docker daemon's transport)."""
+
+    def __init__(self, socket_path: str, timeout: float):
+        super().__init__("localhost", timeout=timeout)
+        self._socket_path = socket_path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect(self._socket_path)
+        self.sock = sock
+
+
+class DockerAPIProvider:
+    """CNB probing straight against the docker Engine API.
+
+    Parity: ``internal/containerizer/cnb/dockerapiprovider.go:104-300`` —
+    the reference uses the docker SDK to (a) run the CNB lifecycle
+    detector in a container with the source bind-mounted and (b) read the
+    builder image's buildpack-order label. This implementation speaks the
+    same REST API over the daemon socket with the stdlib, so it works in
+    environments that have a dockerd but no docker CLI/SDK.
+    """
+
+    API = "/v1.41"
+
+    def __init__(self, socket_path: str | None = None):
+        self._socket_path = socket_path
+        self._available: bool | None = None
+
+    def _resolve_socket(self) -> str | None:
+        if self._socket_path:
+            return self._socket_path
+        host = os.environ.get("DOCKER_HOST", "")
+        if host.startswith("unix://"):
+            return host[len("unix://"):]
+        if host:
+            return None  # tcp daemons: the CLI provider handles those
+        return "/var/run/docker.sock"
+
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 timeout: float = 30.0) -> tuple[int, bytes]:
+        sock_path = self._resolve_socket()
+        if sock_path is None:
+            return 0, b""
+        conn = _UnixHTTPConnection(sock_path, timeout)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, self.API + path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            log.debug("docker API %s %s failed: %s", method, path, e)
+            return 0, b""
+        finally:
+            conn.close()
+
+    def _json(self, method: str, path: str, body: dict | None = None,
+              timeout: float = 30.0) -> tuple[int, dict]:
+        status, raw = self._request(method, path, body, timeout)
+        try:
+            return status, json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            return status, {}
+
+    def is_available(self) -> bool:
+        if self._available is None:
+            self._available = False
+            if not common.IGNORE_ENVIRONMENT:
+                sock_path = self._resolve_socket()
+                if sock_path and os.path.exists(sock_path):
+                    status, _ = self._request("GET", "/_ping", timeout=5.0)
+                    self._available = status == 200
+        return self._available
+
+    def is_builder_supported(self, directory: str, builder: str) -> bool:
+        """create/start/wait a detector container; exit 0 == supported."""
+        create_body = {
+            "Image": builder,
+            "Entrypoint": ["/cnb/lifecycle/detector"],
+            "Cmd": ["-app", "/workspace"],
+            "HostConfig": {"Binds": [f"{os.path.abspath(directory)}:/workspace:ro"]},
+        }
+        status, created = self._json("POST", "/containers/create", create_body)
+        if status == 404:
+            # builder image not present locally; try a daemon-side pull
+            # (parity: dockerapiprovider.go isBuilderAvailable pulls first).
+            # An explicit tag is required: an untagged fromImage pulls
+            # EVERY tag of the repository.
+            name, _, tag = builder.rpartition(":")
+            if not name or "/" in tag:  # no tag, or ':' was a registry port
+                name, tag = builder, "latest"
+            self._request(
+                "POST",
+                f"/images/create?fromImage={urllib.parse.quote(name, safe='')}"
+                f"&tag={urllib.parse.quote(tag, safe='')}",
+                timeout=_EXEC_TIMEOUT)
+            status, created = self._json("POST", "/containers/create",
+                                         create_body)
+        cid = created.get("Id")
+        if status != 201 or not cid:
+            return False
+        try:
+            status, _ = self._request("POST", f"/containers/{cid}/start")
+            if status not in (204, 304):
+                return False
+            status, result = self._json("POST", f"/containers/{cid}/wait",
+                                        timeout=_EXEC_TIMEOUT)
+            return status == 200 and result.get("StatusCode") == 0
+        finally:
+            self._request("DELETE", f"/containers/{cid}?force=true")
+
+    def get_all_buildpacks(self, builders: list[str]) -> dict[str, list[str]]:
+        out: dict[str, list[str]] = {}
+        for builder in builders:
+            quoted = urllib.parse.quote(builder, safe="")
+            status, info = self._json("GET", f"/images/{quoted}/json")
+            if status != 200:
+                continue
+            labels = (info.get("Config") or {}).get("Labels") or {}
+            try:
+                meta = json.loads(labels.get(BUILDER_METADATA_LABEL, ""))
+                ids = [bp.get("id", "") for bp in meta.get("buildpacks", [])
+                       if bp.get("id")]
+            except (json.JSONDecodeError, AttributeError):
+                continue
+            if ids:
+                out[builder] = ids
+        return out
 
 
 class ContainerRuntimeProvider:
@@ -164,8 +308,10 @@ class StaticProvider:
 
 
 def get_providers() -> list:
-    """Ordered chain (provider.go:31); live providers first, static last."""
-    return [ContainerRuntimeProvider(), PackProvider(), StaticProvider()]
+    """Ordered chain (provider.go:31: dockerAPI, containerRuntime, pack,
+    runc); live providers first, static last (our runc stand-in)."""
+    return [DockerAPIProvider(), ContainerRuntimeProvider(), PackProvider(),
+            StaticProvider()]
 
 
 def is_builder_supported(providers: list, directory: str, builder: str) -> bool:

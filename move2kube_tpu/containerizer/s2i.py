@@ -33,7 +33,8 @@ BUILDERS = {
     "java-maven": "registry.access.redhat.com/ubi8/openjdk-17",
     "java-gradle": "registry.access.redhat.com/ubi8/openjdk-17",
     "java-ant": "registry.access.redhat.com/ubi8/openjdk-17",
-    "java-war-tomcat": "registry.access.redhat.com/jboss-webserver-5/jws58-openjdk17-openshift-rhel8",
+    "java-war-tomcat": ("registry.access.redhat.com/jboss-webserver-5"
+                        "/jws58-openjdk17-openshift-rhel8"),
     "java-war-liberty": "icr.io/appcafe/open-liberty-s2i:23",
     "java-war-jboss": "quay.io/wildfly/wildfly-s2i:latest-jdk17",
     "php": "registry.access.redhat.com/ubi8/php-80",

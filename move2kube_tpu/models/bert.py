@@ -85,7 +85,8 @@ class BertEncoder(nn.Module):
         for _ in range(self.num_layers):
             x = BertLayer(self.num_heads, self.mlp_dim, dtype=self.dtype)(x, mask)
         cls = x[:, 0]
-        pooled = jnp.tanh(nn.Dense(self.d_model, dtype=jnp.float32, name="pooler")(cls.astype(jnp.float32)))
+        pooled = jnp.tanh(nn.Dense(self.d_model, dtype=jnp.float32,
+                                   name="pooler")(cls.astype(jnp.float32)))
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(pooled)
 
 

@@ -6,7 +6,6 @@ ingress]`` (customizer.go:30-49).
 
 from __future__ import annotations
 
-import base64
 import json
 
 from move2kube_tpu import qa

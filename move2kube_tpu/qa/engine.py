@@ -9,10 +9,9 @@ typed fetch helpers.
 
 from __future__ import annotations
 
-from typing import Any
 
 from move2kube_tpu.qa.cache import Cache
-from move2kube_tpu.qa.problem import Problem, SolutionForm
+from move2kube_tpu.qa.problem import Problem
 from move2kube_tpu.utils.log import get_logger
 
 log = get_logger("qa")

@@ -16,7 +16,6 @@ from move2kube_tpu.source.base import Translator
 from move2kube_tpu.source.ignores import IgnoreRules
 from move2kube_tpu.types import ir as irtypes
 from move2kube_tpu.types.plan import (
-    ContainerBuildType,
     Plan,
     PlanService,
     SourceType,

@@ -353,7 +353,9 @@ class ComposeTranslator(Translator):
         svc = irtypes.service_from_plan(plan_svc)
         env_map = _parse_env(svc_def, compose_dir)
 
-        image = _interpolate(str(svc_def.get("image", "") or plan_svc.image or f"{name}:latest"), env_map)
+        image = _interpolate(
+            str(svc_def.get("image", "") or plan_svc.image or f"{name}:latest"),
+            env_map)
         container: dict = {"name": name, "image": image}
 
         # entrypoint/command (compose entrypoint->k8s command, command->args)
@@ -416,7 +418,8 @@ class ComposeTranslator(Translator):
 
         # restart policy (v1v2.go: restart / deploy.restart_policy)
         restart = str(svc_def.get("restart", "")
-                      or (svc_def.get("deploy", {}).get("restart_policy", {}) or {}).get("condition", ""))
+                      or ((svc_def.get("deploy", {}).get("restart_policy", {})
+                           or {}).get("condition", "")))
         if restart in ("no", "none"):
             svc.restart_policy = "Never"
         elif restart.startswith("on-failure"):
@@ -473,7 +476,8 @@ class ComposeTranslator(Translator):
                 svc.add_volume({"name": vol_name, "emptyDir": {"medium": "Memory"}})
             elif vtype == "bind" or (src and src.startswith((".", "/", "~"))):
                 vol_name = common.make_dns_label(f"{name}-hostpath-{i}")
-                host_path = os.path.normpath(os.path.join(compose_dir, src)) if src.startswith(".") else src
+                host_path = (os.path.normpath(os.path.join(compose_dir, src))
+                             if src.startswith(".") else src)
                 svc.add_volume({"name": vol_name, "hostPath": {"path": host_path}})
             else:
                 vol_name = common.make_dns_label(src or f"{name}-vol-{i}")

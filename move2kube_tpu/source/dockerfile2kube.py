@@ -28,7 +28,8 @@ from move2kube_tpu.utils.log import get_logger
 log = get_logger("source.dockerfile")
 
 _INSTRUCTION = re.compile(
-    r"^\s*(FROM|RUN|CMD|LABEL|MAINTAINER|EXPOSE|ENV|ADD|COPY|ENTRYPOINT|VOLUME|USER|WORKDIR|ARG|ONBUILD|STOPSIGNAL|HEALTHCHECK|SHELL)\b",
+    r"^\s*(FROM|RUN|CMD|LABEL|MAINTAINER|EXPOSE|ENV|ADD|COPY|ENTRYPOINT"
+    r"|VOLUME|USER|WORKDIR|ARG|ONBUILD|STOPSIGNAL|HEALTHCHECK|SHELL)\b",
     re.IGNORECASE,
 )
 
@@ -46,7 +47,8 @@ def is_dockerfile(path: str) -> bool:
         line = raw.strip()
         if not line or line.startswith("#"):
             continue
-        if not _INSTRUCTION.match(line) and not raw.startswith((" ", "\t")) and not raw.rstrip().endswith("\\"):
+        if (not _INSTRUCTION.match(line) and not raw.startswith((" ", "\t"))
+                and not raw.rstrip().endswith("\\")):
             # allow continuation lines; anything else disqualifies
             if not has_from:
                 return False

@@ -52,7 +52,8 @@ _FRAMEWORK_IMPORTS = {
 
 _MODEL_FAMILY_PATTERNS = [
     ("llama", re.compile(r"llama|LlamaForCausalLM|mistral|decoder_layer|rotary", re.I)),
-    ("bert", re.compile(r"\bbert\b|BertModel|BertForSequenceClassification|AutoModelForSequenceClassification", re.I)),
+    ("bert", re.compile(r"\bbert\b|BertModel|BertForSequenceClassification"
+                        r"|AutoModelForSequenceClassification", re.I)),
     ("resnet", re.compile(r"resnet|torchvision\.models", re.I)),
     ("gpt", re.compile(r"\bgpt2?\b|GPT2LMHeadModel|causal_lm|CausalLM", re.I)),
     ("unet", re.compile(r"\bunet\b|diffusion", re.I)),
@@ -62,7 +63,8 @@ _CUDA_TEXT = re.compile(
     r"torch\.cuda|\.cuda\(\)|to\(['\"]cuda|device\s*=\s*['\"]cuda|cupy|numba\.cuda"
     r"|tf\.config[^\n]*GPU|nvidia-smi|CUDA_VISIBLE_DEVICES"
 )
-_NCCL_TEXT = re.compile(r"['\"]nccl['\"]|init_process_group|DistributedDataParallel|torchrun|torch\.distributed")
+_NCCL_TEXT = re.compile(r"['\"]nccl['\"]|init_process_group"
+                        r"|DistributedDataParallel|torchrun|torch\.distributed")
 
 
 @dataclass
@@ -204,7 +206,8 @@ def _analyze_directory_uncached(directory: str) -> GpuReport | None:
         is_trainingish = (v and v.is_training) or bool(
             re.search(r"\.backward\(\)|optimizer\.step|loss|train_loop|model\.fit", text)
         )
-        if is_trainingish and (uses_cuda or imports & {"torch", "tensorflow", "deepspeed", "horovod"}):
+        if is_trainingish and (uses_cuda or imports & {
+                "torch", "tensorflow", "deepspeed", "horovod"}):
             report.training_scripts.append(path)
 
     # DeepSpeed config JSON (ZeRO stage, micro batch, parallel sizes)
@@ -270,7 +273,8 @@ def _analyze_directory_uncached(directory: str) -> GpuReport | None:
                     f"{os.path.relpath(sh, directory)}: {attr}={m.group(1)}")
 
     # decide: is this a GPU training workload?
-    gpu_frameworks = set(report.frameworks) & {"torch", "tensorflow", "deepspeed", "horovod", "cupy"}
+    gpu_frameworks = set(report.frameworks) & {
+        "torch", "tensorflow", "deepspeed", "horovod", "cupy"}
     if not gpu_frameworks:
         return None
     if not (report.uses_cuda or report.distributed_backend or "deepspeed" in report.frameworks):
@@ -354,7 +358,15 @@ def map_gpu_to_tpu_multislice(
     if gpu_count <= MAX_SLICE_CHIPS:
         acc, topo, hosts = map_gpu_to_tpu(gpu_count, zero_stage)
         return acc, topo, hosts, 1
-    num_slices = min(-(-gpu_count // MAX_SLICE_CHIPS), MAX_SLICES)
+    slices_needed = -(-gpu_count // MAX_SLICE_CHIPS)
+    num_slices = min(slices_needed, MAX_SLICES)
+    if slices_needed > MAX_SLICES:
+        log.warning(
+            "detected %d GPUs needs %d slices of %d chips but the emitter "
+            "caps at %d slices (%d chips total); scale the JobSet replicas "
+            "up manually for the full footprint",
+            gpu_count, slices_needed, MAX_SLICE_CHIPS, MAX_SLICES,
+            MAX_SLICES * MAX_SLICE_CHIPS)
     acc, topo, hosts = map_gpu_to_tpu(MAX_SLICE_CHIPS, zero_stage)
     return acc, topo, hosts, num_slices
 

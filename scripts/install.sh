@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Install the move2kube-tpu CLI from source.
+# Parity: reference scripts/install.sh (fetch + place binary on PATH); the
+# Python equivalent is a user-level pip install exposing the m2kt console
+# script.
+set -euo pipefail
+
+REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+PYTHON="${PYTHON:-python3}"
+if ! command -v "$PYTHON" >/dev/null 2>&1; then
+    echo "error: $PYTHON not found; install Python >= 3.10 first" >&2
+    exit 1
+fi
+version_ok=$("$PYTHON" -c 'import sys; print(int(sys.version_info >= (3, 10)))')
+if [ "$version_ok" != "1" ]; then
+    echo "error: Python >= 3.10 required, found $("$PYTHON" --version)" >&2
+    exit 1
+fi
+
+echo "Installing move2kube-tpu from $REPO_DIR ..."
+"$PYTHON" -m pip install --user "$REPO_DIR"
+
+BIN_DIR=$("$PYTHON" -m site --user-base)/bin
+if ! command -v m2kt >/dev/null 2>&1; then
+    echo "note: add $BIN_DIR to your PATH to use 'm2kt'" >&2
+fi
+echo "Done. Try: m2kt version"

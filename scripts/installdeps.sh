@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Install the optional external binaries move2kube-tpu shells out to.
+# Parity: reference scripts/installdeps.sh (pack, kubectl, operator-sdk).
+# Everything here is OPTIONAL: planning/translation degrade gracefully
+# without them (collectors skip, CNB falls back to the static provider).
+set -euo pipefail
+
+BIN_DIR="${BIN_DIR:-$HOME/.local/bin}"
+mkdir -p "$BIN_DIR"
+
+have() { command -v "$1" >/dev/null 2>&1; }
+
+OS=$(uname -s | tr '[:upper:]' '[:lower:]')
+ARCH=$(uname -m)
+case "$ARCH" in
+    x86_64) ARCH=amd64 ;;
+    aarch64 | arm64) ARCH=arm64 ;;
+esac
+
+if have kubectl; then
+    echo "kubectl: already installed"
+else
+    echo "kubectl: installing to $BIN_DIR"
+    STABLE=$(curl -fsSL https://dl.k8s.io/release/stable.txt)
+    curl -fsSLo "$BIN_DIR/kubectl" \
+        "https://dl.k8s.io/release/${STABLE}/bin/${OS}/${ARCH}/kubectl"
+    chmod +x "$BIN_DIR/kubectl"
+fi
+
+if have pack; then
+    echo "pack: already installed"
+else
+    echo "pack: installing to $BIN_DIR (CNB builder probing)"
+    PACK_VERSION=v0.35.1
+    # release assets are named pack-<ver>-{linux,linux-arm64,macos,macos-arm64}.tgz
+    case "$OS" in
+        darwin) PACK_PLATFORM=macos ;;
+        *) PACK_PLATFORM=linux ;;
+    esac
+    if [ "$ARCH" = "arm64" ]; then
+        PACK_PLATFORM="${PACK_PLATFORM}-arm64"
+    fi
+    curl -fsSL \
+        "https://github.com/buildpacks/pack/releases/download/${PACK_VERSION}/pack-${PACK_VERSION}-${PACK_PLATFORM}.tgz" \
+        | tar -xz -C "$BIN_DIR" pack
+    chmod +x "$BIN_DIR/pack"
+fi
+
+if have docker || have podman; then
+    echo "container runtime: found"
+else
+    echo "note: no docker/podman found; CNB probing will use the static" \
+         "heuristic and image builds must run elsewhere" >&2
+fi
+
+echo "Done. Ensure $BIN_DIR is on your PATH."

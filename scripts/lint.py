@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Dependency-free Python linter for move2kube-tpu.
+
+Lint/static-analysis parity with the reference's golangci-lint gate
+(reference Makefile:82-101) in an image with no ruff/flake8/pylint: real
+AST checks plus mechanical style checks, exit 1 on any finding.
+
+AST checks (per file):
+  unused-import        imported name never referenced (skips __init__.py
+                       re-export files and names in __all__)
+  mutable-default      list/dict/set literal as a function default
+  bare-except          ``except:`` with no exception class
+  duplicate-def        function/class defined twice in the same scope
+  pointless-fstring    f-string with no placeholders
+  assert-tuple         ``assert (x, "msg")`` — always true
+  none-compare         ``== None`` / ``!= None`` instead of ``is``
+
+Style checks: tabs in indentation, trailing whitespace, missing final
+newline, lines > 100 chars.
+
+Usage: python scripts/lint.py PATH [PATH...]   (dirs are walked for *.py;
+jinja template assets under assets/ are skipped — not valid Python until
+rendered).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+MAX_LINE = 100
+SKIP_DIRS = {"__pycache__", ".git", "assets", ".claude"}
+
+
+def iter_py_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+class Checker(ast.NodeVisitor):
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        self.findings: list[tuple[int, str, str]] = []
+        # name -> first definition line, for imports
+        self.imports: dict[str, int] = {}
+        self.used: set[str] = set()
+        self.is_init = os.path.basename(path) == "__init__.py"
+
+    def add(self, line: int, rule: str, msg: str) -> None:
+        self.findings.append((line, rule, msg))
+
+    # --- imports ----------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            self.imports.setdefault(bound, node.lineno)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = alias.asname or alias.name
+            self.imports.setdefault(bound, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+    # --- functions --------------------------------------------------------
+    def _check_defaults(self, node) -> None:
+        for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.add(default.lineno, "mutable-default",
+                         f"mutable default argument in {node.name}()")
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    # --- statements -------------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.add(node.lineno, "bare-except",
+                     "bare 'except:' catches SystemExit/KeyboardInterrupt")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self.add(node.lineno, "assert-tuple",
+                     "assert on a non-empty tuple is always true")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comparator in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                    isinstance(comparator, ast.Constant)
+                    and comparator.value is None):
+                self.add(node.lineno, "none-compare",
+                         "use 'is None' / 'is not None', not ==/!=")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.add(node.lineno, "pointless-fstring",
+                     "f-string without any placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
+        # do NOT recurse into format_spec: a ':.3f' spec is itself a
+        # JoinedStr with no placeholders and would false-positive above
+        self.visit(node.value)
+
+    # --- scope-level duplicate defs ---------------------------------------
+    def check_duplicates(self) -> None:
+        for scope in ast.walk(self.tree):
+            if not isinstance(scope, (ast.Module, ast.ClassDef,
+                                      ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            seen: dict[str, int] = {}
+            for stmt in getattr(scope, "body", []):
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    # property setters / singledispatch re-use names legally
+                    if any(isinstance(d, ast.Attribute) or isinstance(d, ast.Name)
+                           for d in stmt.decorator_list):
+                        continue
+                    if stmt.name in seen:
+                        self.add(stmt.lineno, "duplicate-def",
+                                 f"'{stmt.name}' already defined at line "
+                                 f"{seen[stmt.name]}")
+                    seen[stmt.name] = stmt.lineno
+
+    def check_unused_imports(self, source: str) -> None:
+        if self.is_init:
+            return  # __init__.py re-exports
+        # names mentioned in __all__ strings count as used
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "__all__"
+                            for t in node.targets)
+                    and isinstance(node.value, (ast.List, ast.Tuple))):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        self.used.add(elt.value)
+        for name, line in sorted(self.imports.items(), key=lambda kv: kv[1]):
+            if name not in self.used and not name.startswith("_"):
+                self.add(line, "unused-import", f"'{name}' imported but unused")
+
+
+def check_style(path: str, source: str) -> list[tuple[int, str, str]]:
+    findings = []
+    lines = source.split("\n")
+    for i, line in enumerate(lines, 1):
+        stripped = line.rstrip("\n")
+        indent = stripped[: len(stripped) - len(stripped.lstrip())]
+        if "\t" in indent:
+            findings.append((i, "tab-indent", "tab in indentation"))
+        if stripped != stripped.rstrip():
+            findings.append((i, "trailing-ws", "trailing whitespace"))
+        if len(stripped) > MAX_LINE:
+            findings.append((i, "line-length",
+                             f"line is {len(stripped)} chars (max {MAX_LINE})"))
+    if source and not source.endswith("\n"):
+        findings.append((len(lines), "no-final-newline", "missing final newline"))
+    return findings
+
+
+def lint_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax-error: {e.msg}"]
+    checker = Checker(path, tree)
+    checker.visit(tree)
+    checker.check_duplicates()
+    checker.check_unused_imports(source)
+    findings = checker.findings + check_style(path, source)
+    # standard '# noqa' suppression (whole line)
+    noqa = {i for i, line in enumerate(source.split("\n"), 1)
+            if "# noqa" in line}
+    return [f"{path}:{line}: {rule}: {msg}"
+            for line, rule, msg in sorted(findings) if line not in noqa]
+
+
+def main(argv: list[str]) -> int:
+    paths = argv or ["move2kube_tpu"]
+    all_findings: list[str] = []
+    n_files = 0
+    for path in iter_py_files(paths):
+        n_files += 1
+        all_findings.extend(lint_file(path))
+    for finding in all_findings:
+        print(finding)
+    print(f"[lint] {n_files} files, {len(all_findings)} findings",
+          file=sys.stderr)
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -2,7 +2,6 @@
 
 from __future__ import annotations
 
-import os
 
 from move2kube_tpu.collector.cfapps import apps_from_v2_payload
 from move2kube_tpu.collector.cfcontainertypes import (

@@ -3,7 +3,6 @@ restore roundtrip, resume step accounting, env gating."""
 
 from __future__ import annotations
 
-import os
 
 import jax
 import jax.numpy as jnp

@@ -3,6 +3,13 @@ memoised builder-support probing, buildpack listing)."""
 
 from __future__ import annotations
 
+import http.server
+import json
+import socket
+import threading
+
+import pytest
+
 from move2kube_tpu.containerizer import cnb_providers
 from move2kube_tpu.containerizer.cnb import BUILDERS, CNBContainerizer
 from move2kube_tpu.types.plan import ContainerBuildType, Plan, PlanService
@@ -27,6 +34,130 @@ class FakeProvider:
 
     def get_all_buildpacks(self, builders):
         return self.buildpacks
+
+
+class _UnixHTTPServer(http.server.ThreadingHTTPServer):
+    address_family = socket.AF_UNIX
+
+    def server_bind(self):
+        self.socket.bind(self.server_address)
+
+    def server_activate(self):
+        self.socket.listen(8)
+
+
+@pytest.fixture
+def fake_docker_daemon(tmp_path):
+    """A scriptable docker Engine API on a unix socket (the surface
+    DockerAPIProvider speaks; no dockerd needed)."""
+    state = {
+        "detector_exit": 0,
+        "creates": [],       # recorded container-create bodies
+        "deleted": [],
+        "labels": {cnb_providers.BUILDER_METADATA_LABEL: json.dumps(
+            {"buildpacks": [{"id": "google.python"}, {"id": "google.nodejs"}]})},
+    }
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):  # keep test output clean
+            pass
+
+        def address_string(self):  # AF_UNIX has no (host, port) pair
+            return "unix"
+
+        def _reply(self, status, obj=None):
+            body = json.dumps(obj).encode() if obj is not None else b""
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.endswith("/_ping"):
+                self._reply(200, "OK")
+            elif "/images/" in self.path and self.path.endswith("/json"):
+                self._reply(200, {"Config": {"Labels": state["labels"]}})
+            else:
+                self._reply(404, {"message": "not found"})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length)) if length else {}
+            if self.path.endswith("/containers/create"):
+                state["creates"].append(body)
+                self._reply(201, {"Id": "fake-cid"})
+            elif self.path.endswith("/containers/fake-cid/start"):
+                self._reply(204)
+            elif self.path.endswith("/containers/fake-cid/wait"):
+                self._reply(200, {"StatusCode": state["detector_exit"]})
+            else:
+                self._reply(404, {"message": "not found"})
+
+        def do_DELETE(self):
+            state["deleted"].append(self.path)
+            self._reply(204)
+
+    sock_path = str(tmp_path / "docker.sock")
+    server = _UnixHTTPServer(sock_path, Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield sock_path, state
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_docker_api_provider_detector_run(fake_docker_daemon, tmp_path):
+    sock_path, state = fake_docker_daemon
+    p = cnb_providers.DockerAPIProvider(socket_path=sock_path)
+    assert p.is_available()
+    src = tmp_path / "src"
+    src.mkdir()
+    assert p.is_builder_supported(str(src), "gcr.io/buildpacks/builder") is True
+    create = state["creates"][0]
+    assert create["Entrypoint"] == ["/cnb/lifecycle/detector"]
+    assert create["Image"] == "gcr.io/buildpacks/builder"
+    assert create["HostConfig"]["Binds"] == [f"{src}:/workspace:ro"]
+    # container removed even on success
+    assert any("fake-cid" in d for d in state["deleted"])
+
+    # non-zero detector exit = builder does not support the source
+    state["detector_exit"] = 100
+    assert p.is_builder_supported(str(src), "gcr.io/buildpacks/builder") is False
+
+
+def test_docker_api_provider_buildpack_listing(fake_docker_daemon):
+    sock_path, _state = fake_docker_daemon
+    p = cnb_providers.DockerAPIProvider(socket_path=sock_path)
+    assert p.get_all_buildpacks(["b1"]) == {"b1": ["google.python",
+                                                  "google.nodejs"]}
+
+
+def test_docker_api_provider_unavailable_without_socket(tmp_path):
+    p = cnb_providers.DockerAPIProvider(socket_path=str(tmp_path / "nope.sock"))
+    assert p.is_available() is False
+
+
+def test_provider_chain_order_docker_api_first():
+    """Reference order (provider.go:31): dockerAPI -> CLI -> pack ->
+    always-available fallback."""
+    chain = cnb_providers.get_providers()
+    assert [type(p).__name__ for p in chain] == [
+        "DockerAPIProvider", "ContainerRuntimeProvider", "PackProvider",
+        "StaticProvider"]
+
+
+def test_chain_falls_through_dead_docker_api_to_static(tmp_path):
+    """dockerAPI unavailable (no daemon) must fall through the chain to the
+    static heuristic, not disable CNB."""
+    (tmp_path / "requirements.txt").write_text("flask\n")
+    (tmp_path / "app.py").write_text("x = 1\n")
+    dead = cnb_providers.DockerAPIProvider(socket_path=str(tmp_path / "no.sock"))
+    chain = [dead, cnb_providers.StaticProvider()]
+    assert cnb_providers.is_builder_supported(chain, str(tmp_path),
+                                              BUILDERS[0]) is True
 
 
 def test_denying_provider_falls_through():

@@ -60,7 +60,8 @@ def test_read_m2kt_yaml_kind_check(tmp_path):
 
 
 def test_render_template():
-    out = common.render_template("FROM {{ base }}\nEXPOSE {{ port }}\n", {"base": "python:3", "port": 8080})
+    out = common.render_template("FROM {{ base }}\nEXPOSE {{ port }}\n",
+                                 {"base": "python:3", "port": 8080})
     assert out == "FROM python:3\nEXPOSE 8080\n"
 
 

@@ -5,7 +5,7 @@ import pytest
 
 from move2kube_tpu.qa import engine as qaengine
 from move2kube_tpu.qa.cache import Cache
-from move2kube_tpu.qa.problem import Problem, SolutionForm
+from move2kube_tpu.qa.problem import Problem
 
 
 @pytest.fixture(autouse=True)

@@ -3,7 +3,6 @@ use in Tekton git secrets (§2.8 TektonAPIResourceSet)."""
 
 from __future__ import annotations
 
-import os
 
 from move2kube_tpu.qa import engine as qaengine
 from move2kube_tpu.utils import gitinfo, knownhosts, sshkeys

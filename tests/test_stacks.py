@@ -3,7 +3,6 @@ java ant/war variants, s2i builder coverage)."""
 
 from __future__ import annotations
 
-import os
 
 from move2kube_tpu.containerizer import stacks
 from move2kube_tpu.containerizer.dockerfile import DockerfileContainerizer
