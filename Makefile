@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast lint ci bench dryrun e2e clean
+.PHONY: test test-fast lint ci dist bench dryrun e2e clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -21,6 +21,14 @@ lint:
 
 # what .github/workflows/build.yml runs
 ci: lint test dryrun
+
+# wheel + sdist + checksums (parity: reference scripts/builddist.go's
+# tar+checksum dist packaging; one pure-Python artifact replaces the
+# per-OS gox matrix). Used by .github/workflows/release.yml.
+dist:
+	rm -rf dist
+	$(PY) -m build --wheel --sdist --no-isolation --outdir dist
+	cd dist && sha256sum * > SHA256SUMS
 
 bench:
 	$(PY) bench.py

@@ -146,16 +146,19 @@ class DockerAPIProvider:
         if status == 404:
             # builder image not present locally; try a daemon-side pull
             # (parity: dockerapiprovider.go isBuilderAvailable pulls first).
-            # An explicit tag is required: an untagged fromImage pulls
-            # EVERY tag of the repository.
-            name, _, tag = builder.rpartition(":")
-            if not name or "/" in tag:  # no tag, or ':' was a registry port
-                name, tag = builder, "latest"
-            self._request(
-                "POST",
-                f"/images/create?fromImage={urllib.parse.quote(name, safe='')}"
-                f"&tag={urllib.parse.quote(tag, safe='')}",
-                timeout=_EXEC_TIMEOUT)
+            # An explicit tag is required for tag refs — an untagged
+            # fromImage pulls EVERY tag — while digest refs (repo@sha256:…)
+            # must go through verbatim with no tag param.
+            if "@" in builder:
+                pull = f"fromImage={urllib.parse.quote(builder, safe='')}"
+            else:
+                name, _, tag = builder.rpartition(":")
+                if not name or "/" in tag:  # no tag, or ':' was a registry port
+                    name, tag = builder, "latest"
+                pull = (f"fromImage={urllib.parse.quote(name, safe='')}"
+                        f"&tag={urllib.parse.quote(tag, safe='')}")
+            self._request("POST", f"/images/create?{pull}",
+                          timeout=_EXEC_TIMEOUT)
             status, created = self._json("POST", "/containers/create",
                                          create_body)
         cid = created.get("Id")

@@ -79,6 +79,7 @@ class GpuReport:
     tensor_parallel: int = 1
     pipeline_parallel: int = 1
     expert_parallel: int = 1
+    seq_parallel: int = 1  # DeepSpeed-Ulysses / Megatron context parallel
     num_experts: int = 0  # MoE expert count (DeepSpeed-MoE / Megatron)
     batch_size_hint: int = 0   # per-device batch from source args/config
     lr_hint: float = 0.0
@@ -228,6 +229,11 @@ def _analyze_directory_uncached(directory: str) -> GpuReport | None:
                 doc.get("tensor_parallel", {}).get("tp_size", 1)
                 if isinstance(doc.get("tensor_parallel"), dict) else 1
             )
+            # DeepSpeed-Ulysses sequence parallelism
+            report.seq_parallel = max(
+                report.seq_parallel,
+                int(doc.get("sequence_parallel_size",
+                            doc.get("ds_sequence_parallel_size", 1)) or 1))
             # DeepSpeed-MoE config block
             moe = doc.get("moe")
             if isinstance(moe, dict):
@@ -265,6 +271,10 @@ def _analyze_directory_uncached(directory: str) -> GpuReport | None:
             (r"--pipeline[_-]model[_-]parallel[_-]size[=\s]+(\d+)", "pipeline_parallel"),
             (r"--expert[_-]model[_-]parallel[_-]size[=\s]+(\d+)", "expert_parallel"),
             (r"--num[_-]experts[=\s]+(\d+)", "num_experts"),
+            # DeepSpeed-Ulysses / Megatron context parallelism
+            (r"--ds[_-]sequence[_-]parallel[_-]size[=\s]+(\d+)", "seq_parallel"),
+            (r"--context[_-]parallel[_-]size[=\s]+(\d+)", "seq_parallel"),
+            (r"--sequence[_-]parallel[_-]size[=\s]+(\d+)", "seq_parallel"),
         ):
             m = re.search(pat, text)
             if m:
@@ -385,6 +395,8 @@ def report_to_accelerator(report: GpuReport, gpu_count: int = 0) -> AcceleratorI
         parallelism["pp"] = report.pipeline_parallel
     if report.expert_parallel > 1:
         parallelism["ep"] = report.expert_parallel
+    if report.seq_parallel > 1:
+        parallelism["sp"] = report.seq_parallel
     if report.num_experts:
         parallelism["experts"] = report.num_experts
     if count > 1:

@@ -1,0 +1,6 @@
+#!/bin/sh
+# DeepSpeed-Ulysses long-context run: sequence parallelism over 4 GPUs
+# per replica, ZeRO-3 for the params.
+deepspeed --num_gpus 8 train_long_context.py \
+  --ds-sequence-parallel-size 4 \
+  --seq-length 65536

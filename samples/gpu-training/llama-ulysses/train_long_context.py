@@ -1,0 +1,33 @@
+"""DeepSpeed-Ulysses long-context Llama training (GPU source; translation
+input). Attention heads are all-to-all resharded across the sequence-
+parallel group so each GPU holds the full sequence for a head subset."""
+import argparse
+
+import deepspeed
+import torch
+import torch.distributed as dist
+from transformers import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--ds-sequence-parallel-size", type=int, default=4)
+    parser.add_argument("--seq-length", type=int, default=65536)
+    args = parser.parse_args()
+
+    dist.init_process_group(backend="nccl")
+    torch.cuda.set_device(dist.get_rank() % torch.cuda.device_count())
+    config = LlamaConfig(hidden_size=4096, num_hidden_layers=32,
+                         max_position_embeddings=args.seq_length)
+    model = LlamaForCausalLM(config).cuda()
+    engine, optimizer, _, _ = deepspeed.initialize(
+        model=model, config="ds_config.json")
+    for step in range(1000):
+        batch = torch.randint(0, 32000, (1, args.seq_length)).cuda()
+        loss = engine(input_ids=batch, labels=batch).loss
+        engine.backward(loss)
+        engine.step()
+
+
+if __name__ == "__main__":
+    main()

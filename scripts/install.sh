@@ -19,7 +19,16 @@ if [ "$version_ok" != "1" ]; then
 fi
 
 echo "Installing move2kube-tpu from $REPO_DIR ..."
-"$PYTHON" -m pip install --user "$REPO_DIR"
+in_venv=$("$PYTHON" -c 'import sys; print(int(sys.prefix != sys.base_prefix))')
+if [ "$in_venv" = "1" ]; then
+    # inside a virtualenv --user is rejected; install into the venv
+    "$PYTHON" -m pip install "$REPO_DIR"
+elif ! "$PYTHON" -m pip install --user "$REPO_DIR"; then
+    echo "error: pip install failed (PEP 668 externally-managed Python?)." >&2
+    echo "Try:  pipx install $REPO_DIR" >&2
+    echo "or:   python3 -m venv ~/.m2kt-venv && ~/.m2kt-venv/bin/pip install $REPO_DIR" >&2
+    exit 1
+fi
 
 BIN_DIR=$("$PYTHON" -m site --user-base)/bin
 if ! command -v m2kt >/dev/null 2>&1; then

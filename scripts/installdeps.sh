@@ -17,14 +17,21 @@ case "$ARCH" in
     aarch64 | arm64) ARCH=arm64 ;;
 esac
 
+# each tool is optional: a failed install warns and moves on instead of
+# aborting the script (set -e is scoped out via `if ! { ...; }`)
 if have kubectl; then
     echo "kubectl: already installed"
 else
     echo "kubectl: installing to $BIN_DIR"
-    STABLE=$(curl -fsSL https://dl.k8s.io/release/stable.txt)
-    curl -fsSLo "$BIN_DIR/kubectl" \
-        "https://dl.k8s.io/release/${STABLE}/bin/${OS}/${ARCH}/kubectl"
-    chmod +x "$BIN_DIR/kubectl"
+    if ! {
+        STABLE=$(curl -fsSL https://dl.k8s.io/release/stable.txt) &&
+        curl -fsSLo "$BIN_DIR/kubectl" \
+            "https://dl.k8s.io/release/${STABLE}/bin/${OS}/${ARCH}/kubectl" &&
+        chmod +x "$BIN_DIR/kubectl"
+    }; then
+        echo "warning: kubectl install failed for ${OS}/${ARCH}; collectors" \
+             "will degrade gracefully without it" >&2
+    fi
 fi
 
 if have pack; then
@@ -40,10 +47,15 @@ else
     if [ "$ARCH" = "arm64" ]; then
         PACK_PLATFORM="${PACK_PLATFORM}-arm64"
     fi
-    curl -fsSL \
-        "https://github.com/buildpacks/pack/releases/download/${PACK_VERSION}/pack-${PACK_VERSION}-${PACK_PLATFORM}.tgz" \
-        | tar -xz -C "$BIN_DIR" pack
-    chmod +x "$BIN_DIR/pack"
+    if ! {
+        curl -fsSL \
+            "https://github.com/buildpacks/pack/releases/download/${PACK_VERSION}/pack-${PACK_VERSION}-${PACK_PLATFORM}.tgz" \
+            | tar -xz -C "$BIN_DIR" pack &&
+        chmod +x "$BIN_DIR/pack"
+    }; then
+        echo "warning: pack install failed for ${PACK_PLATFORM}; CNB probing" \
+             "will fall back to the static provider" >&2
+    fi
 fi
 
 if have docker || have podman; then

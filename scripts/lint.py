@@ -35,6 +35,10 @@ SKIP_DIRS = {"__pycache__", ".git", "assets", ".claude"}
 
 def iter_py_files(paths: list[str]):
     for p in paths:
+        if not os.path.exists(p):
+            # a vanished lint target must fail loudly, not shrink coverage
+            print(f"error: no such file or directory: {p}", file=sys.stderr)
+            sys.exit(2)
         if os.path.isfile(p):
             yield p
             continue

@@ -20,6 +20,38 @@ def _flask_tree(tmp_path):
     return tmp_path / "src"
 
 
+def test_helm_chart_carries_compose_gpu_tpu_workload(tmp_path):
+    """BASELINE config 3: the compose sample's multi-GPU 'trainer' service
+    lands in the Helm chart as a TPU pod-slice workload (google.com/tpu
+    resources + topology selectors), not a plain Deployment."""
+    samples = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "samples", "docker-compose")
+    out = tmp_path / "out"
+    qaengine.reset_engines()
+    qaengine.start_engine(qa_skip=True)
+    try:
+        plan = planner.create_plan(samples, name="stack")
+        plan.kubernetes.artifact_type = TargetArtifactType.HELM
+        translator.translate(plan, str(out))
+    finally:
+        qaengine.reset_engines()
+
+    chart = out / "stack"
+    assert (chart / "Chart.yaml").exists()
+    tmpl_dir = chart / "templates"
+    trainer = [f for f in os.listdir(tmpl_dir) if "trainer" in f
+               and ("job" in f or "deployment" in f)]
+    assert trainer, os.listdir(tmpl_dir)
+    docs = [d for f in trainer
+            for d in yaml.safe_load_all((tmpl_dir / f).read_text()
+                                        .replace("{{", "#{{")) if d]
+    workload = [d for d in docs if d.get("kind") in ("Job", "JobSet")]
+    assert workload, [d.get("kind") for d in docs]
+    text = "".join((tmpl_dir / f).read_text() for f in trainer)
+    assert "google.com/tpu" in text
+    assert "gke-tpu-topology" in text
+
+
 def test_helm_translate_emits_chart_and_operator(tmp_path):
     src = _flask_tree(tmp_path)
     out = tmp_path / "out"
