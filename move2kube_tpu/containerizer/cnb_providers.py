@@ -7,7 +7,7 @@ the CNB lifecycle detector against a source dir (``IsBuilderSupported``,
 provider.go:68) and (b) list the buildpacks baked into a builder image
 (``GetAllBuildpacks``, provider.go:56).
 
-We keep the same seam with four providers:
+We keep the same seam with five providers:
 
 - ``DockerAPIProvider`` — talks to the docker daemon REST API directly
   over its unix socket with stdlib ``http.client`` (no docker SDK, no
@@ -22,9 +22,13 @@ We keep the same seam with four providers:
   daemon at all (net-new; replaces the reference's hard dependency on a
   container runtime at plan time).
 
-There is no runc provider (runc isn't a dependency of this environment;
-the daemon-API and CLI providers cover dockerd/podman setups). Option
-lists are memoised per directory by the caller (parity: cnbcache,
+- ``RuncProvider`` — daemon-free: ``skopeo`` fetches the builder image
+  into an OCI layout, ``umoci`` unpacks it to a bundle, and ``runc``
+  executes the detector with the source bind-mounted (parity:
+  runcprovider.go:108-160). For locked-down hosts with no docker/podman
+  daemon at all.
+
+Option lists are memoised per directory by the caller (parity: cnbcache,
 cnbcontainerizer.go:41).
 """
 
@@ -47,6 +51,17 @@ _EXEC_TIMEOUT = 120
 
 # builder image label listing the buildpack order (CNB platform spec)
 BUILDER_METADATA_LABEL = "io.buildpacks.builder.metadata"
+
+
+def _buildpack_ids_from_labels(labels: dict | None) -> list[str]:
+    """Buildpack ids from an image's label map (shared by every provider
+    that can reach image labels)."""
+    try:
+        meta = json.loads((labels or {}).get(BUILDER_METADATA_LABEL, ""))
+        return [bp.get("id", "") for bp in meta.get("buildpacks", [])
+                if bp.get("id")]
+    except (json.JSONDecodeError, AttributeError):
+        return []
 
 
 def _run(cmd: list[str], timeout: int = _EXEC_TIMEOUT) -> subprocess.CompletedProcess | None:
@@ -181,13 +196,8 @@ class DockerAPIProvider:
             status, info = self._json("GET", f"/images/{quoted}/json")
             if status != 200:
                 continue
-            labels = (info.get("Config") or {}).get("Labels") or {}
-            try:
-                meta = json.loads(labels.get(BUILDER_METADATA_LABEL, ""))
-                ids = [bp.get("id", "") for bp in meta.get("buildpacks", [])
-                       if bp.get("id")]
-            except (json.JSONDecodeError, AttributeError):
-                continue
+            ids = _buildpack_ids_from_labels(
+                (info.get("Config") or {}).get("Labels"))
             if ids:
                 out[builder] = ids
         return out
@@ -247,13 +257,10 @@ class ContainerRuntimeProvider:
             ], timeout=30)
             if res is None or res.returncode != 0:
                 continue
-            try:
-                meta = json.loads(res.stdout.strip())
-                out[builder] = [
-                    bp.get("id", "") for bp in meta.get("buildpacks", []) if bp.get("id")
-                ]
-            except (json.JSONDecodeError, AttributeError):
-                continue
+            ids = _buildpack_ids_from_labels(
+                {BUILDER_METADATA_LABEL: res.stdout.strip()})
+            if ids:
+                out[builder] = ids
         return out
 
 
@@ -285,6 +292,147 @@ class PackProvider:
         return out
 
 
+class RuncProvider:
+    """Daemon-free CNB probing: skopeo + umoci + runc.
+
+    Parity: ``internal/containerizer/cnb/runcprovider.go:108-160`` —
+    the builder image is fetched into an OCI layout (skopeo), unpacked
+    into a runtime bundle (umoci), the bundle's ``config.json`` patched
+    to bind-mount the source at ``/workspace`` and run
+    ``/cnb/lifecycle/detector``, then executed with runc. Buildpack
+    listing goes through ``skopeo inspect`` labels without pulling.
+    """
+
+    def __init__(self, cache_dir: str | None = None):
+        self._cache = cache_dir or os.path.join(
+            os.path.expanduser("~"), ".m2kt", "cnb")
+        # builders whose fetch failed this process: don't re-pay the
+        # skopeo/umoci timeouts on every probe of an offline host
+        self._fetch_failed: set[str] = set()
+        self._run_seq = 0
+
+    def is_available(self) -> bool:
+        return (not common.IGNORE_ENVIRONMENT
+                and all(shutil.which(b) for b in ("runc", "skopeo", "umoci")))
+
+    def _safe_key(self, builder: str) -> str:
+        # lossless: distinct refs (tag vs digest vs path) stay distinct
+        return urllib.parse.quote(builder, safe="")
+
+    def _bundle_dir(self, builder: str) -> str:
+        return os.path.join(self._cache, "bundles", self._safe_key(builder))
+
+    def _layout_dir(self, builder: str) -> str:
+        return os.path.join(self._cache, "images", self._safe_key(builder))
+
+    def _read_config(self, bundle: str) -> dict | None:
+        try:
+            with open(os.path.join(bundle, "config.json"),
+                      encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _ensure_bundle(self, builder: str) -> str | None:
+        if builder in self._fetch_failed:
+            return None
+        bundle = self._bundle_dir(builder)
+        if self._read_config(bundle) is not None:
+            return bundle
+        # a dir without a parseable config is a partial fetch: re-fetch
+        # from scratch (umoci refuses to unpack over a non-empty dir)
+        oci_layout = self._layout_dir(builder)
+        shutil.rmtree(bundle, ignore_errors=True)
+        shutil.rmtree(oci_layout, ignore_errors=True)
+        os.makedirs(os.path.dirname(bundle), exist_ok=True)
+        os.makedirs(os.path.dirname(oci_layout), exist_ok=True)
+        res = _run(["skopeo", "copy", f"docker://{builder}",
+                    f"oci:{oci_layout}:builder"], timeout=600)
+        if res is None or res.returncode != 0:
+            log.debug("skopeo copy failed for %s", builder)
+            self._fetch_failed.add(builder)
+            shutil.rmtree(oci_layout, ignore_errors=True)
+            return None
+        res = _run(["umoci", "unpack", "--image", f"{oci_layout}:builder",
+                    bundle], timeout=600)
+        if res is None or res.returncode != 0 \
+                or self._read_config(bundle) is None:
+            log.debug("umoci unpack failed for %s", builder)
+            self._fetch_failed.add(builder)
+            shutil.rmtree(bundle, ignore_errors=True)
+            return None
+        return bundle
+
+    def is_builder_supported(self, directory: str, builder: str) -> bool:
+        bundle = self._ensure_bundle(builder)
+        if bundle is None:
+            return False
+        spec = self._read_config(bundle)
+        if spec is None:
+            return False
+        mount = {"source": os.path.abspath(directory),
+                 "destination": "/workspace", "type": "bind",
+                 "options": ["rbind", "ro"]}
+        spec["mounts"] = [m for m in spec.get("mounts", [])
+                          if m.get("destination") != "/workspace"] + [mount]
+        spec.setdefault("process", {})
+        spec["process"]["args"] = ["/cnb/lifecycle/detector", "-app", "/workspace"]
+        spec["process"]["terminal"] = False
+        # the rootfs is shared read-only; the patched config goes into a
+        # private per-call bundle so concurrent probes of the same builder
+        # (different source dirs) can't race on one config.json
+        root = spec.setdefault("root", {})
+        root["path"] = os.path.join(bundle, root.get("path") or "rootfs") \
+            if not os.path.isabs(root.get("path") or "rootfs") \
+            else root["path"]
+        root.setdefault("readonly", True)
+        self._run_seq += 1
+        name = f"m2kt-cnb-{os.getpid()}-{self._run_seq}"
+        run_bundle = os.path.join(self._cache, "runs", name)
+        os.makedirs(run_bundle, exist_ok=True)
+        try:
+            with open(os.path.join(run_bundle, "config.json"), "w",
+                      encoding="utf-8") as f:
+                json.dump(spec, f)
+            res = _run(["runc", "run", "--bundle", run_bundle, name],
+                       timeout=_EXEC_TIMEOUT)
+            if res is None or res.returncode != 0:
+                return False
+            return "No buildpack groups passed detection" not in (
+                res.stdout + res.stderr)
+        except OSError as e:
+            log.debug("cannot stage run bundle for %s: %s", builder, e)
+            return False
+        finally:
+            # a timed-out run can leave container state behind; clear it
+            # so the name space and disk don't accumulate
+            _run(["runc", "delete", "--force", name], timeout=30)
+            shutil.rmtree(run_bundle, ignore_errors=True)
+
+    def get_all_buildpacks(self, builders: list[str]) -> dict[str, list[str]]:
+        out: dict[str, list[str]] = {}
+        for builder in builders:
+            # prefer the cached OCI layout (offline-friendly); fall back
+            # to a registry inspect
+            layout = self._layout_dir(builder)
+            if os.path.exists(os.path.join(layout, "index.json")):
+                res = _run(["skopeo", "inspect", f"oci:{layout}:builder"],
+                           timeout=60)
+            else:
+                res = _run(["skopeo", "inspect", f"docker://{builder}"],
+                           timeout=60)
+            if res is None or res.returncode != 0:
+                continue
+            try:
+                info = json.loads(res.stdout)
+            except json.JSONDecodeError:
+                continue
+            ids = _buildpack_ids_from_labels(info.get("Labels"))
+            if ids:
+                out[builder] = ids
+        return out
+
+
 class StaticProvider:
     """Always-available fallback: stack detection implies support for the
     default builders. Keeps planning runnable with no container runtime."""
@@ -312,9 +460,10 @@ class StaticProvider:
 
 def get_providers() -> list:
     """Ordered chain (provider.go:31: dockerAPI, containerRuntime, pack,
-    runc); live providers first, static last (our runc stand-in)."""
+    runc); live providers first, the always-available static heuristic
+    last so planning works with no runtime at all."""
     return [DockerAPIProvider(), ContainerRuntimeProvider(), PackProvider(),
-            StaticProvider()]
+            RuncProvider(), StaticProvider()]
 
 
 def is_builder_supported(providers: list, directory: str, builder: str) -> bool:

@@ -141,12 +141,120 @@ def test_docker_api_provider_unavailable_without_socket(tmp_path):
 
 
 def test_provider_chain_order_docker_api_first():
-    """Reference order (provider.go:31): dockerAPI -> CLI -> pack ->
-    always-available fallback."""
+    """Reference order (provider.go:31): dockerAPI -> CLI -> pack -> runc
+    -> always-available fallback."""
     chain = cnb_providers.get_providers()
     assert [type(p).__name__ for p in chain] == [
         "DockerAPIProvider", "ContainerRuntimeProvider", "PackProvider",
-        "StaticProvider"]
+        "RuncProvider", "StaticProvider"]
+
+
+@pytest.fixture
+def fake_runc_tools(tmp_path, monkeypatch):
+    """Executable stand-ins for runc/skopeo/umoci on PATH, scripted via
+    files in the tmp dir (no real container tooling needed)."""
+    import json as _json
+    import os
+    import stat
+
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    state_dir = tmp_path / "state"
+    state_dir.mkdir()
+
+    labels = {cnb_providers.BUILDER_METADATA_LABEL: _json.dumps(
+        {"buildpacks": [{"id": "google.go"}]})}
+
+    scripts = {
+        # skopeo inspect -> labels json; skopeo copy -> success marker
+        # (counts invocations; fails when copy-fail flag is set)
+        "skopeo": f"""#!/bin/sh
+if [ "$1" = inspect ]; then
+  cat {state_dir}/inspect.json
+else
+  echo x >> {state_dir}/copy-count
+  [ -e {state_dir}/copy-fail ] && exit 1
+  touch {state_dir}/copied
+fi
+""",
+        # umoci unpack --image <img> <bundle>: fabricate a bundle config
+        "umoci": """#!/bin/sh
+bundle=$4
+mkdir -p "$bundle"
+printf '{"mounts": [], "process": {"args": ["/bin/sh"]}}' > "$bundle/config.json"
+""",
+        # runc run --bundle <dir> <name>
+        "runc": f"""#!/bin/sh
+cp "$3/config.json" {state_dir}/runc-saw-config.json
+cat {state_dir}/runc-output 2>/dev/null
+exit $(cat {state_dir}/runc-exit 2>/dev/null || echo 0)
+""",
+    }
+    for name, body in scripts.items():
+        path = bin_dir / name
+        path.write_text(body)
+        path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    (state_dir / "inspect.json").write_text(_json.dumps({"Labels": labels}))
+    (state_dir / "runc-exit").write_text("0")
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    return state_dir
+
+
+def test_runc_provider_detector_run(fake_runc_tools, tmp_path):
+    p = cnb_providers.RuncProvider(cache_dir=str(tmp_path / "cache"))
+    assert p.is_available()
+    src = tmp_path / "src"
+    src.mkdir()
+    assert p.is_builder_supported(str(src), "gcr.io/buildpacks/builder") is True
+    # the bundle config runc executed carries the patched mount + detector
+    import json as _json
+    spec = _json.loads((fake_runc_tools / "runc-saw-config.json").read_text())
+    assert spec["process"]["args"][0] == "/cnb/lifecycle/detector"
+    mounts = {m["destination"]: m for m in spec["mounts"]}
+    assert mounts["/workspace"]["source"] == str(src)
+    assert "ro" in mounts["/workspace"]["options"]
+
+    # detector reporting no buildpack groups = unsupported
+    (fake_runc_tools / "runc-output").write_text(
+        "ERROR: No buildpack groups passed detection.")
+    assert p.is_builder_supported(str(src), "gcr.io/buildpacks/builder") is False
+
+
+def test_runc_provider_buildpack_listing_via_skopeo(fake_runc_tools, tmp_path):
+    p = cnb_providers.RuncProvider(cache_dir=str(tmp_path / "cache"))
+    assert p.get_all_buildpacks(["b"]) == {"b": ["google.go"]}
+
+
+def test_runc_provider_negative_caches_failed_fetch(fake_runc_tools, tmp_path):
+    """An offline host must pay the skopeo timeout once per builder, not
+    once per probe (the chain then falls through to the next provider)."""
+    (fake_runc_tools / "copy-fail").write_text("")
+    p = cnb_providers.RuncProvider(cache_dir=str(tmp_path / "cache"))
+    src = tmp_path / "src"
+    src.mkdir()
+    assert p.is_builder_supported(str(src), "b") is False
+    assert p.is_builder_supported(str(src), "b") is False
+    copies = (fake_runc_tools / "copy-count").read_text().count("x")
+    assert copies == 1
+
+
+def test_runc_provider_recovers_from_corrupt_bundle(fake_runc_tools, tmp_path):
+    """A truncated config.json from an interrupted fetch must trigger a
+    clean re-fetch, not permanently disable the builder."""
+    cache = tmp_path / "cache"
+    p = cnb_providers.RuncProvider(cache_dir=str(cache))
+    bundle = cache / "bundles" / "b"
+    bundle.mkdir(parents=True)
+    (bundle / "config.json").write_text("{truncated")
+    src = tmp_path / "src"
+    src.mkdir()
+    assert p.is_builder_supported(str(src), "b") is True  # re-fetched
+
+
+def test_runc_provider_unavailable_without_binaries(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATH", str(tmp_path))  # empty PATH: no tools
+    p = cnb_providers.RuncProvider(cache_dir=str(tmp_path / "cache"))
+    assert p.is_available() is False
 
 
 def test_chain_falls_through_dead_docker_api_to_static(tmp_path):
