@@ -35,13 +35,15 @@ def run_parent_with(monkeypatch, capsys, script, requested=("resnet", "bert", "p
     clock = FakeTime()
     calls = []
 
-    def fake_spawn(phases, timeout, results, fails, errors):
+    def fake_spawn(phases, timeout, results, fails, errors, env=None):
         idx = len(calls)
         calls.append(list(phases))
         clock.sleep(100.0)
         out = script[idx] if idx < len(script) else ""
         bench._harvest(out, results, fails)
-        errors.append("rc=0" if idx < len(script) else "timeout")
+        what = "rc=0" if idx < len(script) else "timeout=100s"
+        errors.append(what)
+        return what
 
     monkeypatch.setattr(bench, "_spawn", fake_spawn)
     monkeypatch.setattr(bench, "time", clock)
@@ -116,3 +118,30 @@ def test_primary_phase_failure_reports_phase_failed(monkeypatch, capsys):
                                      requested=("resnet",))
     assert out["value"] == 0
     assert out["extra"]["status"] == "phase_failed"
+
+
+def test_cpu_phases_split_into_their_own_child(monkeypatch, capsys):
+    """translate runs in a separate (tunnel-immune) child after the TPU
+    phases, and its result survives a TPU child that hangs forever."""
+    script = ["",                    # tpu child "hangs" (no output)
+              _result("translate"),  # cpu child succeeds immediately
+              ""]                    # tpu retry hangs again...
+    rc, out, calls = run_parent_with(monkeypatch, capsys, script,
+                                     requested=("resnet", "translate"))
+    assert calls[0] == ["resnet"]
+    assert calls[1] == ["translate"]
+    assert all(c == ["resnet"] for c in calls[2:])  # only tpu retries remain
+    assert out["metric"] == "resnet50_train_throughput_v5e1"
+    assert out["value"] == 0  # tpu never came up...
+    assert out["extra"]["translate"]["value"] == 100.0  # ...translate did
+
+
+def test_hung_cpu_phase_does_not_eat_tpu_retries(monkeypatch, capsys):
+    """A CPU child that times out is deterministic: translate is dropped
+    after one timeout and every further attempt goes to the TPU phases."""
+    script = [_result("resnet")]  # tpu succeeds; cpu child then times out
+    rc, out, calls = run_parent_with(monkeypatch, capsys, script,
+                                     requested=("resnet", "translate"))
+    assert calls == [["resnet"], ["translate"]]  # no translate retry
+    assert out["value"] == 100.0
+    assert out["extra"]["translate"]["status"] == "failed"
