@@ -100,17 +100,17 @@ def _tpu_resources(svc: Service, workload_kind: str = JOB_SET) -> None:
 
 
 def _chips_per_host(topology: str, num_hosts: int) -> int:
+    from move2kube_tpu.source.gpu_detect import (
+        CHIPS_PER_HOST, topology_chip_count)
+
     try:
-        chips = 1
-        for dim in topology.split("x"):
-            chips *= int(dim)
-        return max(1, chips // max(1, num_hosts))
+        return max(1, topology_chip_count(topology) // max(1, num_hosts))
     except (ValueError, AttributeError):
         log.warning(
-            "malformed TPU topology %r; falling back to 4 chips per host "
+            "malformed TPU topology %r; falling back to %d chips per host "
             "(google.com/tpu resource limits may not match the node pool)",
-            topology)
-        return 4
+            topology, CHIPS_PER_HOST)
+        return CHIPS_PER_HOST
 
 
 class DeploymentAPIResource(APIResource):

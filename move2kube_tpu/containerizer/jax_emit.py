@@ -56,6 +56,56 @@ def _vendor_package(container: Container) -> None:
                 container.add_file(f"move2kube_tpu/{sub}/{fname}", f.read())
 
 
+TPU_ACCELERATOR_OPTIONS = [
+    "tpu-v5-lite-podslice", "tpu-v5p-slice", "tpu-v4-podslice",
+    "tpu-v6e-slice",
+]
+
+
+def _ask_tpu_slice(name: str, acc: AcceleratorInfo) -> None:
+    """TPU slice choice is a QA problem like every other decision
+    (reference philosophy: all runtime decisions are Problems —
+    engine.go fetch chain). Defaults keep headless runs identical to
+    detection; interactive/REST/cache answers override the slice,
+    resize the host count, and rescale the chip count the emitted
+    trainer's mesh is derived from (callers must ask BEFORE computing
+    the mesh)."""
+    from move2kube_tpu import qa
+    from move2kube_tpu.source.gpu_detect import (
+        CHIPS_PER_HOST, topology_chip_count)
+
+    detected_acc = acc.tpu_accelerator or "tpu-v5-lite-podslice"
+    detected_topo = acc.tpu_topology or "1x1"
+    options = list(TPU_ACCELERATOR_OPTIONS)
+    if detected_acc not in options:
+        options.insert(0, detected_acc)
+    chosen_acc = qa.fetch_select(
+        f"m2kt.services.{name}.tpu.accelerator",
+        f"Select the TPU accelerator for GPU service [{name}]",
+        ["Detected from the workload's GPU parallelism; override to retarget"],
+        detected_acc, options)
+    chosen_topo = qa.fetch_input(
+        f"m2kt.services.{name}.tpu.topology",
+        f"Enter the TPU topology for [{name}] (e.g. 2x4, 4x4x4)",
+        ["chips = product of the dims; one host per 4 chips"],
+        detected_topo)
+    if chosen_acc == detected_acc and chosen_topo == detected_topo:
+        return
+    try:
+        chips = topology_chip_count(chosen_topo)
+    except ValueError:
+        log.warning("invalid TPU topology answer %r for %s; keeping "
+                    "detected %s/%s", chosen_topo, name, detected_acc,
+                    detected_topo)
+        return
+    acc.tpu_accelerator = chosen_acc
+    acc.tpu_topology = chosen_topo
+    acc.num_hosts = max(1, chips // CHIPS_PER_HOST)
+    # the emitted trainer's mesh must cover the chosen slice, not the
+    # originally detected GPU count
+    acc.gpu_count = chips
+
+
 def emit_container(service: PlanService, plan=None) -> Container:
     acc = service.accelerator or AcceleratorInfo()
     family = (service.containerization_target_options[0]
@@ -63,6 +113,11 @@ def emit_container(service: PlanService, plan=None) -> Container:
               else acc.model_family) or "generic"
     if family not in KNOWN_FAMILIES:
         family = "generic"
+
+    name = common.make_dns_label(service.service_name)
+    # ask for the slice BEFORE sizing the mesh: an override rescales
+    # acc.gpu_count so the emitted mesh covers the chosen topology
+    _ask_tpu_slice(name, acc)
 
     # MoE only exists in the decoder-LM family; elsewhere detected expert
     # settings would shape a mesh the trainer can't use
@@ -96,7 +151,6 @@ def emit_container(service: PlanService, plan=None) -> Container:
         expert_parallel=acc.parallelism.get("ep", 1) if moe_experts else 1,
     )
 
-    name = common.make_dns_label(service.service_name)
     image_name = service.image or f"{name}:latest"
     container = Container(
         image_names=[image_name],

@@ -352,6 +352,19 @@ def map_gpu_to_tpu(gpu_count: int, zero_stage: int = 0) -> tuple[str, str, int]:
 
 MAX_SLICE_CHIPS = 256  # largest single-slice topology in the table
 MAX_SLICES = 8
+# default host granularity for topologies outside the table (all table
+# entries today use 4-chip hosts; single owner for that assumption)
+CHIPS_PER_HOST = 4
+
+
+def topology_chip_count(topology: str) -> int:
+    """Chip count of an NxM[xK] topology string; raises ValueError when
+    malformed. Single owner of topology parsing (used by the apiresource
+    sizing and the QA slice override)."""
+    chips = 1
+    for dim in str(topology).split("x"):
+        chips *= int(dim)
+    return chips
 
 
 def map_gpu_to_tpu_multislice(

@@ -116,6 +116,53 @@ def test_translate_deepspeed_moe(tmp_path):
     assert (cdir / "move2kube_tpu" / "models" / "moe.py").exists()
 
 
+def test_tpu_slice_is_a_qa_problem(tmp_path):
+    """Accelerator/topology are QA problems: a cached answer retargets
+    the JobSet to a different slice (and resizes the host count) with no
+    code or plan change."""
+    import yaml as _yaml
+
+    from move2kube_tpu.qa.cache import Cache
+    from move2kube_tpu.qa.problem import Problem
+
+    cache_path = tmp_path / "answers.yaml"
+    cache = Cache(path=str(cache_path))
+    # cache matching is description-based with [bracketed] wildcards
+    # (problem.matches, parity with the reference's matchString)
+    p1 = Problem.select(
+        "m2kt.services.resnet.tpu.accelerator",
+        "Select the TPU accelerator for GPU service [resnet]",
+        [], "tpu-v5-lite-podslice",
+        ["tpu-v5-lite-podslice", "tpu-v5p-slice"])
+    p1.set_answer("tpu-v5p-slice")
+    cache.add_solution(p1)
+    p2 = Problem.input(
+        "m2kt.services.resnet.tpu.topology",
+        "Enter the TPU topology for [resnet] (e.g. 2x4, 4x4x4)", [])
+    p2.set_answer("4x4x4")
+    cache.add_solution(p2)
+
+    res = run_cli("translate", "-s", os.path.join(SAMPLES, "gpu-training"),
+                  "-o", "out", "--qa-skip", "--qa-cache", str(cache_path),
+                  cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    jobset = _yaml.safe_load(
+        open(tmp_path / "out" / "gpu-training" / "resnet-jobset.yaml"))
+    pod = (jobset["spec"]["replicatedJobs"][0]["template"]["spec"]
+           ["template"]["spec"])
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == \
+        "tpu-v5p-slice"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4x4"
+    # 64 chips / 4 per host = 16 hosts
+    assert jobset["spec"]["replicatedJobs"][0]["template"]["spec"][
+        "parallelism"] == 16
+    # the emitted trainer's mesh covers the chosen 64-chip slice, not the
+    # originally detected 8 GPUs
+    train_src = (tmp_path / "out" / "containers" / "resnet"
+                 / "train_tpu.py").read_text()
+    assert 'M2KT_MESH_DATA", "64"' in train_src
+
+
 def test_translate_megatron_pipeline(tmp_path):
     """Megatron pp=2 WITHOUT ZeRO -> staged GPipe trainer over a real pipe
     mesh axis (models/llama_pipe.py), not folded into fsdp."""
