@@ -102,8 +102,11 @@ def _ask_tpu_slice(name: str, acc: AcceleratorInfo) -> None:
     acc.tpu_topology = chosen_topo
     acc.num_hosts = max(1, chips // CHIPS_PER_HOST)
     # the emitted trainer's mesh must cover the chosen slice, not the
-    # originally detected GPU count
+    # originally detected GPU count; the answer describes ONE slice, so a
+    # multi-slice detection collapses to it (keeping stale num_slices
+    # would schedule N replicas of the new slice against a 1-slice mesh)
     acc.gpu_count = chips
+    acc.num_slices = 1
 
 
 def emit_container(service: PlanService, plan=None) -> Container:
@@ -152,6 +155,17 @@ def emit_container(service: PlanService, plan=None) -> Container:
     )
 
     image_name = service.image or f"{name}:latest"
+    # HF GPT-2 fine-tunes (family gpt, no model parallelism) emit the
+    # true GPT-2 architecture so port_weights can load real
+    # GPT2LMHeadModel checkpoints; Megatron-style parallel gpt workloads
+    # keep the Llama-class trainer (architecture fidelity is irrelevant
+    # for a from-scratch pretrain, the parallelism mapping is not)
+    emit_family = family
+    if (family == "gpt" and not moe_experts and pp <= 1
+            and acc.parallelism.get("tp", 1) <= 1
+            and acc.parallelism.get("sp", 1) <= 1):
+        emit_family = "gpt2"
+
     container = Container(
         image_names=[image_name],
         new=True,
@@ -179,7 +193,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
             "frameworks": ",".join(acc.frameworks) or "unknown",
             "backend": acc.distributed_backend,
             "gpu_count": acc.gpu_count,
-            "family": family,
+            "family": emit_family,
             "tpu_accelerator": acc.tpu_accelerator or "tpu-v5-lite-podslice",
             "tpu_topology": acc.tpu_topology or "1x1",
             "num_hosts": acc.num_hosts,
@@ -192,7 +206,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
     with open(os.path.join(_ASSETS, "port_weights.py"), encoding="utf-8") as f:
         container.add_file(
             "port_weights.py",
-            common.render_template(f.read(), {"family": family}),
+            common.render_template(f.read(), {"family": emit_family}),
         )
     _vendor_package(container)
     with open(os.path.join(_ASSETS, "Dockerfile"), encoding="utf-8") as f:

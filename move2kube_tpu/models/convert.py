@@ -28,7 +28,12 @@ _LAYER_RES = {
     # ('bert.encoder.layer.0...', 'model.layers.0...')
     "bert": re.compile(r"(?:^|\.)encoder\.layer\.(\d+)\."),
     "llama": re.compile(r"(?:^|\.)layers\.(\d+)\."),
+    # family 'gpt' (Megatron-style, ported via the llama converter) must
+    # NOT match HF GPT-2's 'h.N' keys: a clear "no layer keys" ValueError
+    # beats a KeyError deep inside llama_params_from_torch; HF GPT-2
+    # checkpoints go through the 'gpt2' entry
     "gpt": re.compile(r"(?:^|\.)layers\.(\d+)\."),
+    "gpt2": re.compile(r"(?:^|\.)h\.(\d+)\."),
 }
 
 
@@ -134,6 +139,40 @@ def llama_params_from_torch(state_dict: dict, num_layers: int) -> dict:
         params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T}
     elif pre + "embed_tokens.weight" in sd:  # tied embeddings
         params["lm_head"] = {"kernel": _np(sd[pre + "embed_tokens.weight"]).T}
+    return params
+
+
+def gpt2_params_from_torch(state_dict: dict, num_layers: int) -> dict:
+    """HF ``GPT2LMHeadModel`` (or bare ``GPT2Model``) state_dict ->
+    models/gpt2.py GPT2 params.
+
+    HF GPT-2 uses Conv1D modules storing weights ``[in, out]`` — the SAME
+    orientation as a flax Dense kernel, so unlike Linear they are NOT
+    transposed. The LM head is tied to wte in both models, so no separate
+    head tensor is ported."""
+    sd = dict(state_dict)
+    pre = ("transformer."
+           if any(k.startswith("transformer.") for k in sd) else "")
+
+    def conv1d(prefix: str) -> dict:
+        return {"kernel": _np(sd[prefix + ".weight"]),
+                "bias": _np(sd[prefix + ".bias"])}
+
+    params: dict = {
+        "wte": {"embedding": _np(sd[pre + "wte.weight"])},
+        "wpe": {"embedding": _np(sd[pre + "wpe.weight"])},
+        "ln_f": _layernorm(sd, pre + "ln_f"),
+    }
+    for i in range(num_layers):
+        lp = f"{pre}h.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": _layernorm(sd, lp + "ln_1"),
+            "c_attn": conv1d(lp + "attn.c_attn"),
+            "attn_out": conv1d(lp + "attn.c_proj"),
+            "ln_2": _layernorm(sd, lp + "ln_2"),
+            "c_fc": conv1d(lp + "mlp.c_fc"),
+            "mlp_out": conv1d(lp + "mlp.c_proj"),
+        }
     return params
 
 

@@ -1,0 +1,98 @@
+"""GPT-2 decoder in Flax, TPU-first.
+
+Emission target for detected HF GPT-2 fine-tunes (gpu_detect family
+``gpt`` with no model parallelism — jax_emit maps those to this model so
+``port_weights.py`` can load real ``GPT2LMHeadModel`` checkpoints;
+Megatron-style parallel GPT workloads keep the Llama-class trainer).
+
+Architecture follows HF ``transformers`` GPT-2 exactly so converted
+weights reproduce its logits (tests/test_convert.py): learned positional
+embeddings, pre-LN blocks, fused c_attn projection, tanh-approx GELU,
+LM head tied to the token embedding.
+
+TPU notes: LayerNorm/softmax in float32, matmuls in bfloat16 on the MXU;
+attention goes through ops/attention.py (Pallas flash kernel on TPU for
+tile-friendly shapes, jnp reference elsewhere).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from move2kube_tpu.ops.attention import flash_attention
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    d_model: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+
+def gpt2_small() -> GPT2Config:
+    return GPT2Config()
+
+
+def gpt2_tiny() -> GPT2Config:
+    """Small variant for tests / dry-runs."""
+    return GPT2Config(vocab_size=256, n_positions=64, d_model=64,
+                      num_layers=2, num_heads=4)
+
+
+class GPT2Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, s, d = x.shape
+        head_dim = d // cfg.num_heads
+
+        h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         name="ln_1")(x)
+        # fused qkv, HF Conv1D layout [in, 3*d] == flax Dense kernel
+        qkv = nn.Dense(3 * d, dtype=cfg.dtype, name="c_attn")(h.astype(cfg.dtype))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.num_heads, head_dim)
+        k = k.reshape(b, s, cfg.num_heads, head_dim)
+        v = v.reshape(b, s, cfg.num_heads, head_dim)
+        o = flash_attention(q, k, v, causal=True).reshape(b, s, d)
+        o = nn.Dense(d, dtype=cfg.dtype, name="attn_out")(o)
+        x = x + o
+
+        h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         name="ln_2")(x)
+        h = nn.Dense(4 * d, dtype=cfg.dtype, name="c_fc")(h.astype(cfg.dtype))
+        h = nn.gelu(h, approximate=True)  # HF gelu_new
+        h = nn.Dense(d, dtype=cfg.dtype, name="mlp_out")(h)
+        return x + h
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                       name="wte")
+        wpe = nn.Embed(cfg.n_positions, cfg.d_model, dtype=cfg.dtype,
+                       name="wpe")
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        x = wte(input_ids) + wpe(positions)
+        for i in range(cfg.num_layers):
+            x = GPT2Block(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         name="ln_f")(x)
+        # LM head tied to the token embedding (HF GPT2LMHeadModel ties)
+        logits = x.astype(jnp.float32) @ wte.embedding.astype(jnp.float32).T
+        return logits

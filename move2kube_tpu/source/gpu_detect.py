@@ -359,11 +359,14 @@ CHIPS_PER_HOST = 4
 
 def topology_chip_count(topology: str) -> int:
     """Chip count of an NxM[xK] topology string; raises ValueError when
-    malformed. Single owner of topology parsing (used by the apiresource
-    sizing and the QA slice override)."""
+    malformed (incl. non-positive dims). Single owner of topology parsing
+    (used by the apiresource sizing and the QA slice override)."""
     chips = 1
-    for dim in str(topology).split("x"):
-        chips *= int(dim)
+    for dim_str in str(topology).split("x"):
+        dim = int(dim_str)
+        if dim <= 0:
+            raise ValueError(f"non-positive topology dim {dim} in {topology!r}")
+        chips *= dim
     return chips
 
 

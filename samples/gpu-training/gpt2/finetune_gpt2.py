@@ -1,0 +1,23 @@
+"""HF GPT-2 causal-LM fine-tune (GPU source; translation input)."""
+import torch
+import torch.distributed as dist
+from torch.nn.parallel import DistributedDataParallel
+from transformers import GPT2LMHeadModel
+
+
+def main():
+    dist.init_process_group(backend="nccl")
+    torch.cuda.set_device(dist.get_rank() % torch.cuda.device_count())
+    model = GPT2LMHeadModel.from_pretrained("gpt2").cuda()
+    model = DistributedDataParallel(model)
+    optimizer = torch.optim.AdamW(model.parameters(), lr=5e-5)
+    for step in range(1000):
+        batch = torch.randint(0, 50257, (8, 1024)).cuda()
+        loss = model(input_ids=batch, labels=batch).loss
+        loss.backward()
+        optimizer.step()
+        optimizer.zero_grad()
+
+
+if __name__ == "__main__":
+    main()

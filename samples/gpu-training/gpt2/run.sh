@@ -1,0 +1,5 @@
+#!/bin/sh
+# HF GPT-2 fine-tune on a DDP node: pure data parallelism, no model
+# parallelism -- the translated trainer keeps the true GPT-2 architecture
+# so the GPU checkpoint ports onto it.
+torchrun --nproc_per_node 8 finetune_gpt2.py

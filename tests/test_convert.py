@@ -66,6 +66,28 @@ def test_llama_logits_match_hf():
     np.testing.assert_allclose(np.asarray(out), ref, atol=3e-4)
 
 
+def test_gpt2_logits_match_hf():
+    transformers = pytest.importorskip("transformers")
+
+    from move2kube_tpu.models.gpt2 import GPT2, GPT2Config
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4)
+    with torch.no_grad():
+        hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+        ids = torch.randint(0, 256, (2, 16))
+        ref = hf(input_ids=ids).logits.numpy()
+
+    ours = GPT2(GPT2Config(vocab_size=256, n_positions=64, d_model=64,
+                           num_layers=2, num_heads=4, dtype=jnp.float32))
+    sd = hf.state_dict()
+    params = m2kt_convert.gpt2_params_from_torch(
+        sd, num_layers=m2kt_convert.infer_num_layers(sd, "gpt2"))
+    out = ours.apply({"params": jax.tree.map(jnp.asarray, params)},
+                     jnp.asarray(ids.numpy()))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-4)
+
+
 def _fabricate_tv_resnet50_sd(num_classes: int = 10, seed: int = 0) -> dict:
     """A random-valued state_dict with torchvision resnet50's exact names
     and shapes (plain numpy; no torch/torchvision needed)."""

@@ -222,6 +222,44 @@ def test_emitted_pipeline_program_runs(tmp_path):
     assert "[m2kt] done" in run.stdout
 
 
+def test_translate_gpt2_finetune_emits_true_gpt2(tmp_path):
+    """HF GPT-2 DDP fine-tune (no model parallelism) -> the true GPT-2
+    architecture (portable checkpoints), pure data-parallel mesh; the
+    emitted program executes on the CPU mesh."""
+    res = run_cli("translate",
+                  "-s", os.path.join(SAMPLES, "gpu-training", "gpt2"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    cdir = tmp_path / "out" / "containers" / "gpt2"
+    train_src = (cdir / "train_tpu.py").read_text()
+    assert "GPT2Config" in train_src
+    assert "LlamaConfig" not in train_src
+    assert 'M2KT_MESH_DATA", "8"' in train_src  # pure DDP -> 8-way data
+    assert (cdir / "move2kube_tpu" / "models" / "gpt2.py").exists()
+    port = (cdir / "port_weights.py").read_text()
+    assert 'family = "gpt2"' in port
+    assert "gpt2_params_from_torch" in port
+
+    env = dict(
+        os.environ,
+        M2KT_STEPS="2", M2KT_BATCH_PER_DEVICE="1", M2KT_SEQ_LEN="32",
+        M2KT_VOCAB="256", M2KT_DMODEL="64", M2KT_LAYERS="2",
+        M2KT_HEADS="4",
+        M2KT_MESH_DATA="8", M2KT_MESH_FSDP="1", M2KT_MESH_PIPE="1",
+        M2KT_MESH_TENSOR="1", M2KT_MESH_SEQ="1", M2KT_MESH_EXPERT="1",
+        JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    run = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import runpy; runpy.run_path('train_tpu.py', run_name='__main__')"],
+        cwd=str(cdir), env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+    assert "[m2kt] done" in run.stdout
+
+
 def test_translate_ulysses_sequence_parallel(tmp_path):
     """DeepSpeed-Ulysses sp=4 -> seq mesh axis + ring attention in the
     emitted trainer (SURVEY §5 long-context emission obligation)."""
