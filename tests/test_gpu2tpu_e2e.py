@@ -332,9 +332,29 @@ def test_translate_bert_finetune(tmp_path):
 
     cdir = out / "containers" / "bert"
     train_src = (cdir / "train_tpu.py").read_text()
-    assert "bert_base" in train_src
+    assert "BertEncoder" in train_src
     assert "make_bert_train_step" in train_src
     assert 'M2KT_MESH_DATA", "8"' in train_src  # pure DDP -> 8-way data
     assert (cdir / "move2kube_tpu" / "models" / "bert.py").exists()
     port = (cdir / "port_weights.py").read_text()
     assert 'family = "bert"' in port  # fine-tune resumes from GPU weights
+
+    # the emitted fine-tune program executes (CPU mesh, tiny shapes)
+    env = dict(
+        os.environ,
+        M2KT_STEPS="2", M2KT_BATCH_PER_DEVICE="1", M2KT_SEQ_LEN="16",
+        M2KT_NUM_CLASSES="2", M2KT_VOCAB="512", M2KT_LAYERS="2",
+        M2KT_HEADS="2", M2KT_DMODEL="64", M2KT_MLP_DIM="128",
+        M2KT_MESH_DATA="8", M2KT_MESH_FSDP="1", M2KT_MESH_PIPE="1",
+        M2KT_MESH_TENSOR="1", M2KT_MESH_SEQ="1", M2KT_MESH_EXPERT="1",
+        JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    run = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import runpy; runpy.run_path('train_tpu.py', run_name='__main__')"],
+        cwd=str(cdir), env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+    assert "[m2kt] done" in run.stdout
