@@ -104,6 +104,31 @@ def _measure(step, state, batches, items_per_step: int):
     return throughput, final_loss
 
 
+def _is_oom(e: Exception) -> bool:
+    return "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+
+
+def _with_batch_fallback(measure_at, batch: int, min_batch: int = 32,
+                         phase: str = ""):
+    """Run ``measure_at(batch)``, halving the batch on device OOM — a too-
+    ambitious default batch must degrade the number, not zero it. Each
+    halving is announced on stdout (OOMBATCH line) so the parent can
+    restart a timed-out child directly at the reduced batch instead of
+    replaying the known-OOM sizes."""
+    while True:
+        try:
+            return measure_at(batch), batch
+        except Exception as e:  # noqa: BLE001 - only OOM is retryable
+            if not _is_oom(e) or batch // 2 < min_batch:
+                raise
+            batch //= 2
+            if phase:
+                print("OOMBATCH " + json.dumps(
+                    {"phase": phase, "batch": batch}), flush=True)
+            print(f"[bench] OOM; retrying {phase} at batch {batch}",
+                  file=sys.stderr)
+
+
 def bench_resnet(n: int) -> dict:
     import jax
     import jax.numpy as jnp
@@ -114,26 +139,32 @@ def bench_resnet(n: int) -> dict:
     from move2kube_tpu.models.resnet import resnet50
     from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
 
-    batch, image = RESNET_BATCH, RESNET_IMAGE
+    image = RESNET_IMAGE
     mesh = make_mesh(MeshConfig(data=n))
     model = resnet50(num_classes=1000)
-    state = m2kt_train.create_sharded_state(
-        jax.random.PRNGKey(0), model,
-        {"x": jnp.zeros((batch, image, image, 3), jnp.bfloat16), "train": False},
-        optax.sgd(0.1, momentum=0.9), mesh, has_batch_stats=True,
-    )
-    step = m2kt_train.make_classifier_train_step(
-        mesh, has_batch_stats=True, scan_steps=SCAN_STEPS)
-    gen = np.random.default_rng(0)
-    # bf16 input batch: halves host->device and HBM traffic vs f32
-    batches = {
-        "input": jnp.asarray(
-            gen.random((SCAN_STEPS, batch, image, image, 3), np.float32),
-            jnp.bfloat16),
-        "label": jnp.asarray(
-            gen.integers(0, 1000, (SCAN_STEPS, batch)), jnp.int32),
-    }
-    img_s, loss = _measure(step, state, batches, batch)
+
+    def measure_at(batch: int):
+        state = m2kt_train.create_sharded_state(
+            jax.random.PRNGKey(0), model,
+            {"x": jnp.zeros((batch, image, image, 3), jnp.bfloat16),
+             "train": False},
+            optax.sgd(0.1, momentum=0.9), mesh, has_batch_stats=True,
+        )
+        step = m2kt_train.make_classifier_train_step(
+            mesh, has_batch_stats=True, scan_steps=SCAN_STEPS)
+        gen = np.random.default_rng(0)
+        # bf16 input batch: halves host->device and HBM traffic vs f32
+        batches = {
+            "input": jnp.asarray(
+                gen.random((SCAN_STEPS, batch, image, image, 3), np.float32),
+                jnp.bfloat16),
+            "label": jnp.asarray(
+                gen.integers(0, 1000, (SCAN_STEPS, batch)), jnp.int32),
+        }
+        return _measure(step, state, batches, batch)
+
+    (img_s, loss), batch = _with_batch_fallback(measure_at, RESNET_BATCH,
+                                                phase="resnet")
     mfu = img_s * RESNET50_FLOPS_PER_IMG / V5E_PEAK_BF16_FLOPS
     print(f"[bench] resnet loss={loss:.3f} mfu={mfu:.1%}", file=sys.stderr)
     metric, unit = PHASE_METRICS["resnet"]
@@ -143,6 +174,7 @@ def bench_resnet(n: int) -> dict:
         "value": round(img_s, 1),
         "unit": unit,
         "mfu": round(mfu, 4),
+        "batch": batch,
         "vs_baseline": round(img_s / RESNET_ANCHOR, 3),
     }
 
@@ -157,23 +189,29 @@ def bench_bert(n: int) -> dict:
     from move2kube_tpu.models.bert import bert_base
     from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
 
-    batch = BERT_BATCH
     mesh = make_mesh(MeshConfig(data=n))
     model = bert_base(num_classes=2)
-    ids0 = jnp.zeros((batch, BERT_SEQ), jnp.int32)
-    state = m2kt_train.create_sharded_state(
-        jax.random.PRNGKey(0), model, {"input_ids": ids0},
-        optax.adamw(2e-5), mesh,
-    )
-    step = m2kt_train.make_bert_train_step(mesh, scan_steps=SCAN_STEPS)
-    gen = np.random.default_rng(0)
-    batches = {
-        "input_ids": jnp.asarray(
-            gen.integers(0, 30522, (SCAN_STEPS, batch, BERT_SEQ)), jnp.int32),
-        "attention_mask": jnp.ones((SCAN_STEPS, batch, BERT_SEQ), bool),
-        "label": jnp.asarray(gen.integers(0, 2, (SCAN_STEPS, batch)), jnp.int32),
-    }
-    samples_s, loss = _measure(step, state, batches, batch)
+
+    def measure_at(batch: int):
+        ids0 = jnp.zeros((batch, BERT_SEQ), jnp.int32)
+        state = m2kt_train.create_sharded_state(
+            jax.random.PRNGKey(0), model, {"input_ids": ids0},
+            optax.adamw(2e-5), mesh,
+        )
+        step = m2kt_train.make_bert_train_step(mesh, scan_steps=SCAN_STEPS)
+        gen = np.random.default_rng(0)
+        batches = {
+            "input_ids": jnp.asarray(
+                gen.integers(0, 30522, (SCAN_STEPS, batch, BERT_SEQ)),
+                jnp.int32),
+            "attention_mask": jnp.ones((SCAN_STEPS, batch, BERT_SEQ), bool),
+            "label": jnp.asarray(gen.integers(0, 2, (SCAN_STEPS, batch)),
+                                 jnp.int32),
+        }
+        return _measure(step, state, batches, batch)
+
+    (samples_s, loss), batch = _with_batch_fallback(measure_at, BERT_BATCH,
+                                                    phase="bert")
     mfu = samples_s * BERT_FLOPS_PER_SAMPLE / V5E_PEAK_BF16_FLOPS
     print(f"[bench] bert loss={loss:.3f} mfu={mfu:.1%}", file=sys.stderr)
     metric, unit = PHASE_METRICS["bert"]
@@ -183,6 +221,7 @@ def bench_bert(n: int) -> dict:
         "value": round(samples_s, 1),
         "unit": unit,
         "mfu": round(mfu, 4),
+        "batch": batch,
         "vs_baseline": round(samples_s / BERT_ANCHOR, 3),
     }
 
@@ -320,7 +359,14 @@ def run_child(phases: list[str]) -> int:
 MAX_PHASE_FAILS = 2  # in-child exceptions per phase before giving up on it
 
 
-def _harvest(text: str, results: dict, fails: dict) -> None:
+# env var carrying a phase's batch size into the child (module constants
+# RESNET_BATCH/BERT_BATCH read these at import)
+PHASE_BATCH_ENV = {"resnet": "M2KT_BENCH_RESNET_BATCH",
+                   "bert": "M2KT_BENCH_BERT_BATCH"}
+
+
+def _harvest(text: str, results: dict, fails: dict,
+             oom_batches: dict | None = None) -> None:
     for line in text.splitlines():
         if line.startswith("RESULT "):
             try:
@@ -334,6 +380,14 @@ def _harvest(text: str, results: dict, fails: dict) -> None:
                 fails.setdefault(f["phase"], []).append(f.get("error", ""))
             except (json.JSONDecodeError, KeyError):
                 pass
+        elif line.startswith("OOMBATCH ") and oom_batches is not None:
+            try:
+                o = json.loads(line[len("OOMBATCH "):])
+                oom_batches[o["phase"]] = min(
+                    int(o["batch"]),
+                    oom_batches.get(o["phase"], int(o["batch"])))
+            except (json.JSONDecodeError, KeyError, ValueError):
+                pass
 
 
 def _cpu_child_env() -> dict:
@@ -345,7 +399,8 @@ def _cpu_child_env() -> dict:
 
 
 def _spawn(phases: list[str], timeout: float, results: dict, fails: dict,
-           errors: list, env: dict | None = None) -> str:
+           errors: list, env: dict | None = None,
+           oom_batches: dict | None = None) -> str:
     """Run one child; returns "rc=N" or "timeout=Ns"."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child", ",".join(phases)]
     try:
@@ -356,7 +411,7 @@ def _spawn(phases: list[str], timeout: float, results: dict, fails: dict,
         def _s(b):
             return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
         out, err, what = _s(e.stdout), _s(e.stderr), f"timeout={timeout:.0f}s"
-    _harvest(out, results, fails)
+    _harvest(out, results, fails, oom_batches)
     errors.append(what)
     tail = err.strip().splitlines()[-6:]
     for line in tail:
@@ -371,6 +426,7 @@ def run_parent(requested: list[str]) -> int:
     results: dict = {}
     fails: dict = {}    # phase -> list of in-child error strings
     errors: list = []   # per-child-attempt outcome (rc / timeout)
+    oom_batches: dict = {}  # phase -> smallest batch a child fell back to
     attempt = 0
     while True:
         # a phase that raised inside a *live* child MAX_PHASE_FAILS times
@@ -396,8 +452,16 @@ def run_parent(requested: list[str]) -> int:
         tpu_missing = [p for p in missing if p in TPU_PHASES]
         cpu_missing = [p for p in missing if p not in TPU_PHASES]
         if tpu_missing:
+            # restart a timed-out-mid-OOM-fallback child at the reduced
+            # batch instead of replaying the known-OOM sizes
+            tpu_env = None
+            if oom_batches:
+                tpu_env = dict(os.environ)
+                for phase, batch in oom_batches.items():
+                    tpu_env[PHASE_BATCH_ENV[phase]] = str(batch)
             _spawn(tpu_missing, min(CHILD_TIMEOUT_S, remaining - 10),
-                   results, fails, errors)
+                   results, fails, errors, env=tpu_env,
+                   oom_batches=oom_batches)
         if cpu_missing:
             remaining = deadline - time.perf_counter()
             if remaining < 20:

@@ -34,16 +34,21 @@ def run_parent_with(monkeypatch, capsys, script, requested=("resnet", "bert", "p
     """
     clock = FakeTime()
     calls = []
+    envs = []
 
-    def fake_spawn(phases, timeout, results, fails, errors, env=None):
+    def fake_spawn(phases, timeout, results, fails, errors, env=None,
+                   oom_batches=None):
         idx = len(calls)
         calls.append(list(phases))
+        envs.append(env)
         clock.sleep(100.0)
         out = script[idx] if idx < len(script) else ""
-        bench._harvest(out, results, fails)
+        bench._harvest(out, results, fails, oom_batches)
         what = "rc=0" if idx < len(script) else "timeout=100s"
         errors.append(what)
         return what
+
+    fake_spawn.envs = envs
 
     monkeypatch.setattr(bench, "_spawn", fake_spawn)
     monkeypatch.setattr(bench, "time", clock)
@@ -51,7 +56,7 @@ def run_parent_with(monkeypatch, capsys, script, requested=("resnet", "bert", "p
     monkeypatch.setattr(bench, "BUDGET_S", 350.0)
     rc = bench.run_parent(list(requested))
     line = capsys.readouterr().out.strip()
-    return rc, json.loads(line), calls
+    return rc, json.loads(line), calls, envs
 
 
 def _result(phase, value=100.0):
@@ -66,7 +71,7 @@ def _fail(phase, error="RuntimeError: boom"):
 
 def test_all_phases_one_attempt(monkeypatch, capsys):
     script = ["\n".join([_result("resnet"), _result("bert"), _result("pallas")])]
-    rc, out, calls = run_parent_with(monkeypatch, capsys, script)
+    rc, out, calls, envs = run_parent_with(monkeypatch, capsys, script)
     assert rc == 0
     assert out["metric"] == "resnet_metric" and out["value"] == 100.0
     assert out["extra"]["bert"]["value"] == 100.0
@@ -77,7 +82,7 @@ def test_all_phases_one_attempt(monkeypatch, capsys):
 def test_partial_results_survive_and_retry_only_missing(monkeypatch, capsys):
     script = [_result("resnet"),                      # child died after resnet
               "\n".join([_result("bert"), _result("pallas")])]
-    rc, out, calls = run_parent_with(monkeypatch, capsys, script)
+    rc, out, calls, envs = run_parent_with(monkeypatch, capsys, script)
     assert out["metric"] == "resnet_metric"
     assert calls == [["resnet", "bert", "pallas"], ["bert", "pallas"]]
     assert out["extra"]["attempts"] == 2
@@ -87,7 +92,7 @@ def test_deterministic_phase_failure_stops_after_two_strikes(monkeypatch, capsys
     script = ["\n".join([_result("resnet"), _result("bert"), _fail("pallas")]),
               _fail("pallas"),
               _fail("pallas")]  # must never be requested a third time
-    rc, out, calls = run_parent_with(monkeypatch, capsys, script)
+    rc, out, calls, envs = run_parent_with(monkeypatch, capsys, script)
     assert out["metric"] == "resnet_metric"
     assert calls == [["resnet", "bert", "pallas"], ["pallas"]]
     assert out["extra"]["pallas"]["status"] == "failed"
@@ -95,7 +100,7 @@ def test_deterministic_phase_failure_stops_after_two_strikes(monkeypatch, capsys
 
 
 def test_total_failure_still_emits_parseable_json(monkeypatch, capsys):
-    rc, out, calls = run_parent_with(monkeypatch, capsys, script=[])
+    rc, out, calls, envs = run_parent_with(monkeypatch, capsys, script=[])
     assert rc == 0
     assert out["metric"] == "resnet50_train_throughput_v5e1"
     assert out["value"] == 0 and out["vs_baseline"] == 0.0
@@ -106,7 +111,7 @@ def test_total_failure_still_emits_parseable_json(monkeypatch, capsys):
 
 def test_single_phase_request_keeps_its_own_metric(monkeypatch, capsys):
     script = [_result("bert", 250.0)]
-    rc, out, calls = run_parent_with(monkeypatch, capsys, script,
+    rc, out, calls, envs = run_parent_with(monkeypatch, capsys, script,
                                      requested=("bert",))
     assert out["metric"] == "bert_metric" and out["value"] == 250.0
     assert "resnet" not in out["extra"]
@@ -114,10 +119,53 @@ def test_single_phase_request_keeps_its_own_metric(monkeypatch, capsys):
 
 def test_primary_phase_failure_reports_phase_failed(monkeypatch, capsys):
     script = [_fail("resnet"), _fail("resnet")]
-    rc, out, calls = run_parent_with(monkeypatch, capsys, script,
+    rc, out, calls, envs = run_parent_with(monkeypatch, capsys, script,
                                      requested=("resnet",))
     assert out["value"] == 0
     assert out["extra"]["status"] == "phase_failed"
+
+
+def test_batch_fallback_halves_on_oom():
+    attempts = []
+
+    def measure_at(batch):
+        attempts.append(batch)
+        if batch > 64:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory in HBM")
+        return 123.0
+
+    result, batch = bench._with_batch_fallback(measure_at, 256)
+    assert (result, batch) == (123.0, 64)
+    assert attempts == [256, 128, 64]
+
+
+def test_batch_fallback_reraises_non_oom_and_floor():
+    import pytest
+
+    def diverged(batch):
+        raise RuntimeError("training diverged: loss=nan")
+
+    with pytest.raises(RuntimeError, match="diverged"):
+        bench._with_batch_fallback(diverged, 256)
+
+    def always_oom(batch):
+        raise RuntimeError("RESOURCE_EXHAUSTED")
+
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        bench._with_batch_fallback(always_oom, 64, min_batch=32)
+
+
+def test_oom_fallback_progress_survives_child_timeout(monkeypatch, capsys):
+    """A child that halves the batch (OOMBATCH lines) then times out must
+    be restarted AT the reduced batch, not replay the known-OOM sizes."""
+    oom = "OOMBATCH " + json.dumps({"phase": "resnet", "batch": 64})
+    script = [oom + "\n",          # child reported fallback then hung
+              _result("resnet")]   # retry (at batch 64) succeeds
+    rc, out, calls, envs = run_parent_with(monkeypatch, capsys, script,
+                                           requested=("resnet",))
+    assert out["value"] == 100.0
+    assert envs[0] is None  # first spawn: stock env
+    assert envs[1]["M2KT_BENCH_RESNET_BATCH"] == "64"
 
 
 def test_cpu_phases_split_into_their_own_child(monkeypatch, capsys):
@@ -126,7 +174,7 @@ def test_cpu_phases_split_into_their_own_child(monkeypatch, capsys):
     script = ["",                    # tpu child "hangs" (no output)
               _result("translate"),  # cpu child succeeds immediately
               ""]                    # tpu retry hangs again...
-    rc, out, calls = run_parent_with(monkeypatch, capsys, script,
+    rc, out, calls, envs = run_parent_with(monkeypatch, capsys, script,
                                      requested=("resnet", "translate"))
     assert calls[0] == ["resnet"]
     assert calls[1] == ["translate"]
@@ -140,7 +188,7 @@ def test_hung_cpu_phase_does_not_eat_tpu_retries(monkeypatch, capsys):
     """A CPU child that times out is deterministic: translate is dropped
     after one timeout and every further attempt goes to the TPU phases."""
     script = [_result("resnet")]  # tpu succeeds; cpu child then times out
-    rc, out, calls = run_parent_with(monkeypatch, capsys, script,
+    rc, out, calls, envs = run_parent_with(monkeypatch, capsys, script,
                                      requested=("resnet", "translate"))
     assert calls == [["resnet"], ["translate"]]  # no translate retry
     assert out["value"] == 100.0
