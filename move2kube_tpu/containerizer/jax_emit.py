@@ -38,7 +38,10 @@ optax
 numpy
 """
 
-KNOWN_FAMILIES = ("resnet", "bert", "llama", "gpt")
+# families accepted as containerization target options; "gpt2" may also
+# be chosen explicitly during curation (detection reports "gpt" and the
+# no-model-parallelism refinement below picks gpt2 automatically)
+KNOWN_FAMILIES = ("resnet", "bert", "llama", "gpt", "gpt2")
 
 
 def _vendor_package(container: Container) -> None:
@@ -145,11 +148,14 @@ def emit_container(service: PlanService, plan=None) -> Container:
     # the GPipe shard_map the mesh axes are manual, so block-level TP
     # would need hand-written collective matmuls rather than GSPMD
     # annotations; every device still does useful (data-parallel) work.
+    # Explicitly curated "gpt2" folds them too: models/gpt2.py carries no
+    # tensor/seq sharding annotations, so those axes would replicate work.
+    fold_tp_sp = use_pipe or family == "gpt2"
     mesh = infer_mesh_config(
         max(1, acc.gpu_count),
         zero_stage=zero if use_pipe else max(zero, 2 if pp > 1 else 0),
-        tensor_parallel=1 if use_pipe else acc.parallelism.get("tp", 1),
-        seq_parallel=1 if use_pipe else acc.parallelism.get("sp", 1),
+        tensor_parallel=1 if fold_tp_sp else acc.parallelism.get("tp", 1),
+        seq_parallel=1 if fold_tp_sp else acc.parallelism.get("sp", 1),
         pipeline_parallel=pp if use_pipe else 1,
         expert_parallel=acc.parallelism.get("ep", 1) if moe_experts else 1,
     )
@@ -200,7 +206,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
             "mesh": mesh,
             "moe_experts": moe_experts,
             "steps": 100,
-            "lr": 3e-4 if family in ("llama", "gpt") else 1e-3,
+            "lr": 3e-4 if family in ("llama", "gpt", "gpt2") else 1e-3,
         }),
     )
     with open(os.path.join(_ASSETS, "port_weights.py"), encoding="utf-8") as f:

@@ -113,19 +113,46 @@ def _flash_attention_tpu(q, k, v, causal: bool, scale: float,
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_diff(q, k, v, causal: bool, scale: float):
+    """Differentiable wrapper: Pallas kernels have no automatic reverse-
+    mode rule, so without this custom_vjp ``jax.grad`` through a training
+    step would fail at trace time on TPU."""
+    return _flash_attention_tpu(q, k, v, causal, scale)
+
+
+def _flash_diff_fwd(q, k, v, causal, scale):
+    return _flash_attention_tpu(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_diff_bwd(causal, scale, residuals, g):
+    # exact attention backward via the reference math (recompute, no
+    # saved probabilities). The [b, h, s, s] score matrix is transient
+    # and freed per layer; a fused Pallas backward kernel can replace
+    # this without touching callers.
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_attention_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None):
     """Fused attention. q/k/v: [batch, seq, heads, head_dim].
 
     Uses the Pallas kernel on TPU when shapes are tile-friendly (seq a
     multiple of 128, head_dim >= 64); otherwise the jnp reference (which
-    XLA still fuses reasonably well).
+    XLA still fuses reasonably well). Differentiable on both paths.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     on_tpu = jax.default_backend() == "tpu"
     s, d = q.shape[1], q.shape[3]
     if on_tpu and s % 128 == 0 and k.shape[1] % 128 == 0 and d % 64 == 0:
         try:
-            return _flash_attention_tpu(q, k, v, causal, scale)
+            return _flash_attention_diff(q, k, v, causal, scale)
         except Exception as e:  # noqa: BLE001 - fall back rather than fail
             logging.getLogger(__name__).warning(
                 "pallas flash attention failed (%s: %s); falling back to "
