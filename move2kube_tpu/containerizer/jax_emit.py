@@ -65,21 +65,41 @@ TPU_ACCELERATOR_OPTIONS = [
 ]
 
 
-def _ask_tpu_slice(name: str, acc: AcceleratorInfo) -> None:
+def _cluster_tpu_accelerators(plan) -> list[str]:
+    """Accelerator types the plan's target cluster actually has (collected
+    metadata or builtin profile); empty when unknown."""
+    if plan is None:
+        return []
+    try:
+        target = plan.kubernetes.target_cluster
+    except AttributeError:
+        return []
+    if not (getattr(target, "type", "") or getattr(target, "path", "")):
+        return []
+    from move2kube_tpu.metadata.clusters import resolve_target_cluster
+
+    return list(resolve_target_cluster(target).tpu_accelerators)
+
+
+def _ask_tpu_slice(name: str, acc: AcceleratorInfo, plan=None) -> None:
     """TPU slice choice is a QA problem like every other decision
     (reference philosophy: all runtime decisions are Problems —
     engine.go fetch chain). Defaults keep headless runs identical to
     detection; interactive/REST/cache answers override the slice,
     resize the host count, and rescale the chip count the emitted
     trainer's mesh is derived from (callers must ask BEFORE computing
-    the mesh)."""
+    the mesh). The target cluster's collected TPU node-pool types rank
+    first in the options (collect -> QA default flow)."""
     from move2kube_tpu import qa
     from move2kube_tpu.source.gpu_detect import (
         CHIPS_PER_HOST, topology_chip_count)
 
     detected_acc = acc.tpu_accelerator or "tpu-v5-lite-podslice"
     detected_topo = acc.tpu_topology or "1x1"
-    options = list(TPU_ACCELERATOR_OPTIONS)
+    cluster_accs = _cluster_tpu_accelerators(plan)
+    # cluster-supported types first, then the generic list
+    options = cluster_accs + [a for a in TPU_ACCELERATOR_OPTIONS
+                              if a not in cluster_accs]
     if detected_acc not in options:
         options.insert(0, detected_acc)
     chosen_acc = qa.fetch_select(
@@ -123,7 +143,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
     name = common.make_dns_label(service.service_name)
     # ask for the slice BEFORE sizing the mesh: an override rescales
     # acc.gpu_count so the emitted mesh covers the chosen topology
-    _ask_tpu_slice(name, acc)
+    _ask_tpu_slice(name, acc, plan)
 
     # MoE only exists in the decoder-LM family; elsewhere detected expert
     # settings would shape a mesh the trainer can't use

@@ -56,20 +56,8 @@ class ClusterMDLoader(Loader):
             )
 
     def load_to_ir(self, plan: Plan, ir: IR) -> None:
-        tc = plan.kubernetes.target_cluster
-        if tc.path:
-            try:
-                cm = collecttypes.read_cluster_metadata(tc.path)
-                ir.target_cluster_spec = cm.spec
-                return
-            except Exception as e:  # noqa: BLE001
-                log.warning("cannot read cluster metadata %s: %s", tc.path, e)
-        name = tc.type or clusters.DEFAULT_CLUSTER
-        cm = clusters.get_cluster(name)
-        if cm is None:
-            log.warning("unknown cluster profile %r; using %s", name, clusters.DEFAULT_CLUSTER)
-            cm = clusters.get_cluster(clusters.DEFAULT_CLUSTER)
-        ir.target_cluster_spec = cm.spec
+        ir.target_cluster_spec = clusters.resolve_target_cluster(
+            plan.kubernetes.target_cluster)
 
 
 class K8sFilesLoader(Loader):

@@ -103,3 +103,27 @@ DEFAULT_TPU_CLUSTER = "GCP-GKE-TPU"
 
 def get_cluster(name: str) -> ClusterMetadata | None:
     return builtin_clusters().get(name)
+
+
+def resolve_target_cluster(target_cluster) -> ClusterMetadataSpec:
+    """Resolve a plan TargetCluster (collected-yaml ``path`` first, then
+    builtin ``type``, with unknown-name fallback) to its spec. Single
+    owner of the resolution used by IR loading (metadata/base.py) and the
+    TPU-slice QA defaults (containerizer/jax_emit.py)."""
+    from move2kube_tpu.types import collection as collecttypes
+    from move2kube_tpu.utils.log import get_logger
+
+    log = get_logger("metadata.clusters")
+    if getattr(target_cluster, "path", ""):
+        try:
+            return collecttypes.read_cluster_metadata(target_cluster.path).spec
+        except Exception as e:  # noqa: BLE001 - fall back to builtin
+            log.warning("cannot read cluster metadata %s: %s",
+                        target_cluster.path, e)
+    name = getattr(target_cluster, "type", "") or DEFAULT_CLUSTER
+    cm = get_cluster(name)
+    if cm is None:
+        log.warning("unknown cluster profile %r; using %s", name,
+                    DEFAULT_CLUSTER)
+        cm = get_cluster(DEFAULT_CLUSTER)
+    return cm.spec

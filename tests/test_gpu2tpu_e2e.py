@@ -163,6 +163,39 @@ def test_tpu_slice_is_a_qa_problem(tmp_path):
     assert 'M2KT_MESH_DATA", "64"' in train_src
 
 
+def test_cluster_tpu_types_rank_first_in_qa_options(tmp_path):
+    """collect -> QA default flow: collected cluster metadata's TPU
+    node-pool types lead the slice QA options (path and builtin cases)."""
+    from move2kube_tpu.containerizer.jax_emit import _cluster_tpu_accelerators
+    from move2kube_tpu.types.collection import (
+        ClusterMetadata,
+        ClusterMetadataSpec,
+    )
+    from move2kube_tpu.types.plan import Plan
+    from move2kube_tpu.utils import common
+
+    # collected metadata (path case)
+    cm = ClusterMetadata(name="my-gke", spec=ClusterMetadataSpec(
+        api_kind_version_map={"Deployment": ["apps/v1"]},
+        tpu_accelerators=["tpu-v6e-slice"]))
+    path = tmp_path / "my-gke.yaml"
+    common.write_yaml(str(path), cm.to_dict())
+    plan = Plan(name="t", root_dir=str(tmp_path))
+    plan.kubernetes.target_cluster.path = str(path)
+    assert _cluster_tpu_accelerators(plan) == ["tpu-v6e-slice"]
+
+    # builtin profile (type case)
+    plan2 = Plan(name="t", root_dir=str(tmp_path))
+    plan2.kubernetes.target_cluster.type = "GCP-GKE-TPU"
+    assert "tpu-v5-lite-podslice" in _cluster_tpu_accelerators(plan2)
+
+    # non-TPU cluster / no cluster: no reordering signal
+    plan3 = Plan(name="t", root_dir=str(tmp_path))
+    plan3.kubernetes.target_cluster.type = "EKS"
+    assert _cluster_tpu_accelerators(plan3) == []
+    assert _cluster_tpu_accelerators(None) == []
+
+
 def test_translate_megatron_pipeline(tmp_path):
     """Megatron pp=2 WITHOUT ZeRO -> staged GPipe trainer over a real pipe
     mesh axis (models/llama_pipe.py), not folded into fsdp."""
