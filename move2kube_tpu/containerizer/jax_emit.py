@@ -65,6 +65,11 @@ TPU_ACCELERATOR_OPTIONS = [
 ]
 
 
+# memoised per (type, path): emission resolves the same target cluster
+# once per run, not once per service (and warns once on unreadable paths)
+_cluster_acc_cache: dict = {}
+
+
 def _cluster_tpu_accelerators(plan) -> list[str]:
     """Accelerator types the plan's target cluster actually has (collected
     metadata or builtin profile); empty when unknown."""
@@ -74,11 +79,15 @@ def _cluster_tpu_accelerators(plan) -> list[str]:
         target = plan.kubernetes.target_cluster
     except AttributeError:
         return []
-    if not (getattr(target, "type", "") or getattr(target, "path", "")):
+    key = (getattr(target, "type", ""), getattr(target, "path", ""))
+    if not any(key):
         return []
-    from move2kube_tpu.metadata.clusters import resolve_target_cluster
+    if key not in _cluster_acc_cache:
+        from move2kube_tpu.metadata.clusters import resolve_target_cluster
 
-    return list(resolve_target_cluster(target).tpu_accelerators)
+        _cluster_acc_cache[key] = list(
+            resolve_target_cluster(target).tpu_accelerators)
+    return list(_cluster_acc_cache[key])
 
 
 def _ask_tpu_slice(name: str, acc: AcceleratorInfo, plan=None) -> None:

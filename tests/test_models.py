@@ -164,6 +164,34 @@ def test_flash_attention_fallback_matches():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_pallas_flash_kernel_math_in_interpret_mode():
+    """Run the ACTUAL Pallas kernel body through the interpreter (no
+    silicon needed): blockwise online-softmax must match the reference.
+    This is the CI-side half of the kernel proof (the bench's pallas
+    phase is the on-silicon half); it caught a pl.load API removal that
+    would have silently disabled the kernel on TPU."""
+    from move2kube_tpu.ops.attention import (
+        _flash_attention_tpu,
+        _reference_attention,
+    )
+
+    b, s, h, d = 2, 256, 4, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.float32) for kk in ks)
+    scale = d ** -0.5
+    for causal in (True, False):
+        out = _flash_attention_tpu(q, k, v, causal, scale, interpret=True)
+        ref = _reference_attention(q, k, v, causal, scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+    # uneven q/kv lengths (cross-attention-ish shape)
+    k2 = jax.random.normal(ks[1], (b, 128, h, d), jnp.float32)
+    v2 = jax.random.normal(ks[2], (b, 128, h, d), jnp.float32)
+    out = _flash_attention_tpu(q, k2, v2, False, scale, interpret=True)
+    ref = _reference_attention(q, k2, v2, False, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
 def test_flash_attention_custom_vjp_matches_reference_grad(monkeypatch):
     """The Pallas kernel has no automatic reverse-mode rule; training on
     TPU goes through _flash_attention_diff's custom_vjp. Verify the vjp
