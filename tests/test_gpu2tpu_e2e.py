@@ -20,6 +20,22 @@ def run_cli(*args, cwd):
     )
 
 
+def run_emitted_program(cdir, **env_overrides):
+    """Execute an emitted train_tpu.py on the virtual 8-device CPU mesh."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        **{k: str(v) for k, v in env_overrides.items()},
+    )
+    return subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import runpy; runpy.run_path('train_tpu.py', run_name='__main__')"],
+        cwd=str(cdir), env=env, capture_output=True, text=True, timeout=600,
+    )
+
+
 def test_translate_gpu_training(tmp_path):
     res = run_cli("translate", "-s", os.path.join(SAMPLES, "gpu-training"),
                   "-o", "out", "--qa-skip", cwd=str(tmp_path))
@@ -62,22 +78,42 @@ def test_emitted_program_runs(tmp_path):
                   "-o", "out", "--qa-skip", cwd=str(tmp_path))
     assert res.returncode == 0, res.stderr
     cdir = tmp_path / "out" / "containers" / "resnet"
-    env = dict(
-        os.environ,
-        M2KT_STEPS="2", M2KT_BATCH_PER_DEVICE="1", M2KT_IMAGE_SIZE="32",
-        M2KT_NUM_CLASSES="10", M2KT_MESH_DATA="8", M2KT_MESH_FSDP="1",
-        M2KT_MESH_TENSOR="1", M2KT_MESH_SEQ="1",
-        JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=8",
-    )
-    run = subprocess.run(
-        [sys.executable, "-c",
-         "import jax; jax.config.update('jax_platforms','cpu');"
-         "import runpy; runpy.run_path('train_tpu.py', run_name='__main__')"],
-        cwd=str(cdir), env=env, capture_output=True, text=True, timeout=600,
-    )
+    run = run_emitted_program(
+        cdir, M2KT_STEPS=2, M2KT_BATCH_PER_DEVICE=1, M2KT_IMAGE_SIZE=32,
+        M2KT_NUM_CLASSES=10, M2KT_MESH_DATA=8, M2KT_MESH_FSDP=1,
+        M2KT_MESH_TENSOR=1, M2KT_MESH_SEQ=1)
     assert run.returncode == 0, run.stderr[-2000:]
     assert "[m2kt] done" in run.stdout
+
+
+def test_emitted_program_checkpoint_resume(tmp_path):
+    """JobSet preemption story end-to-end: an emitted program killed after
+    N steps must resume from its orbax checkpoint, not start over."""
+    res = run_cli("translate", "-s", os.path.join(SAMPLES, "gpu-training"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    cdir = tmp_path / "out" / "containers" / "resnet"
+    ckpt_dir = tmp_path / "ckpt"
+    base = dict(
+        M2KT_BATCH_PER_DEVICE=1, M2KT_IMAGE_SIZE=32,
+        M2KT_NUM_CLASSES=10, M2KT_MESH_DATA=8, M2KT_MESH_FSDP=1,
+        M2KT_MESH_PIPE=1, M2KT_MESH_TENSOR=1, M2KT_MESH_SEQ=1,
+        M2KT_MESH_EXPERT=1,
+        M2KT_CKPT_DIR=str(ckpt_dir), M2KT_CKPT_EVERY=1,
+    )
+
+    def run_steps(steps):
+        return run_emitted_program(cdir, M2KT_STEPS=steps, **base)
+
+    first = run_steps(2)
+    assert first.returncode == 0, first.stderr[-2000:]
+    assert "[m2kt] done" in first.stdout
+    assert "resumed" not in first.stdout
+
+    second = run_steps(4)  # simulated pod restart with a larger target
+    assert second.returncode == 0, second.stderr[-2000:]
+    assert "[m2kt] resumed from step 2" in second.stdout
+    assert "[m2kt] done" in second.stdout
 
 
 def test_graft_entry():
