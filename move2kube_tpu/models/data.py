@@ -109,19 +109,30 @@ class HostShardedLoader:
     def __iter__(self):
         return self
 
-    def __next__(self) -> dict[str, jax.Array]:
+    def _advance(self) -> np.ndarray:
         if self._cursor + self.local_batch > len(self._order):
             self._epoch += 1
             self._order = self._reshuffle()
             self._cursor = 0
         take = self._order[self._cursor:self._cursor + self.local_batch]
         self._cursor += self.local_batch
+        return take
+
+    def __next__(self) -> dict[str, jax.Array]:
+        take = self._advance()
         out = {}
         for k, v in self.arrays.items():
             local = np.ascontiguousarray(v[take])
             out[k] = jax.make_array_from_process_local_data(
                 self._sharding, local)
         return out
+
+    def skip(self, n: int) -> None:
+        """Advance the stream n batches WITHOUT materializing them —
+        resume fast-forward must be cursor arithmetic, not n host-to-
+        device transfers."""
+        for _ in range(n):
+            self._advance()
 
 
 def make_loader(path: str, global_batch: int, mesh: Mesh,
@@ -144,5 +155,8 @@ def make_loader(path: str, global_batch: int, mesh: Mesh,
             batch = synthetic_fn(self._i)
             self._i += 1
             return batch
+
+        def skip(self, n: int) -> None:
+            self._i += n
 
     return _Synthetic()

@@ -65,6 +65,28 @@ def test_synthetic_fallback(mesh):
     assert float(next(loader)["input"][0, 0]) == 1
 
 
+def test_skip_matches_consuming_without_materializing(tmp_path, mesh):
+    """Resume fast-forward: skip(n) must land the stream exactly where n
+    next() calls would, for both loader kinds (incl. across an epoch
+    reshuffle boundary)."""
+    np.savez(tmp_path / "d.npz",
+             input=np.arange(80).reshape(20, 4).astype(np.float32))
+    arrays = m2kt_data.load_arrays(str(tmp_path / "d.npz"))
+    consumed = m2kt_data.HostShardedLoader(arrays, 8, mesh, seed=3)
+    skipped = m2kt_data.HostShardedLoader(arrays, 8, mesh, seed=3)
+    n = 5  # 20 examples / batch 8 -> crosses epoch boundaries
+    for _ in range(n):
+        next(consumed)
+    skipped.skip(n)
+    np.testing.assert_array_equal(np.asarray(next(consumed)["input"]),
+                                  np.asarray(next(skipped)["input"]))
+
+    syn = m2kt_data.make_loader("", 4, mesh,
+                                synthetic_fn=lambda i: {"i": jnp.full((4,), i)})
+    syn.skip(7)
+    assert float(next(syn)["i"][0]) == 7
+
+
 def test_indivisible_batch_rejected(tmp_path, mesh):
     np.savez(tmp_path / "d.npz", input=np.zeros((8, 2)), label=np.zeros(8))
     with pytest.raises(ValueError, match="divisible|shard"):

@@ -190,6 +190,13 @@ def test_pallas_flash_kernel_math_in_interpret_mode():
     out = _flash_attention_tpu(q, k2, v2, False, scale, interpret=True)
     ref = _reference_attention(q, k2, v2, False, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+    # bf16 inputs with f32 accumulation — the dtype the bench runs on
+    # silicon; error bounded by bf16 output resolution
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    out = _flash_attention_tpu(qb, kb, vb, True, scale, interpret=True)
+    ref = _reference_attention(qb, kb, vb, True, scale)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref, dtype=np.float32), atol=2e-2)
 
 
 def test_flash_attention_custom_vjp_matches_reference_grad(monkeypatch):
