@@ -36,6 +36,7 @@ REQUIREMENTS = """jax[tpu]>=0.4.35
 flax
 optax
 numpy
+orbax-checkpoint
 """
 
 # families accepted as containerization target options; "gpt2" may also

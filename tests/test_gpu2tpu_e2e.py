@@ -60,7 +60,11 @@ def test_translate_gpu_training(tmp_path):
     # container payload: Dockerfile + train program + vendored model zoo
     cdir = out / "containers" / "resnet"
     assert (cdir / "Dockerfile").exists()
-    assert "jax" in (cdir / "requirements.txt").read_text()
+    reqs = (cdir / "requirements.txt").read_text()
+    assert "jax" in reqs
+    # checkpoint/resume is wired into every emitted loop and the JobSet
+    # injects M2KT_CKPT_DIR when a volume is mounted - orbax must ship
+    assert "orbax-checkpoint" in reqs
     train_src = (cdir / "train_tpu.py").read_text()
     assert "resnet50" in train_src
     assert "initialize_distributed" in train_src
