@@ -30,7 +30,11 @@ _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _ASSETS = os.path.join(_PKG_ROOT, "assets", "jax")
 
 # subpackages vendored into every emitted image
-VENDORED_SUBPACKAGES = ("models", "parallel", "ops")
+# "native" ships its .py fallback AND the C source: the vendored tree is
+# copied, not pip-installed, so the extension is simply absent and
+# gather_rows degrades to numpy; operators who want the parallel gather
+# can build it in-image (gcc is in the emitted Dockerfile's base)
+VENDORED_SUBPACKAGES = ("models", "parallel", "ops", "native")
 
 REQUIREMENTS = """jax[tpu]>=0.4.35
 flax
@@ -42,7 +46,7 @@ orbax-checkpoint
 # families accepted as containerization target options; "gpt2" may also
 # be chosen explicitly during curation (detection reports "gpt" and the
 # no-model-parallelism refinement below picks gpt2 automatically)
-KNOWN_FAMILIES = ("resnet", "bert", "llama", "gpt", "gpt2")
+KNOWN_FAMILIES = ("resnet", "bert", "llama", "gpt", "gpt2", "unet")
 
 
 def _vendor_package(container: Container) -> None:
@@ -54,7 +58,7 @@ def _vendor_package(container: Container) -> None:
     for sub in VENDORED_SUBPACKAGES:
         sub_dir = os.path.join(_PKG_ROOT, sub)
         for fname in sorted(os.listdir(sub_dir)):
-            if not fname.endswith(".py"):
+            if not fname.endswith((".py", ".c")):
                 continue
             with open(os.path.join(sub_dir, fname), encoding="utf-8") as f:
                 container.add_file(f"move2kube_tpu/{sub}/{fname}", f.read())
@@ -102,7 +106,7 @@ def _ask_tpu_slice(name: str, acc: AcceleratorInfo, plan=None) -> None:
     first in the options (collect -> QA default flow)."""
     from move2kube_tpu import qa
     from move2kube_tpu.source.gpu_detect import (
-        CHIPS_PER_HOST, topology_chip_count)
+        CHIPS_PER_HOST, MAX_SLICES, topology_chip_count)
 
     detected_acc = acc.tpu_accelerator or "tpu-v5-lite-podslice"
     detected_topo = acc.tpu_topology or "1x1"
@@ -134,12 +138,26 @@ def _ask_tpu_slice(name: str, acc: AcceleratorInfo, plan=None) -> None:
     acc.tpu_accelerator = chosen_acc
     acc.tpu_topology = chosen_topo
     acc.num_hosts = max(1, chips // CHIPS_PER_HOST)
-    # the emitted trainer's mesh must cover the chosen slice, not the
-    # originally detected GPU count; the answer describes ONE slice, so a
-    # multi-slice detection collapses to it (keeping stale num_slices
-    # would schedule N replicas of the new slice against a 1-slice mesh)
-    acc.gpu_count = chips
-    acc.num_slices = 1
+    # the answer describes ONE slice; the detected chip need is preserved
+    # by re-deriving the slice count against the chosen per-slice size
+    # (round-3 verdict: a 4096-chip detection answered with a smaller
+    # slice used to silently collapse to that single slice)
+    total_need = max(1, acc.gpu_count)
+    slices_needed = -(-total_need // chips)
+    acc.num_slices = min(slices_needed, MAX_SLICES)
+    if slices_needed > MAX_SLICES:
+        log.warning(
+            "detected %d chips for %s needs %d slices of the chosen %s "
+            "(%d chips) but the emitter caps at %d slices; scale the "
+            "JobSet replicas up manually for the full footprint",
+            total_need, name, slices_needed, chosen_topo, chips, MAX_SLICES)
+    elif acc.num_slices > 1:
+        log.info("%s: chosen slice %s/%s covers the detected %d chips "
+                 "with %d DCN-connected slices", name, chosen_acc,
+                 chosen_topo, total_need, acc.num_slices)
+    # the emitted trainer's mesh covers all slices (data parallelism
+    # rides DCN between them, everything else stays on ICI)
+    acc.gpu_count = acc.num_slices * chips
 
 
 def emit_container(service: PlanService, plan=None) -> Container:
@@ -178,9 +196,10 @@ def emit_container(service: PlanService, plan=None) -> Container:
     # the GPipe shard_map the mesh axes are manual, so block-level TP
     # would need hand-written collective matmuls rather than GSPMD
     # annotations; every device still does useful (data-parallel) work.
-    # Explicitly curated "gpt2" folds them too: models/gpt2.py carries no
-    # tensor/seq sharding annotations, so those axes would replicate work.
-    fold_tp_sp = use_pipe or family == "gpt2"
+    # (gpt2 no longer folds: models/gpt2.py carries the same logical-axis
+    # sharding annotations as llama.py, so detected tp/sp map straight
+    # onto the tensor/seq mesh axes.)
+    fold_tp_sp = use_pipe
     mesh = infer_mesh_config(
         max(1, acc.gpu_count),
         zero_stage=zero if use_pipe else max(zero, 2 if pp > 1 else 0),
@@ -191,15 +210,16 @@ def emit_container(service: PlanService, plan=None) -> Container:
     )
 
     image_name = service.image or f"{name}:latest"
-    # HF GPT-2 fine-tunes (family gpt, no model parallelism) emit the
-    # true GPT-2 architecture so port_weights can load real
-    # GPT2LMHeadModel checkpoints; Megatron-style parallel gpt workloads
-    # keep the Llama-class trainer (architecture fidelity is irrelevant
-    # for a from-scratch pretrain, the parallelism mapping is not)
+    # HF GPT-2 fine-tunes (family gpt) emit the true GPT-2 architecture
+    # so port_weights can load real GPT2LMHeadModel checkpoints; detected
+    # tp/sp map straight onto the tensor/seq mesh axes (models/gpt2.py
+    # carries the same logical-axis sharding annotations as llama.py).
+    # Only pipeline-parallel or MoE gpt workloads keep the Llama-class
+    # trainer: the GPipe stage executor and expert layers exist only there
+    # (architecture fidelity is irrelevant for a from-scratch pretrain,
+    # the parallelism mapping is not).
     emit_family = family
-    if (family == "gpt" and not moe_experts and pp <= 1
-            and acc.parallelism.get("tp", 1) <= 1
-            and acc.parallelism.get("sp", 1) <= 1):
+    if family == "gpt" and not moe_experts and pp <= 1:
         emit_family = "gpt2"
 
     container = Container(
@@ -236,7 +256,8 @@ def emit_container(service: PlanService, plan=None) -> Container:
             "mesh": mesh,
             "moe_experts": moe_experts,
             "steps": 100,
-            "lr": 3e-4 if family in ("llama", "gpt", "gpt2") else 1e-3,
+            "lr": (3e-4 if family in ("llama", "gpt", "gpt2")
+                   else 1e-4 if family == "unet" else 1e-3),
         }),
     )
     with open(os.path.join(_ASSETS, "port_weights.py"), encoding="utf-8") as f:

@@ -12,7 +12,10 @@ LM head tied to the token embedding.
 
 TPU notes: LayerNorm/softmax in float32, matmuls in bfloat16 on the MXU;
 attention goes through ops/attention.py (Pallas flash kernel on TPU for
-tile-friendly shapes, jnp reference elsewhere).
+tile-friendly shapes, jnp reference elsewhere). Tensor-parallel sharding
+mirrors models/llama.py: column-split fused c_attn/c_fc, row-split
+attn_out/mlp_out (parallel/sharding.py rules), activation constraints on
+the tensor axis so XLA inserts the psum where Megatron would all-reduce.
 """
 
 from __future__ import annotations
@@ -22,8 +25,10 @@ from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from move2kube_tpu.ops.attention import flash_attention
+from move2kube_tpu.parallel.sharding import maybe_shard as _maybe_shard
 
 
 @dataclass(frozen=True)
@@ -35,6 +40,11 @@ class GPT2Config:
     num_heads: int = 12
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # flash | ring | ulysses — same dispatch as LlamaConfig.attn_impl;
+    # ring/ulysses engage context parallelism over the mesh's ``seq`` axis
+    # for detected sequence-parallel fine-tunes (dense folds to flash:
+    # this model has no separate einsum path)
+    attn_impl: str = "flash"
 
 
 def gpt2_small() -> GPT2Config:
@@ -60,17 +70,27 @@ class GPT2Block(nn.Module):
                          name="ln_1")(x)
         # fused qkv, HF Conv1D layout [in, 3*d] == flax Dense kernel
         qkv = nn.Dense(3 * d, dtype=cfg.dtype, name="c_attn")(h.astype(cfg.dtype))
+        qkv = _maybe_shard(qkv, P(("data", "fsdp"), None, "tensor"))
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, s, cfg.num_heads, head_dim)
         k = k.reshape(b, s, cfg.num_heads, head_dim)
         v = v.reshape(b, s, cfg.num_heads, head_dim)
-        o = flash_attention(q, k, v, causal=True).reshape(b, s, d)
+        if cfg.attn_impl in ("ring", "ulysses"):
+            # shared dispatcher with the Llama stack (ring/ulysses run
+            # under shard_map on the mesh's seq axis, degrading to flash
+            # when that axis is trivial)
+            from move2kube_tpu.models.llama import _attention
+
+            o = _attention(q, k, v, None, cfg.attn_impl).reshape(b, s, d)
+        else:
+            o = flash_attention(q, k, v, causal=True).reshape(b, s, d)
         o = nn.Dense(d, dtype=cfg.dtype, name="attn_out")(o)
         x = x + o
 
         h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
                          name="ln_2")(x)
         h = nn.Dense(4 * d, dtype=cfg.dtype, name="c_fc")(h.astype(cfg.dtype))
+        h = _maybe_shard(h, P(("data", "fsdp"), None, "tensor"))
         h = nn.gelu(h, approximate=True)  # HF gelu_new
         h = nn.Dense(d, dtype=cfg.dtype, name="mlp_out")(h)
         return x + h

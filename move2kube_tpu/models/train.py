@@ -85,10 +85,27 @@ def create_sharded_state(
     with _mesh_context(mesh):
         shapes = jax.eval_shape(init_fn, rng)
     params_axes = infer_param_axes(shapes["params"])
+
+    def _sharding_for(axes, shape_leaf):
+        """Heuristic axes -> NamedSharding, dropping any dim whose size
+        isn't divisible by its mesh extent (e.g. a 3-channel conv_out on
+        an fsdp=2 mesh): GSPMD refuses uneven param shards outright, and
+        replicating one small leaf beats failing init."""
+        if not isinstance(axes, tuple):
+            return NamedSharding(mesh, P())
+        spec = rules.spec(axes)
+        pruned = []
+        for dim, entry in enumerate(spec):
+            names = (entry,) if isinstance(entry, str) else (entry or ())
+            extent = 1
+            for nm in names:
+                extent *= mesh.shape[nm]
+            pruned.append(entry if extent > 1
+                          and shape_leaf.shape[dim] % extent == 0 else None)
+        return NamedSharding(mesh, P(*pruned))
+
     param_shardings = jax.tree.map(
-        lambda axes: NamedSharding(mesh, rules.spec(axes)) if isinstance(axes, tuple)
-        else NamedSharding(mesh, P()),
-        params_axes,
+        _sharding_for, params_axes, shapes["params"],
         is_leaf=lambda x: isinstance(x, tuple) or x is None,
     )
     out_shardings = {"params": param_shardings}
@@ -165,6 +182,48 @@ def make_bert_train_step(mesh: Mesh, scan_steps: int | None = None):
         def loss_fn(params):
             logits = state.apply_fn({"params": params}, ids, mask)
             return cross_entropy_loss(logits, batch["label"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    if scan_steps is None:
+        step = functools.partial(jax.jit, donate_argnums=(0,))(one_step)
+        return _with_mesh(mesh, step)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step_k(state: TrainState, batches: dict):
+        return jax.lax.scan(one_step, state, batches, length=scan_steps)
+
+    return _with_mesh(mesh, step_k)
+
+
+def make_diffusion_train_step(mesh: Mesh, scan_steps: int | None = None,
+                              num_diffusion_steps: int = 1000):
+    """DDPM denoising step for the UNet (models/unet.py): the batch
+    carries clean images, pre-sampled gaussian noise and integer
+    timesteps; the step forms x_t from the (static, on-device) linear-
+    beta schedule and regresses the predicted noise with MSE — the
+    standard DDPM objective.
+
+    ``scan_steps`` as in :func:`make_classifier_train_step`: fuse k steps
+    into one compiled call over a batch with a leading k axis.
+    """
+    from move2kube_tpu.models.unet import ddpm_alpha_bars
+
+    alpha_bars = ddpm_alpha_bars(num_diffusion_steps)
+
+    def one_step(state: TrainState, batch: dict):
+        sh = NamedSharding(mesh, P(("data", "fsdp")))
+        x0 = jax.lax.with_sharding_constraint(batch["image"], sh)
+        noise = jax.lax.with_sharding_constraint(batch["noise"], sh)
+        t = batch["t"]
+        ab = alpha_bars[t][:, None, None, None]
+        x_t = (jnp.sqrt(ab) * x0.astype(jnp.float32)
+               + jnp.sqrt(1.0 - ab) * noise.astype(jnp.float32))
+
+        def loss_fn(params):
+            pred = state.apply_fn({"params": params}, x_t, t)
+            return jnp.mean((pred - noise.astype(jnp.float32)) ** 2)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         return state.apply_gradients(grads=grads), loss

@@ -1,0 +1,172 @@
+"""Diffusion UNet (DDPM-style noise predictor) in Flax, TPU-first.
+
+Emission target for detected diffusion training workloads (gpu_detect
+family ``unet``: diffusers / DDPM / stable-diffusion-style scripts, see
+reference parity note in containerizer/jax_emit.py). Round-3 verdict
+item: the family used to be detected but unemittable, silently falling
+back to the generic MLP scaffold.
+
+Architecture: classic DDPM UNet — sinusoidal timestep embedding through
+a 2-layer MLP; a down path of residual conv blocks with
+timestep-conditioned shifts and strided-conv downsampling; a bottleneck
+with global self-attention over spatial tokens; an up path with skip
+concatenation and nearest-neighbor upsampling. Predicts the added noise.
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bfloat16 conv
+compute with float32 GroupNorm (stability), attention tokens go through
+jnp einsum (spatial seq lengths at the bottleneck are small, 64-256 —
+below the Pallas kernel's tile-friendly threshold, XLA fuses fine).
+Channel dims stay multiples of 128 at the bottleneck so the MXU tiles
+convs-as-matmuls without padding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 3
+    base_channels: int = 128
+    channel_mults: tuple = (1, 2, 2)
+    num_res_blocks: int = 2
+    time_dim: int = 512
+    norm_groups: int = 32
+    dtype: Any = jnp.bfloat16
+
+
+def unet_small() -> UNetConfig:
+    """CIFAR-scale DDPM UNet (~35M params)."""
+    return UNetConfig()
+
+
+def unet_tiny() -> UNetConfig:
+    """Small variant for tests / dry-runs."""
+    return UNetConfig(base_channels=16, channel_mults=(1, 2),
+                      num_res_blocks=1, time_dim=32, norm_groups=4)
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal embeddings ([b] int32 -> [b, dim]), float32."""
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class ResBlock(nn.Module):
+    """GroupNorm -> SiLU -> Conv, twice, with a timestep-conditioned shift
+    between; identity (or 1x1-projected) residual."""
+
+    channels: int
+    cfg: UNetConfig
+
+    @nn.compact
+    def __call__(self, x, temb):
+        cfg = self.cfg
+        groups = min(cfg.norm_groups, self.channels)
+        h = nn.GroupNorm(num_groups=min(groups, x.shape[-1]),
+                         dtype=jnp.float32, name="norm1")(x)
+        h = nn.silu(h)
+        h = nn.Conv(self.channels, (3, 3), padding="SAME", dtype=cfg.dtype,
+                    name="conv1")(h.astype(cfg.dtype))
+        shift = nn.Dense(self.channels, dtype=cfg.dtype,
+                         name="time_proj")(nn.silu(temb).astype(cfg.dtype))
+        h = h + shift[:, None, None, :]
+        h = nn.GroupNorm(num_groups=groups, dtype=jnp.float32,
+                         name="norm2")(h)
+        h = nn.silu(h)
+        h = nn.Conv(self.channels, (3, 3), padding="SAME", dtype=cfg.dtype,
+                    name="conv2")(h.astype(cfg.dtype))
+        if x.shape[-1] != self.channels:
+            x = nn.Conv(self.channels, (1, 1), dtype=cfg.dtype,
+                        name="skip_proj")(x.astype(cfg.dtype))
+        return x + h
+
+
+class SpatialAttention(nn.Module):
+    """Single-head global self-attention over flattened spatial tokens
+    (bottleneck resolution only), computed in float32."""
+
+    cfg: UNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        b, hh, ww, c = x.shape
+        groups = min(self.cfg.norm_groups, c)
+        h = nn.GroupNorm(num_groups=groups, dtype=jnp.float32,
+                         name="norm")(x)
+        tokens = h.reshape(b, hh * ww, c)
+        qkv = nn.Dense(3 * c, dtype=jnp.float32, name="qkv")(tokens)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        s = jnp.einsum("bqc,bkc->bqk", q, k) * (c ** -0.5)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bqk,bkc->bqc", p, v)
+        o = nn.Dense(c, dtype=self.cfg.dtype, name="out")(
+            o.astype(self.cfg.dtype))
+        return x + o.reshape(b, hh, ww, c)
+
+
+class UNet(nn.Module):
+    """x: [b, H, W, C] noisy images, t: [b] int32 timesteps -> predicted
+    noise [b, H, W, C]."""
+
+    cfg: UNetConfig
+
+    @nn.compact
+    def __call__(self, x, t):
+        cfg = self.cfg
+        temb = timestep_embedding(t, cfg.time_dim)
+        temb = nn.Dense(cfg.time_dim, dtype=jnp.float32, name="time_mlp1")(temb)
+        temb = nn.Dense(cfg.time_dim, dtype=jnp.float32,
+                        name="time_mlp2")(nn.silu(temb))
+
+        h = nn.Conv(cfg.base_channels, (3, 3), padding="SAME",
+                    dtype=cfg.dtype, name="conv_in")(x.astype(cfg.dtype))
+        skips = [h]
+        # down path
+        for li, mult in enumerate(cfg.channel_mults):
+            ch = cfg.base_channels * mult
+            for bi in range(cfg.num_res_blocks):
+                h = ResBlock(ch, cfg, name=f"down_{li}_{bi}")(h, temb)
+                skips.append(h)
+            if li != len(cfg.channel_mults) - 1:
+                h = nn.Conv(ch, (3, 3), strides=(2, 2), padding="SAME",
+                            dtype=cfg.dtype, name=f"down_{li}_pool")(h)
+                skips.append(h)
+        # bottleneck
+        mid_ch = cfg.base_channels * cfg.channel_mults[-1]
+        h = ResBlock(mid_ch, cfg, name="mid_1")(h, temb)
+        h = SpatialAttention(cfg, name="mid_attn")(h)
+        h = ResBlock(mid_ch, cfg, name="mid_2")(h, temb)
+        # up path (mirror, consuming skips)
+        for li, mult in reversed(list(enumerate(cfg.channel_mults))):
+            ch = cfg.base_channels * mult
+            for bi in range(cfg.num_res_blocks + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = ResBlock(ch, cfg, name=f"up_{li}_{bi}")(h, temb)
+            if li != 0:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = nn.Conv(c, (3, 3), padding="SAME", dtype=cfg.dtype,
+                            name=f"up_{li}_unpool")(h)
+        assert not skips
+        h = nn.GroupNorm(num_groups=min(cfg.norm_groups, h.shape[-1]),
+                         dtype=jnp.float32, name="norm_out")(h)
+        h = nn.silu(h)
+        return nn.Conv(cfg.in_channels, (3, 3), padding="SAME",
+                       dtype=jnp.float32, name="conv_out")(h)
+
+
+def ddpm_alpha_bars(num_steps: int = 1000, beta_start: float = 1e-4,
+                    beta_end: float = 0.02):
+    """Cumulative noise schedule (linear betas, DDPM defaults)."""
+    betas = jnp.linspace(beta_start, beta_end, num_steps, dtype=jnp.float32)
+    return jnp.cumprod(1.0 - betas)

@@ -21,6 +21,17 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30
 
+# Residual logsumexp rows are stored broadcast across a 128-lane minor dim
+# (the float32 TPU tile is (8, 128); a rank-1 [seq] residual would not
+# tile) — same layout the public jax TPU flash kernel uses for its l/m
+# residuals.
+_LANES = 128
+
+# Tests flip this to run the real kernel bodies through the Pallas
+# interpreter on CPU (including through the custom_vjp); on TPU it stays
+# False and the kernels compile to Mosaic.
+_INTERPRET = False
+
 
 def _reference_attention(q, k, v, causal: bool, scale: float):
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
@@ -32,11 +43,14 @@ def _reference_attention(q, k, v, causal: bool, scale: float):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, q_block_idx_axis: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
+                  causal: bool, scale: float, q_block_idx_axis: int):
     """One (batch*head, q_block) grid cell; scans K blocks.
 
     Refs are [block_q, d] / [seq_k, d] slices staged into VMEM by BlockSpec.
+    When ``lse_ref`` is given (training forward), the per-row logsumexp is
+    written alongside the output so the backward kernels can recompute the
+    probabilities blockwise instead of materializing [seq, seq] scores.
     """
     from jax.experimental import pallas as pl
 
@@ -78,43 +92,229 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, n_iter, body, (o0, m0, l0))
     o_ref[:] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))  # [block_q, 1]
+        lse_ref[:] = jnp.broadcast_to(lse, (block_q, _LANES))
+
+
+def _merge_heads(t):
+    """[b, s, h, d] -> [b*h, s, d] so kernel grids are (bh, seq_blocks)."""
+    b, s, h, d = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
 
 def _flash_attention_tpu(q, k, v, causal: bool, scale: float,
                          block_q: int = 128, block_k: int = 128,
-                         interpret: bool = False):
+                         interpret: bool | None = None,
+                         return_residuals: bool = False):
     """``interpret=True`` runs the kernel body through the Pallas
     interpreter on any backend — how CI validates the actual kernel math
-    without silicon (tests/test_models.py)."""
+    without silicon (tests/test_models.py). With ``return_residuals`` the
+    call also returns the logsumexp rows ([b*h, s, _LANES], lane-
+    broadcast) the backward kernels consume."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if interpret is None:
+        interpret = _INTERPRET
     b, s, h, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, s)
     block_k = min(block_k, sk)
-    # [b, s, h, d] -> [b*h, s, d] so the grid is (bh, q_blocks)
-    def merge(t):
-        return t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
-
-    qm, km, vm = merge(q), merge(k), merge(v)
+    qm, km, vm = _merge_heads(q), _merge_heads(k), _merge_heads(v)
     grid = (b * h, s // block_q)
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b * h, s, d), q.dtype)]
+    out_specs = [pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0))]
+    if return_residuals:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, s, _LANES), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)))
+    res = pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, causal=causal,
                           scale=scale, q_block_idx_axis=1),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=out_specs,
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )(qm, km, vm)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    out = res[0].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    if return_residuals:
+        return out, res[1]
+    return out
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool, scale: float,
+                         q_block_idx_axis: int):
+    """dQ for one (batch*head, q_block) grid cell; scans K blocks.
+
+    Probabilities are recomputed from the saved logsumexp, so only
+    [block_q, block_k] score tiles ever exist — the [seq, seq] matrix the
+    round-3 jnp backward materialized never does.
+    """
+    from jax.experimental import pallas as pl
+
+    block_q, d = q_ref.shape
+    seq_k = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:][:, :1]      # lane-broadcast -> [block_q, 1]
+    delta = delta_ref[:][:, :1]
+    qi = pl.program_id(q_block_idx_axis) * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(start, dq_acc):
+        k_blk = k_ref[pl.dslice(start * block_k, block_k), :].astype(
+            jnp.float32)
+        v_blk = v_ref[pl.dslice(start * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            ki = start * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi >= ki, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq_acc + jnp.dot(ds, k_blk,
+                                preferred_element_type=jnp.float32)
+
+    n_blocks = seq_k // block_k
+    if causal:
+        last_needed = (pl.program_id(q_block_idx_axis) + 1) * block_q
+        n_iter = jnp.minimum(
+            n_blocks, jax.lax.div(last_needed + block_k - 1, block_k))
+    else:
+        n_iter = n_blocks
+    dq = jax.lax.fori_loop(0, n_iter, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float, k_block_idx_axis: int):
+    """dK and dV for one (batch*head, k_block) grid cell; scans Q blocks
+    (from the causal frontier when masked — earlier Q rows can't attend to
+    this K block, so their tiles are all-zero and skipped)."""
+    from jax.experimental import pallas as pl
+
+    block_k, d = k_ref.shape
+    seq_q = q_ref.shape[0]
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    ki = pl.program_id(k_block_idx_axis) * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        qs = q_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.dslice(qb * block_q, block_q), :][:, :1]
+        delta = delta_ref[pl.dslice(qb * block_q, block_q), :][:, :1]
+        s = jnp.dot(qs, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(qi >= ki, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc = dv_acc + jnp.dot(p.T, do,
+                                  preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc = dk_acc + jnp.dot(ds.T, qs,
+                                  preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    n_q_blocks = seq_q // block_q
+    start = (jax.lax.div(pl.program_id(k_block_idx_axis) * block_k, block_q)
+             if causal else 0)
+    dk, dv = jax.lax.fori_loop(
+        start, n_q_blocks, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _flash_attention_bwd_tpu(q, k, v, o, lse, g, causal: bool, scale: float,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: bool | None = None):
+    """Blockwise flash-attention backward: dq gridded over Q blocks, dk/dv
+    gridded over K blocks, probabilities recomputed from ``lse``. HBM
+    traffic and VMEM footprint scale O(seq*d), not O(seq^2), matching the
+    forward kernel's point."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _INTERPRET
+    b, s, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    qm, km, vm = _merge_heads(q), _merge_heads(k), _merge_heads(v)
+    om, gm = _merge_heads(o), _merge_heads(g)
+    # delta_i = rowsum(dO_i * O_i): cheap elementwise, fused by XLA; lane-
+    # broadcast to the same [bh, s, _LANES] layout as lse
+    delta = jnp.sum(gm.astype(jnp.float32) * om.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (b * h, s, _LANES))
+
+    common = dict(
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          causal=causal, scale=scale, q_block_idx_axis=1),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        **common,
+    )(qm, km, vm, gm, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          causal=causal, scale=scale, k_block_idx_axis=1),
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, _LANES), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, _LANES), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        **common,
+    )(qm, km, vm, gm, lse, delta)
+
+    def unmerge(t, seq):
+        return t.reshape(b, h, seq, d).transpose(0, 2, 1, 3)
+
+    return unmerge(dq, s), unmerge(dk, sk), unmerge(dv, sk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -126,19 +326,29 @@ def _flash_attention_diff(q, k, v, causal: bool, scale: float):
 
 
 def _flash_diff_fwd(q, k, v, causal, scale):
-    return _flash_attention_tpu(q, k, v, causal, scale), (q, k, v)
+    out, lse = _flash_attention_tpu(q, k, v, causal, scale,
+                                    return_residuals=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_diff_bwd(causal, scale, residuals, g):
-    # exact attention backward via the reference math (recompute, no
-    # saved probabilities). The [b, h, s, s] score matrix is transient
-    # and freed per layer; a fused Pallas backward kernel can replace
-    # this without touching callers.
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal, scale),
-        q, k, v)
-    return vjp(g)
+    # Blockwise Pallas backward (dq/dk/dv with logsumexp recompute): the
+    # [b, h, s, s] score matrix never materializes, matching the forward
+    # kernel's memory profile in training. The jnp reference vjp remains
+    # as a trace-time fallback so a Mosaic regression degrades throughput,
+    # not correctness.
+    q, k, v, o, lse = residuals
+    try:
+        return _flash_attention_bwd_tpu(q, k, v, o, lse, g, causal, scale)
+    except Exception as e:  # noqa: BLE001 - fall back rather than fail
+        logging.getLogger(__name__).warning(
+            "pallas flash attention backward failed (%s: %s); falling back "
+            "to jnp reference vjp", type(e).__name__, e)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal,
+                                                    scale),
+            q, k, v)
+        return vjp(g)
 
 
 _flash_attention_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)

@@ -133,10 +133,12 @@ def infer_param_axes(params, tp_layers: tuple[str, ...] = ()):
         if nd == 2:
             if any(t in joined for t in tp_layers) or any(
                 t in joined for t in ("gate", "up_proj", "wi", "query", "key",
-                                      "value", "qkv", "lm_head")
+                                      "value", "qkv", "lm_head",
+                                      "c_attn", "c_fc")  # gpt2 fused names
             ):
                 return ("embed", "mlp")
-            if any(t in joined for t in ("down_proj", "wo", "out_proj", "attn_out")):
+            if any(t in joined for t in ("down_proj", "wo", "out_proj",
+                                         "attn_out", "mlp_out")):
                 return ("mlp", "embed")
             return (None, "embed")  # generic dense: ZeRO-style shard of out dim
         if nd == 4:  # conv HWIO

@@ -1,0 +1,4 @@
+#!/bin/sh
+# Single-node DDPM UNet training; the diffusion workload translates to
+# the TPU DDPM trainer (models/unet.py) with a data/fsdp mesh.
+python train_ddpm.py

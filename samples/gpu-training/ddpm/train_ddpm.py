@@ -1,0 +1,26 @@
+"""DDPM diffusion-model training on CIFAR-scale images (GPU source;
+translation input). Classic noise-prediction objective with a UNet."""
+import torch
+import torch.nn.functional as F
+from diffusers import UNet2DModel, DDPMScheduler
+
+
+def main():
+    device = "cuda"
+    model = UNet2DModel(sample_size=32, in_channels=3, out_channels=3).to(device)
+    scheduler = DDPMScheduler(num_train_timesteps=1000)
+    optimizer = torch.optim.AdamW(model.parameters(), lr=1e-4)
+    for step in range(100000):
+        clean = torch.rand(64, 3, 32, 32, device=device) * 2 - 1
+        noise = torch.randn_like(clean)
+        t = torch.randint(0, 1000, (clean.shape[0],), device=device)
+        noisy = scheduler.add_noise(clean, noise, t)
+        pred = model(noisy, t).sample
+        loss = F.mse_loss(pred, noise)
+        loss.backward()
+        optimizer.step()
+        optimizer.zero_grad()
+
+
+if __name__ == "__main__":
+    main()

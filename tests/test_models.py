@@ -49,6 +49,48 @@ def test_resnet_train_step(mesh8):
     assert np.mean(losses[-2:]) < np.mean(losses[:2])  # it learns the batch
 
 
+def test_unet_diffusion_train_step(mesh8):
+    """DDPM UNet (models/unet.py): noise-prediction training on the CPU
+    mesh learns the fixed batch; skip connections and timestep
+    conditioning are exercised end-to-end."""
+    from move2kube_tpu.models.unet import UNet, unet_tiny
+
+    model = UNet(unet_tiny())
+    b, size = 8, 16
+    state = train.create_sharded_state(
+        jax.random.PRNGKey(0), model,
+        {"x": jnp.zeros((b, size, size, 3)),
+         "t": jnp.zeros((b,), jnp.int32)},
+        optax.adamw(2e-3), mesh8,
+    )
+    step = train.make_diffusion_train_step(mesh8, num_diffusion_steps=100)
+    gen = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(gen.random((b, size, size, 3), np.float32) * 2 - 1),
+        "noise": jnp.asarray(gen.standard_normal((b, size, size, 3),
+                                                 np.float32)),
+        "t": jnp.asarray(gen.integers(0, 100, (b,)), jnp.int32),
+    }
+    losses = []
+    for _ in range(6):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert np.mean(losses[-2:]) < np.mean(losses[:2])
+
+
+def test_unet_output_shape_and_dtype():
+    from move2kube_tpu.models.unet import UNet, unet_tiny
+
+    model = UNet(unet_tiny())
+    x = jnp.zeros((2, 16, 16, 3))
+    t = jnp.array([0, 7], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, t)
+    out = model.apply(params, x, t)
+    assert out.shape == (2, 16, 16, 3)
+    assert out.dtype == jnp.float32  # noise regressed in f32
+
+
 def test_classifier_scan_steps(mesh8):
     """scan_steps=k fuses k optimizer steps into one compiled call."""
     model = resnet.resnet18_ish(num_classes=10, dtype=jnp.float32)
@@ -199,20 +241,55 @@ def test_pallas_flash_kernel_math_in_interpret_mode():
                                np.asarray(ref, dtype=np.float32), atol=2e-2)
 
 
-def test_flash_attention_custom_vjp_matches_reference_grad(monkeypatch):
-    """The Pallas kernel has no automatic reverse-mode rule; training on
-    TPU goes through _flash_attention_diff's custom_vjp. Verify the vjp
-    wiring produces the reference gradients (kernel substituted with the
-    reference impl — the wiring, residuals and cotangent routing are the
-    same code paths that run on TPU)."""
+def test_pallas_flash_bwd_kernels_match_reference_grad():
+    """Run the ACTUAL blockwise backward kernels (dq over Q blocks, dk/dv
+    over K blocks, probabilities recomputed from the saved logsumexp)
+    through the Pallas interpreter and compare against jax.grad of the
+    reference attention. No [seq, seq] matrix exists on the kernel path —
+    this is the training-mode half of the kernel proof."""
     from move2kube_tpu.ops import attention
 
-    monkeypatch.setattr(
-        attention, "_flash_attention_tpu",
-        lambda q, k, v, causal, scale: attention._reference_attention(
-            q, k, v, causal, scale))
+    b, s, h, d = 2, 256, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.float32)
+               for kk in ks[:3])
+    g = jax.random.normal(ks[3], (b, s, h, d), jnp.float32)
+    scale = d ** -0.5
+    for causal in (True, False):
+        o, lse = attention._flash_attention_tpu(
+            q, k, v, causal, scale, interpret=True, return_residuals=True)
+        dq, dk, dv = attention._flash_attention_bwd_tpu(
+            q, k, v, o, lse, g, causal, scale, interpret=True)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: attention._reference_attention(
+                q_, k_, v_, causal, scale), q, k, v)
+        rq, rk, rv = vjp(g)
+        for got, want in ((dq, rq), (dk, rk), (dv, rv)):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=2e-4)
+    # uneven q/kv lengths (cross-attention-ish shape)
+    k2, v2 = k[:, :128], v[:, :128]
+    o, lse = attention._flash_attention_tpu(
+        q, k2, v2, False, scale, interpret=True, return_residuals=True)
+    dq, dk, dv = attention._flash_attention_bwd_tpu(
+        q, k2, v2, o, lse, g, False, scale, interpret=True)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention._reference_attention(
+            q_, k_, v_, False, scale), q, k2, v2)
+    for got, want in zip((dq, dk, dv), vjp(g)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4)
 
-    b, s, h, d = 2, 32, 4, 16
+
+def test_flash_attention_custom_vjp_matches_reference_grad(monkeypatch):
+    """jax.grad through _flash_attention_diff's custom_vjp with the REAL
+    forward + backward kernels in interpret mode: verifies the residual
+    plumbing (o, lse) and cotangent routing end-to-end, exactly the code
+    path a TPU training step takes."""
+    from move2kube_tpu.ops import attention
+
+    monkeypatch.setattr(attention, "_INTERPRET", True)
+    b, s, h, d = 2, 128, 2, 64
     ks = jax.random.split(jax.random.PRNGKey(1), 3)
     q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
     scale = d ** -0.5
@@ -226,7 +303,32 @@ def test_flash_attention_custom_vjp_matches_reference_grad(monkeypatch):
     g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b_ in zip(g_flash, g_ref):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-4)
+
+
+def test_pallas_flash_bwd_bf16_grads():
+    """bf16 primals must produce bf16 grads (custom_vjp dtype contract)
+    with values matching the f32 reference at bf16 resolution."""
+    from move2kube_tpu.ops import attention
+
+    b, s, h, d = 1, 128, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(2), 4)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+               for kk in ks[:3])
+    g = jax.random.normal(ks[3], (b, s, h, d), jnp.bfloat16)
+    scale = d ** -0.5
+    o, lse = attention._flash_attention_tpu(
+        q, k, v, True, scale, interpret=True, return_residuals=True)
+    dq, dk, dv = attention._flash_attention_bwd_tpu(
+        q, k, v, o, lse, g, True, scale, interpret=True)
+    assert dq.dtype == dk.dtype == dv.dtype == jnp.bfloat16
+    qf, kf, vf, gf = (t.astype(jnp.float32) for t in (q, k, v, g))
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention._reference_attention(
+            q_, k_, v_, True, scale), qf, kf, vf)
+    for got, want in zip((dq, dk, dv), vjp(gf)):
+        np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                                   np.asarray(want), atol=6e-2)
 
 
 def test_ulysses_attention_matches_reference():
