@@ -54,8 +54,13 @@ BERT_BATCH = int(os.environ.get("M2KT_BENCH_BERT_BATCH", "128"))
 
 # optimizer steps fused into one device call (lax.scan)
 SCAN_STEPS = int(os.environ.get("M2KT_BENCH_SCAN_STEPS", "10"))
-WARMUP_CALLS = 1
-MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "2"))
+# adaptive warmup: the tunneled backend streams executables/weights on
+# the first call or two after compile (observed: 20-30s for calls the
+# steady state runs in 0.7s), so warm until a call is fast or the cap
+# is hit — a fixed single warmup under-reports throughput ~10x
+MAX_WARMUP_CALLS = int(os.environ.get("M2KT_BENCH_MAX_WARMUP", "4"))
+WARM_FAST_S = float(os.environ.get("M2KT_BENCH_WARM_FAST_S", "3.0"))
+MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
 PHASES = ("resnet", "bert", "pallas", "translate")
 # single source of truth for each phase's reported metric name + unit,
@@ -70,6 +75,13 @@ PHASE_METRICS = {
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
 # never cost the artifact its one always-measurable number
 TPU_PHASES = ("resnet", "bert", "pallas")
+# On-silicon results captured opportunistically during a builder session
+# (``--opportunistic``): when the tunnel is down at the driver's single
+# end-of-round invocation, run_parent folds these in (clearly labeled
+# with the capture timestamp) instead of reporting zeros — a down-window
+# at round end must not erase numbers a live window already produced.
+OPPORTUNISTIC_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_OPPORTUNISTIC.json")
 BUDGET_S = float(os.environ.get("M2KT_BENCH_BUDGET_S", "440"))
 CHILD_TIMEOUT_S = float(os.environ.get("M2KT_BENCH_CHILD_TIMEOUT_S", "240"))
 RETRY_BACKOFF_S = 15.0
@@ -90,9 +102,14 @@ def _measure(step, state, batches, items_per_step: int):
     """Timed loop. Timing boundaries force a device->host transfer, NOT
     block_until_ready: remote-tunnel backends can report ready before
     execution completes, a transfer cannot lie."""
-    for _ in range(WARMUP_CALLS):
+    for i in range(MAX_WARMUP_CALLS):
+        t0 = time.perf_counter()
         state, losses = step(state, batches)
-    float(losses[-1])
+        float(losses[-1])
+        dt = time.perf_counter() - t0
+        if dt < WARM_FAST_S:
+            break
+        print(f"[bench] warmup call {i}: {dt:.1f}s", file=sys.stderr)
     t0 = time.perf_counter()
     for _ in range(MEASURE_CALLS):
         state, losses = step(state, batches)
@@ -132,7 +149,6 @@ def _with_batch_fallback(measure_at, batch: int, min_batch: int = 32,
 def bench_resnet(n: int) -> dict:
     import jax
     import jax.numpy as jnp
-    import numpy as np
     import optax
 
     from move2kube_tpu.models import train as m2kt_train
@@ -152,15 +168,17 @@ def bench_resnet(n: int) -> dict:
         )
         step = m2kt_train.make_classifier_train_step(
             mesh, has_batch_stats=True, scan_steps=SCAN_STEPS)
-        gen = np.random.default_rng(0)
-        # bf16 input batch: halves host->device and HBM traffic vs f32
-        batches = {
-            "input": jnp.asarray(
-                gen.random((SCAN_STEPS, batch, image, image, 3), np.float32),
-                jnp.bfloat16),
-            "label": jnp.asarray(
-                gen.integers(0, 1000, (SCAN_STEPS, batch)), jnp.int32),
-        }
+        # batches generated ON DEVICE: the tunnel's host->device path
+        # runs at ~0.03 GB/s (measured), so staging 1.5GB of host data
+        # would eat the phase budget without measuring anything
+        make = jax.jit(lambda key: {
+            "input": jax.random.uniform(
+                key, (SCAN_STEPS, batch, image, image, 3), jnp.bfloat16),
+            "label": jax.random.randint(
+                key, (SCAN_STEPS, batch), 0, 1000, jnp.int32),
+        })
+        batches = make(jax.random.PRNGKey(1))
+        float(jnp.sum(batches["label"]))  # transfer = true sync
         return _measure(step, state, batches, batch)
 
     (img_s, loss), batch = _with_batch_fallback(measure_at, RESNET_BATCH,
@@ -182,7 +200,6 @@ def bench_resnet(n: int) -> dict:
 def bench_bert(n: int) -> dict:
     import jax
     import jax.numpy as jnp
-    import numpy as np
     import optax
 
     from move2kube_tpu.models import train as m2kt_train
@@ -199,15 +216,16 @@ def bench_bert(n: int) -> dict:
             optax.adamw(2e-5), mesh,
         )
         step = m2kt_train.make_bert_train_step(mesh, scan_steps=SCAN_STEPS)
-        gen = np.random.default_rng(0)
-        batches = {
-            "input_ids": jnp.asarray(
-                gen.integers(0, 30522, (SCAN_STEPS, batch, BERT_SEQ)),
-                jnp.int32),
+        # on-device batches (see bench_resnet: 0.03 GB/s h2d tunnel)
+        make = jax.jit(lambda key: {
+            "input_ids": jax.random.randint(
+                key, (SCAN_STEPS, batch, BERT_SEQ), 0, 30522, jnp.int32),
             "attention_mask": jnp.ones((SCAN_STEPS, batch, BERT_SEQ), bool),
-            "label": jnp.asarray(gen.integers(0, 2, (SCAN_STEPS, batch)),
-                                 jnp.int32),
-        }
+            "label": jax.random.randint(
+                key, (SCAN_STEPS, batch), 0, 2, jnp.int32),
+        })
+        batches = make(jax.random.PRNGKey(1))
+        float(jnp.sum(batches["label"]))  # transfer = true sync
         return _measure(step, state, batches, batch)
 
     (samples_s, loss), batch = _with_batch_fallback(measure_at, BERT_BATCH,
@@ -227,14 +245,16 @@ def bench_bert(n: int) -> dict:
 
 
 def bench_pallas(n: int) -> dict:
-    """Prove the Pallas flash-attention kernel on silicon: run the TPU
-    kernel directly (no fallback), compare against the jnp reference, and
-    report achieved TFLOP/s."""
+    """Prove the Pallas flash-attention kernels on silicon: forward AND
+    blockwise backward (via the custom_vjp), compared against the jnp
+    reference, then report forward TFLOP/s with the per-dispatch tunnel
+    latency (~2.4ms measured) amortized by scanning the kernel inside
+    one jit."""
     import jax
     import jax.numpy as jnp
 
     from move2kube_tpu.ops.attention import (
-        _flash_attention_tpu, _reference_attention)
+        _flash_attention_diff, _flash_attention_tpu, _reference_attention)
 
     metric, unit = PHASE_METRICS["pallas"]
     if jax.default_backend() != "tpu":
@@ -242,7 +262,7 @@ def bench_pallas(n: int) -> dict:
                 "unit": unit, "vs_baseline": 0.0,
                 "status": "skipped_not_tpu", "backend": jax.default_backend()}
 
-    b, s, h, d = 4, 1024, 8, 64
+    b, s, h, d = 8, 2048, 8, 64
     scale = d ** -0.5
     keys = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
@@ -259,23 +279,86 @@ def bench_pallas(n: int) -> dict:
     tol = 2e-2
     if not (err <= tol):
         raise RuntimeError(f"pallas kernel mismatch: max_abs_err={err}")
-    iters = 20
-    float(jnp.sum(kernel(q, k, v)))  # warm
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = kernel(q, k, v)
-    float(jnp.sum(out))
-    dt = time.perf_counter() - t0
-    # causal fwd flops: 2 matmuls * 2 flops/MAC * b*h*s*s*d, halved by mask
-    flops = 2 * 2 * b * h * s * s * d / 2
-    tflops = flops * iters / dt / 1e12
-    print(f"[bench] pallas max_abs_err={err:.4f} {tflops:.1f} TFLOP/s",
+
+    # backward kernels (dq/dk/dv blockwise, lse recompute) on silicon:
+    # grads of the kernel path must match grads of the reference
+    def loss_kernel(q, k, v):
+        return jnp.sum(_flash_attention_diff(q, k, v, True, scale)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v, True, scale)
+                       .astype(jnp.float32) ** 2)
+
+    gk = jax.jit(jax.grad(loss_kernel, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    # grads scale with |dO|~2*s_q... compare relative to the ref magnitude
+    bwd_err = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                              - b_.astype(jnp.float32))))
+        / max(1.0, float(jnp.max(jnp.abs(b_.astype(jnp.float32)))))
+        for a, b_ in zip(gk, gr))
+    bwd_tol = 4e-2  # bf16 grad resolution, relative
+    if not (bwd_err <= bwd_tol):
+        raise RuntimeError(
+            f"pallas backward mismatch: rel_err={bwd_err}")
+
+    # throughput: scan the kernel K times inside ONE jit so the ~2.4ms
+    # per-dispatch tunnel roundtrip doesn't dominate the measurement
+    # (o has q's shape, so it feeds back as the next query block)
+    scan_iters = 10
+
+    def timed_tflops(call):
+        run = jax.jit(lambda q, k, v: jax.lax.scan(
+            lambda c, _: (call(c, k, v), None), q, None,
+            length=scan_iters)[0])
+        float(jnp.sum(run(q, k, v)))  # warm (compile + exe streaming)
+        float(jnp.sum(run(q, k, v)))  # warm (steady state)
+        iters = 4
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run(q, k, v)
+        float(jnp.sum(out))
+        dt = time.perf_counter() - t0
+        # causal fwd flops: 2 matmuls * 2 flops/MAC * b*h*s*s*d, /2 mask
+        flops = 2 * 2 * b * h * s * s * d / 2
+        return flops * scan_iters * iters / dt / 1e12
+
+    tflops = timed_tflops(
+        lambda c, k, v: _flash_attention_tpu(c, k, v, True, scale))
+
+    # north-star comparison (BASELINE.json: >=90% of a hand-ported
+    # kernel): the public jax TPU flash kernel on the same shape/chip
+    vs_official = None
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as official_fa)
+
+        def official(c, k, v):
+            # official kernel takes [b, h, s, d]
+            t = lambda x: x.transpose(0, 2, 1, 3)  # noqa: E731
+            return t(official_fa(t(c), t(k), t(v), causal=True,
+                                 sm_scale=scale))
+
+        official_tflops = timed_tflops(official)
+        vs_official = round(tflops / official_tflops, 3)
+    except Exception as e:  # noqa: BLE001 - comparison is best-effort
+        print(f"[bench] official-kernel comparison failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+
+    print(f"[bench] pallas max_abs_err={err:.4f} bwd_rel_err={bwd_err:.4f} "
+          f"{tflops:.1f} TFLOP/s vs_official={vs_official}",
           file=sys.stderr)
-    return {"phase": "pallas", "metric": metric, "value": round(tflops, 2),
-            "unit": unit,
-            "vs_baseline": round(tflops * 1e12 / (V5E_PEAK_BF16_FLOPS
-                                                  * ANCHOR_MFU), 3),
-            "pallas_ok": True, "max_abs_err": round(err, 5)}
+    result = {"phase": "pallas", "metric": metric,
+              "value": round(tflops, 2), "unit": unit,
+              "vs_baseline": round(tflops * 1e12 / (V5E_PEAK_BF16_FLOPS
+                                                    * ANCHOR_MFU), 3),
+              "pallas_ok": True, "pallas_bwd_ok": True,
+              "max_abs_err": round(err, 5),
+              "bwd_rel_err": round(bwd_err, 5)}
+    if vs_official is not None:
+        result["vs_official_kernel"] = vs_official
+    return result
 
 
 def bench_translate(n: int) -> dict:
@@ -466,16 +549,62 @@ def run_parent(requested: list[str]) -> int:
             remaining = deadline - time.perf_counter()
             if remaining < 20:
                 continue
+            fails_before = {p: len(fails.get(p, ())) for p in cpu_missing}
             what = _spawn(cpu_missing, min(120.0, remaining - 10), results,
                           fails, errors, env=_cpu_child_env())
-            if what.startswith("timeout"):
-                # a pure-CPU hang is deterministic (no flaky tunnel in
-                # play): don't let it eat the TPU phases' retry budget
+            # cpu_missing phases had no result before this spawn, so any
+            # presence in results (or new PHASEFAIL entry) is its output
+            produced_output = any(
+                p in results or len(fails.get(p, ())) > fails_before[p]
+                for p in cpu_missing)
+            if what.startswith("timeout") or (what != "rc=0"
+                                              and not produced_output):
+                # a pure-CPU hang or an rc!=0 exit where THIS spawn
+                # produced no RESULT/PHASEFAIL line (e.g. an import
+                # error) is deterministic (no flaky tunnel in play):
+                # don't let it eat the TPU phases' retry budget by
+                # re-spawning it every attempt
                 for p in cpu_missing:
                     if p not in results:
                         fails.setdefault(p, []).extend(
-                            ["cpu child timeout (not retried)"]
-                            * MAX_PHASE_FAILS)
+                            [f"cpu child died without a result ({what}; "
+                             "not retried)"] * MAX_PHASE_FAILS)
+
+    # fold in any opportunistic on-silicon capture for phases the live
+    # run could not produce because the backend was unreachable (tunnel
+    # down at round end). A phase that deterministically FAILED inside a
+    # live child must stay a failure — masking a code regression with a
+    # stale capture would report healthy throughput for code that can no
+    # longer run the phase. Transient tunnel errors don't count as
+    # deterministic.
+    def _transient(errs: list) -> bool:
+        # ONLY the tunnel's own failure signatures: broad markers like
+        # bare "connection"/"timeout" would classify deterministic code
+        # failures (ConnectionError, a message mentioning a timeout) as
+        # transient and let a stale capture mask a real regression
+        markers = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "Socket closed",
+                   "Connection reset by peer")
+        return all(any(m.lower() in e.lower() for m in markers)
+                   for e in errs)
+
+    captured = _load_opportunistic()
+    for phase in requested:
+        live = results.get(phase)
+        live_is_zero = live is not None and not live.get("value")
+        live_failed_deterministically = (
+            phase in fails and not _transient(fails[phase]))
+        if (phase not in results or live_is_zero) \
+                and not live_failed_deterministically \
+                and captured.get("phases", {}).get(phase, {}).get("value"):
+            r = dict(captured["phases"][phase])
+            r["source"] = "opportunistic_capture"
+            r.setdefault("captured_at", captured.get("captured_at", ""))
+            live_fails = fails.pop(phase, None)
+            if live_fails:
+                r["live_attempt_error"] = live_fails[-1]
+            results[phase] = r
+            print(f"[bench] folding in opportunistic capture for {phase} "
+                  f"({r['captured_at']})", file=sys.stderr)
 
     primary_phase = requested[0]
     extra = {k: v for k, v in results.items() if k != primary_phase}
@@ -499,15 +628,100 @@ def run_parent(requested: list[str]) -> int:
     return 0
 
 
+def _load_opportunistic() -> dict:
+    try:
+        with open(OPPORTUNISTIC_PATH, encoding="utf-8") as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _probe_tpu(timeout: float = 90.0) -> bool:
+    """Cheap subprocess probe: is the TPU tunnel answering right now?
+    Runs out-of-process because a hung tunnel blocks uninterruptibly
+    inside the plugin's C client init."""
+    code = ("import jax, sys; "
+            "sys.exit(0 if jax.default_backend() == 'tpu' "
+            "and jax.device_count() >= 1 else 1)")
+    try:
+        return subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                              capture_output=True).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_opportunistic() -> int:
+    """Probe the tunnel; if it answers, measure the TPU phases and merge
+    the results into BENCH_OPPORTUNISTIC.json (newest capture wins per
+    phase, since code improvements should be reflected). Designed to be
+    invoked repeatedly (cron/loop) during a builder session; exits 0 with
+    nothing written when the tunnel is down — cheap to call often."""
+    if not _probe_tpu():
+        print("[bench] opportunistic: tunnel down", file=sys.stderr)
+        return 0
+    print("[bench] opportunistic: tunnel UP, measuring", file=sys.stderr)
+    results: dict = {}
+    fails: dict = {}
+    errors: list = []
+    oom: dict = {}
+    deadline = time.perf_counter() + BUDGET_S
+    for _ in range(3):
+        missing = [p for p in TPU_PHASES if p not in results
+                   and len(fails.get(p, ())) < MAX_PHASE_FAILS]
+        remaining = deadline - time.perf_counter()
+        if not missing or remaining < 30:
+            break
+        env = None
+        if oom:
+            env = dict(os.environ)
+            for phase, batch in oom.items():
+                env[PHASE_BATCH_ENV[phase]] = str(batch)
+        _spawn(missing, min(CHILD_TIMEOUT_S, remaining - 10), results,
+               fails, errors, env=env, oom_batches=oom)
+    if not results:
+        print("[bench] opportunistic: probe answered but no phase "
+              "completed", file=sys.stderr)
+        return 0
+    import datetime
+
+    data = _load_opportunistic()
+    data.setdefault("phases", {})
+    now = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    data["captured_at"] = now
+    data["source"] = "opportunistic_capture"
+    for phase, r in results.items():
+        # newest capture wins: the artifact must reflect what the CURRENT
+        # code measures, including fixes that legitimately lower a number
+        # (the round-end live run outranks captures anyway — folding only
+        # happens when the tunnel is down at that moment)
+        r = dict(r)
+        r["captured_at"] = now
+        data["phases"][phase] = r
+    tmp = OPPORTUNISTIC_PATH + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1)
+    os.replace(tmp, OPPORTUNISTIC_PATH)
+    print(f"[bench] opportunistic: captured {sorted(results)} -> "
+          f"{OPPORTUNISTIC_PATH}", file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--child", default=None,
                         help="comma-separated phases to measure in-process")
     parser.add_argument("--model", choices=PHASES, default=None,
                         help="restrict the parent to one phase")
+    parser.add_argument("--opportunistic", action="store_true",
+                        help="probe the tunnel; capture TPU phases to "
+                             "BENCH_OPPORTUNISTIC.json if it answers")
     args = parser.parse_args()
     if args.child:
         return run_child(args.child.split(","))
+    if args.opportunistic:
+        return run_opportunistic()
     requested = list(PHASES) if args.model is None else [args.model]
     return run_parent(requested)
 
