@@ -23,10 +23,14 @@ from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from move2kube_tpu.native import gather_rows
 
 
 def _process_slice(n: int) -> tuple[int, int]:
@@ -122,7 +126,9 @@ class HostShardedLoader:
         take = self._advance()
         out = {}
         for k, v in self.arrays.items():
-            local = np.ascontiguousarray(v[take])
+            # parallel C row-gather when built (move2kube_tpu/native);
+            # numpy fancy-index fallback otherwise
+            local = gather_rows(v, take)
             out[k] = jax.make_array_from_process_local_data(
                 self._sharding, local)
         return out
@@ -135,12 +141,67 @@ class HostShardedLoader:
             self._advance()
 
 
+class PrefetchLoader:
+    """Double-buffered host prefetch: a background thread assembles the
+    next batch (shuffle gather + host->device transfer kickoff) while the
+    device runs the current step, hiding host time behind device time.
+
+    ``skip`` must be called before iteration starts (resume fast-forward
+    happens before the training loop) — once the thread is running the
+    already-buffered batches would be from the pre-skip stream."""
+
+    def __init__(self, inner, depth: int = 2):
+        self._inner = inner
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._thread: threading.Thread | None = None
+        self._dead: BaseException | None = None
+        self._terminated = False  # the one None sentinel was consumed
+
+    def _pump(self):
+        try:
+            while True:
+                self._q.put(next(self._inner))
+        except BaseException as e:  # noqa: BLE001 - re-raised in __next__
+            self._dead = e
+            self._q.put(None)
+
+    def skip(self, n: int) -> None:
+        if self._thread is not None:
+            raise RuntimeError("skip() after iteration started")
+        self._inner.skip(n)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._terminated:
+            # the pump thread is dead and its one sentinel was already
+            # consumed — keep raising instead of blocking forever on an
+            # empty queue (buffered good batches before the sentinel are
+            # still delivered by the branch below)
+            raise self._dead if self._dead is not None else StopIteration
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._pump, daemon=True)
+            self._thread.start()
+        item = self._q.get()
+        if item is None:
+            self._terminated = True
+            raise self._dead if self._dead is not None else StopIteration
+        return item
+
+
 def make_loader(path: str, global_batch: int, mesh: Mesh,
-                synthetic_fn=None, seed: int = 0):
+                synthetic_fn=None, seed: int = 0, prefetch: bool = True):
     """Return a batch iterator: real data when ``path`` exists, else the
-    synthetic generator (the emitted programs' out-of-the-box mode)."""
+    synthetic generator (the emitted programs' out-of-the-box mode).
+    Real-data loaders are wrapped in a double-buffered prefetch unless
+    ``prefetch=False`` (or M2KT_PREFETCH=0)."""
     if path and os.path.exists(path):
-        return HostShardedLoader(load_arrays(path), global_batch, mesh, seed)
+        loader = HostShardedLoader(load_arrays(path), global_batch, mesh,
+                                   seed)
+        if prefetch and os.environ.get("M2KT_PREFETCH", "1") != "0":
+            return PrefetchLoader(loader)
+        return loader
     if synthetic_fn is None:
         raise ValueError(f"data path {path!r} not found and no synthetic fn")
 

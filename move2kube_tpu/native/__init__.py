@@ -1,0 +1,48 @@
+"""Native runtime components (C, built by setup.py; optional).
+
+``gather_rows`` is the public API: a parallel fancy-index row gather for
+the input pipeline's per-batch hot path (models/data.py). Falls back to
+numpy transparently when the extension isn't built — pure-Python
+installs lose speed, never function.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+try:
+    from move2kube_tpu.native import _fastgather
+except ImportError:  # extension not built (pure-python install)
+    _fastgather = None
+
+_THREADS = int(os.environ.get("M2KT_GATHER_THREADS",
+                              str(min(8, os.cpu_count() or 1))))
+# below this many bytes the thread spawn costs more than the copy
+_MIN_NATIVE_BYTES = 1 << 20
+
+
+def native_available() -> bool:
+    return _fastgather is not None
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """``np.ascontiguousarray(src[idx])`` — via the parallel C gather
+    when the layout allows (C-contiguous rows), numpy otherwise."""
+    out_shape = (len(idx),) + src.shape[1:]
+    if (_fastgather is None or src.ndim < 1
+            or not src.flags.c_contiguous
+            or src.nbytes < _MIN_NATIVE_BYTES):
+        return np.ascontiguousarray(src[idx])
+    row_bytes = src.dtype.itemsize
+    for dim in src.shape[1:]:
+        row_bytes *= dim
+    if row_bytes == 0:
+        return np.ascontiguousarray(src[idx])
+    out = np.empty(out_shape, src.dtype)
+    idx64 = np.ascontiguousarray(idx, np.int64)
+    _fastgather.gather(
+        memoryview(src).cast("B"), memoryview(out).cast("B"),
+        memoryview(idx64).cast("B"), row_bytes, src.shape[0], _THREADS)
+    return out

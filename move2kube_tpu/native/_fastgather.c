@@ -1,0 +1,137 @@
+/* Parallel row-gather for the host-sharded input pipeline.
+ *
+ * The per-batch hot path of models/data.py is `src[take]` — a fancy-index
+ * gather that numpy executes single-threaded. On a JobSet host feeding
+ * multiple TPU chips, the gather sits between device steps (the device is
+ * idle while it runs), so cutting its wall-clock directly raises
+ * steps/sec for IO-bound workloads. This module is a dependency-free
+ * CPython extension (no numpy C API — plain buffer protocol + memcpy)
+ * that splits the row range over pthreads with the GIL released.
+ *
+ * Reference parity note: the reference implements its performance-
+ * critical paths natively (Go); this is the analogous native component
+ * for the one hot loop the TPU tool runtime owns (everything else hot
+ * runs on-device via XLA/Pallas).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef struct {
+    const char *src;
+    char *dst;
+    const int64_t *idx;
+    Py_ssize_t row_bytes;
+    Py_ssize_t begin; /* first output row (inclusive) */
+    Py_ssize_t end;   /* last output row (exclusive) */
+} gather_span;
+
+static void *gather_worker(void *arg)
+{
+    gather_span *s = (gather_span *)arg;
+    Py_ssize_t i;
+    for (i = s->begin; i < s->end; i++) {
+        memcpy(s->dst + i * s->row_bytes,
+               s->src + s->idx[i] * s->row_bytes,
+               (size_t)s->row_bytes);
+    }
+    return NULL;
+}
+
+/* gather(src: buffer, out: buffer, idx: buffer[int64], row_bytes: int,
+ *        n_src_rows: int, threads: int) -> None
+ * Bounds are validated here so a bad index can never read/write out of
+ * range; raises ValueError instead. */
+static PyObject *gather(PyObject *self, PyObject *args)
+{
+    Py_buffer src, out, idx;
+    Py_ssize_t row_bytes, n_src_rows, threads;
+    if (!PyArg_ParseTuple(args, "y*w*y*nnn", &src, &out, &idx, &row_bytes,
+                          &n_src_rows, &threads)) {
+        return NULL;
+    }
+
+    PyObject *ret = NULL;
+    Py_ssize_t n_idx = idx.len / (Py_ssize_t)sizeof(int64_t);
+    const int64_t *indices = (const int64_t *)idx.buf;
+    Py_ssize_t i;
+
+    if (row_bytes <= 0 || idx.len % (Py_ssize_t)sizeof(int64_t) != 0) {
+        PyErr_SetString(PyExc_ValueError, "bad row_bytes or index buffer");
+        goto done;
+    }
+    if (src.len < n_src_rows * row_bytes || out.len < n_idx * row_bytes) {
+        PyErr_SetString(PyExc_ValueError, "buffer too small for rows");
+        goto done;
+    }
+    for (i = 0; i < n_idx; i++) {
+        if (indices[i] < 0 || indices[i] >= n_src_rows) {
+            PyErr_Format(PyExc_ValueError,
+                         "index %lld out of range [0, %lld)",
+                         (long long)indices[i], (long long)n_src_rows);
+            goto done;
+        }
+    }
+
+    if (threads < 1) threads = 1;
+    if (threads > 16) threads = 16;
+    if (threads > n_idx) threads = n_idx > 0 ? n_idx : 1;
+
+    Py_BEGIN_ALLOW_THREADS
+    {
+        gather_span spans[16];
+        pthread_t tids[16]; /* compact: tids[0..spawned) are all live */
+        Py_ssize_t per = (n_idx + threads - 1) / threads;
+        Py_ssize_t t, spawned = 0;
+        for (t = 0; t < threads; t++) {
+            spans[t].src = (const char *)src.buf;
+            spans[t].dst = (char *)out.buf;
+            spans[t].idx = indices;
+            spans[t].row_bytes = row_bytes;
+            spans[t].begin = t * per;
+            spans[t].end = (t + 1) * per < n_idx ? (t + 1) * per : n_idx;
+            if (spans[t].begin >= spans[t].end) break;
+            if (t + 1 < threads &&
+                pthread_create(&tids[spawned], NULL, gather_worker,
+                               &spans[t]) == 0) {
+                spawned++;
+            } else {
+                /* last span (or thread creation failed): run inline */
+                gather_worker(&spans[t]);
+            }
+        }
+        for (t = 0; t < spawned; t++) {
+            pthread_join(tids[t], NULL);
+        }
+    }
+    Py_END_ALLOW_THREADS
+
+    ret = Py_None;
+    Py_INCREF(ret);
+done:
+    PyBuffer_Release(&src);
+    PyBuffer_Release(&out);
+    PyBuffer_Release(&idx);
+    return ret;
+}
+
+static PyMethodDef methods[] = {
+    {"gather", gather, METH_VARARGS,
+     "gather(src, out, idx, row_bytes, n_src_rows, threads): parallel "
+     "row memcpy with bounds checking; GIL released during the copy."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_fastgather",
+    "Parallel row-gather (see module source header).", -1, methods,
+};
+
+PyMODINIT_FUNC PyInit__fastgather(void)
+{
+    return PyModule_Create(&moduledef);
+}

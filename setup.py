@@ -1,0 +1,17 @@
+"""Build shim: metadata lives in pyproject.toml; this file only adds the
+optional native extension (move2kube_tpu/native/_fastgather.c). A failed
+compile degrades to the pure-Python fallback instead of failing the
+install (Extension(optional=True))."""
+
+from setuptools import Extension, setup
+
+setup(
+    ext_modules=[
+        Extension(
+            "move2kube_tpu.native._fastgather",
+            sources=["move2kube_tpu/native/_fastgather.c"],
+            extra_compile_args=["-O3"],
+            optional=True,
+        )
+    ]
+)
