@@ -4,13 +4,22 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast lint ci dist bench dryrun e2e clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
 
 test-fast:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow" -x
+
+# suite + dependency-free line coverage (scripts/cov.py, PEP 669) gated
+# at the floor (parity: reference build.yml uploads coverage per push);
+# report artifact: coverage-report.txt
+COV_MIN ?= 72
+coverage:
+	$(PY) scripts/cov.py clean
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -p scripts.cov
+	$(PY) scripts/cov.py report --min $(COV_MIN) --out coverage-report.txt
 
 # AST linter (scripts/lint.py; parity with the reference's golangci-lint
 # gate, Makefile:82-101) + bytecode compile + import smoke
@@ -19,8 +28,18 @@ lint:
 	$(PY) scripts/lint.py move2kube_tpu tests scripts bench.py __graft_entry__.py
 	$(PY) -c "import move2kube_tpu.cli.main"
 
-# what .github/workflows/build.yml runs
-ci: lint test dryrun
+# what .github/workflows/build.yml runs; the coverage collector needs
+# sys.monitoring (3.12+), so the 3.11 matrix leg runs the plain suite
+ci: lint ci-test dryrun
+
+.PHONY: ci-test
+ci-test:
+	@if $(PY) -c "import sys; raise SystemExit(0 if sys.version_info >= (3, 12) else 1)"; then \
+		$(MAKE) coverage; \
+	else \
+		echo "python < 3.12: no sys.monitoring, running suite without coverage"; \
+		$(MAKE) test; \
+	fi
 
 # wheel + sdist + checksums (parity: reference scripts/builddist.go's
 # tar+checksum dist packaging; one pure-Python artifact replaces the

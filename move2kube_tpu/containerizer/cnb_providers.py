@@ -370,11 +370,21 @@ class RuncProvider:
         spec = self._read_config(bundle)
         if spec is None:
             return False
-        mount = {"source": os.path.abspath(directory),
-                 "destination": "/workspace", "type": "bind",
-                 "options": ["rbind", "ro"]}
+        # the detector writes group.toml/plan.toml under /layers; the
+        # rootfs stays read-only (it is shared by concurrent probes), so
+        # /layers and /tmp get private tmpfs mounts instead
+        mounts = [
+            {"source": os.path.abspath(directory),
+             "destination": "/workspace", "type": "bind",
+             "options": ["rbind", "ro"]},
+            {"source": "tmpfs", "destination": "/layers", "type": "tmpfs",
+             "options": ["nosuid", "nodev", "mode=1777"]},
+            {"source": "tmpfs", "destination": "/tmp", "type": "tmpfs",
+             "options": ["nosuid", "nodev", "mode=1777"]},
+        ]
+        taken = {m["destination"] for m in mounts}
         spec["mounts"] = [m for m in spec.get("mounts", [])
-                          if m.get("destination") != "/workspace"] + [mount]
+                          if m.get("destination") not in taken] + mounts
         spec.setdefault("process", {})
         spec["process"]["args"] = ["/cnb/lifecycle/detector", "-app", "/workspace"]
         spec["process"]["terminal"] = False

@@ -1,0 +1,176 @@
+"""Dependency-free line coverage for the test suite (PEP 669).
+
+The tool images this repo supports carry no ``coverage``/``pytest-cov``
+(and installs are gated), so CI coverage gating (reference parity:
+build.yml uploads coverage on every push — see
+/root/reference/.github/workflows/build.yml and codecov.yml) is
+implemented on ``sys.monitoring`` (Python 3.12+): a LINE callback that
+records each executed (file, line) once and then disables itself for
+that location, so steady-state overhead is near zero.
+
+Usage:
+  pytest plugin (`make coverage` wires it):
+      python -m pytest tests/ -p scripts.cov
+  report + gate (after a collected run):
+      python scripts/cov.py report --min 72
+
+The executable-line universe comes from compiling each source file and
+walking its code objects' ``co_lines`` — the same universe coverage.py
+uses, minus exclusion pragmas. Subprocess children (e2e tests run
+emitted trainers out-of-process) are not traced; the floor accounts for
+that.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(PKG_ROOT, "move2kube_tpu")
+DATA_PATH = os.path.join(PKG_ROOT, ".coverage.m2kt.json")
+TOOL_ID = 4  # sys.monitoring tool slot (0-5 free for tools)
+
+_hits: dict[str, set[int]] = {}
+
+
+def _line_callback(code, line_number, _pkg=PKG_DIR, _hits=_hits,
+                   _disable=sys.monitoring.DISABLE):
+    # defaults bind the globals: the callback can fire during interpreter
+    # shutdown after module globals are cleared to None
+    fn = code.co_filename
+    if fn is not None and fn.startswith(_pkg):
+        _hits.setdefault(fn, set()).add(line_number)
+    return _disable
+
+
+def start() -> None:
+    mon = sys.monitoring
+    mon.use_tool_id(TOOL_ID, "m2kt-cov")
+    mon.register_callback(TOOL_ID, mon.events.LINE, _line_callback)
+    mon.set_events(TOOL_ID, mon.events.LINE)
+
+
+def stop_and_save() -> None:
+    mon = sys.monitoring
+    mon.set_events(TOOL_ID, 0)
+    mon.free_tool_id(TOOL_ID)
+    merged: dict[str, list[int]] = {}
+    if os.path.exists(DATA_PATH):
+        try:
+            with open(DATA_PATH, encoding="utf-8") as f:
+                merged = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            merged = {}
+    for fn, lines in _hits.items():
+        merged[fn] = sorted(set(merged.get(fn, [])) | lines)
+    with open(DATA_PATH, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+
+
+# --- pytest plugin hooks (loaded via tests/conftest.py) -------------------
+
+def pytest_sessionstart(session):
+    start()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    stop_and_save()
+
+
+# --- reporting ------------------------------------------------------------
+
+def _executable_lines(path: str) -> set[int]:
+    """All line numbers the compiler emits code for in ``path``."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        top = compile(src, path, "exec")
+    except (OSError, SyntaxError):
+        return set()
+    lines: set[int] = set()
+    stack = [top]
+    while stack:
+        code = stack.pop()
+        for _, _, ln in code.co_lines():
+            if ln is not None:
+                lines.add(ln)
+        for const in code.co_consts:
+            if hasattr(const, "co_lines"):
+                stack.append(const)
+    # docstring-only and def/class header lines are still "executed" at
+    # import; keep them — import coverage is real coverage
+    return lines
+
+
+def _iter_sources():
+    for root, dirs, files in os.walk(PKG_DIR):
+        # emitted/vendored assets run in subprocesses or inside emitted
+        # containers, not in this process; excluding them keeps the
+        # number honest for the in-process surface
+        if os.path.basename(root) == "assets":
+            dirs[:] = []
+            continue
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def report(min_pct: float, out_path: str | None = None) -> int:
+    try:
+        with open(DATA_PATH, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("no coverage data; run the suite with `-p scripts.cov` "
+              "first (make coverage does)", file=sys.stderr)
+        return 2
+    rows = []
+    total_exec = total_hit = 0
+    for path in _iter_sources():
+        exe = _executable_lines(path)
+        if not exe:
+            continue
+        hit = set(data.get(path, [])) & exe
+        total_exec += len(exe)
+        total_hit += len(hit)
+        rows.append((os.path.relpath(path, PKG_ROOT), len(hit), len(exe)))
+    pct = 100.0 * total_hit / max(1, total_exec)
+    lines = [f"{'file':58} {'hit':>5} {'exec':>5} {'pct':>6}"]
+    for name, hit, exe in rows:
+        lines.append(f"{name:58} {hit:5d} {exe:5d} {100.0*hit/exe:5.1f}%")
+    lines.append(f"{'TOTAL':58} {total_hit:5d} {total_exec:5d} {pct:5.1f}%")
+    text = "\n".join(lines)
+    print(text)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    if pct < min_pct:
+        print(f"\nFAIL: coverage {pct:.1f}% is below the floor "
+              f"{min_pct:.0f}%", file=sys.stderr)
+        return 1
+    print(f"\nOK: coverage {pct:.1f}% >= floor {min_pct:.0f}%")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="print report; gate on --min")
+    rep.add_argument("--min", type=float,
+                     default=float(os.environ.get("M2KT_COV_MIN", "72")))
+    rep.add_argument("--out", default="coverage-report.txt")
+    sub.add_parser("clean", help="delete collected data")
+    args = parser.parse_args()
+    if args.cmd == "clean":
+        try:
+            os.unlink(DATA_PATH)
+        except FileNotFoundError:
+            pass
+        return 0
+    return report(args.min, args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,3 +21,9 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# dependency-free coverage (scripts/cov.py, PEP 669) is wired as a real
+# pytest plugin: `make coverage` runs the suite with `-p scripts.cov`
+# and gates on the floor. (Conftest-defined sessionstart wrappers were
+# tried first and silently collected nothing; command-line plugins
+# reliably receive the session hooks.)
