@@ -31,9 +31,9 @@ _ASSETS = os.path.join(_PKG_ROOT, "assets", "jax")
 
 # subpackages vendored into every emitted image
 # "native" ships its .py fallback AND the C source: the vendored tree is
-# copied, not pip-installed, so the extension is simply absent and
-# gather_rows degrades to numpy; operators who want the parallel gather
-# can build it in-image (gcc is in the emitted Dockerfile's base)
+# copied, not pip-installed, so the emitted Dockerfile best-effort-builds
+# the extension (transient gcc install, `|| true`); when that fails
+# gather_rows degrades to the numpy fallback
 VENDORED_SUBPACKAGES = ("models", "parallel", "ops", "native")
 
 REQUIREMENTS = """jax[tpu]>=0.4.35

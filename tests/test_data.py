@@ -155,3 +155,33 @@ def test_make_loader_wraps_real_data_in_prefetch(tmp_path, mesh):
     loader = m2kt_data.make_loader(str(tmp_path / "t.npz"), 8, mesh,
                                    prefetch=False)
     assert isinstance(loader, m2kt_data.HostShardedLoader)
+
+
+def test_prefetch_loader_error_keeps_raising():
+    """A dead pump thread must raise on EVERY subsequent next() — not
+    block forever on an empty queue after the one sentinel is consumed
+    (a retry loop around a corrupt-data error would otherwise hang the
+    emitted trainer)."""
+
+    class Boom:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            raise ValueError("corrupt shard")
+
+    pre = m2kt_data.PrefetchLoader(Boom())
+    for _ in range(3):
+        with pytest.raises(ValueError, match="corrupt shard"):
+            next(pre)
+
+
+def test_prefetch_loader_exhaustion_keeps_stopping():
+    """Same terminal contract for plain exhaustion: StopIteration from
+    the inner loader is StopIteration forever, never a hang."""
+    pre = m2kt_data.PrefetchLoader(iter([{"x": 1}, {"x": 2}]))
+    assert next(pre)["x"] == 1
+    assert next(pre)["x"] == 2
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            next(pre)

@@ -432,6 +432,25 @@ def test_translate_gpt2_tensor_parallel_shards_params(tmp_path):
     assert run.returncode == 0, run.stderr[-2000:]
     assert "SHARDED_OK" in run.stdout
 
+    # and the emitted program itself executes on a dp=2 x fsdp=2 x tp=2
+    # CPU mesh (not just the sharding-library assertion above)
+    env = dict(
+        env,
+        M2KT_STEPS="2", M2KT_BATCH_PER_DEVICE="1", M2KT_SEQ_LEN="32",
+        M2KT_MAX_LEN="32", M2KT_VOCAB="256", M2KT_DMODEL="64",
+        M2KT_LAYERS="2", M2KT_HEADS="4",
+        M2KT_MESH_DATA="2", M2KT_MESH_FSDP="2", M2KT_MESH_PIPE="1",
+        M2KT_MESH_TENSOR="2", M2KT_MESH_SEQ="1", M2KT_MESH_EXPERT="1",
+    )
+    run = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import runpy; runpy.run_path('train_tpu.py', run_name='__main__')"],
+        cwd=str(cdir), env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+    assert "[m2kt] done" in run.stdout
+
 
 def test_translate_gpt2_sequence_parallel_runs_ring(tmp_path):
     """DeepSpeed-Ulysses sp=4 GPT-2 fine-tune -> true GPT-2 architecture
