@@ -9,6 +9,7 @@ common-directory math). Behavior parity, idiomatic Python.
 from __future__ import annotations
 
 import difflib
+import functools
 import hashlib
 import json
 import os
@@ -91,7 +92,13 @@ def find_common_directory(paths: Iterable[str]) -> str:
 # ---------------------------------------------------------------------------
 
 
-class _M2KTDumper(yaml.SafeDumper):
+# libyaml's C dumper/loader when present (~5x on emission-heavy
+# translates); the pure-Python classes are a drop-in fallback
+_BaseDumper = getattr(yaml, "CSafeDumper", yaml.SafeDumper)
+_BaseLoader = getattr(yaml, "CSafeLoader", yaml.SafeLoader)
+
+
+class _M2KTDumper(_BaseDumper):
     """Block-style dumper that never emits aliases (k8s YAML convention)."""
 
     def ignore_aliases(self, data: Any) -> bool:  # noqa: ARG002
@@ -134,7 +141,7 @@ def read_yaml(path: str) -> Any:
         if hit is not None and hit[0] == stamp:
             return copy.deepcopy(hit[1])  # callers may mutate their copy
     with open(path, "r", encoding="utf-8") as f:
-        doc = yaml.safe_load(f)
+        doc = yaml.load(f, Loader=_BaseLoader)
     if stamp is not None:
         if len(_yaml_cache) > 4096:
             _yaml_cache.clear()
@@ -189,12 +196,23 @@ def read_json(path: str) -> Any:
 # ---------------------------------------------------------------------------
 
 
-def render_template(template_str: str, params: dict) -> str:
-    """Render a Jinja2 template string with strict undefined handling."""
+@functools.lru_cache(maxsize=256)
+def _compile_template(template_str: str):
     import jinja2
 
-    env = jinja2.Environment(undefined=jinja2.StrictUndefined, keep_trailing_newline=True)
-    return env.from_string(template_str).render(**params)
+    env = jinja2.Environment(undefined=jinja2.StrictUndefined,
+                             keep_trailing_newline=True)
+    return env.from_string(template_str)
+
+
+def render_template(template_str: str, params: dict) -> str:
+    """Render a Jinja2 template string with strict undefined handling.
+
+    Compiled templates are lru-cached by source: a translate run renders
+    the same trainer/build-script templates once per service, and jinja
+    compilation dominated the translate profile (~half the wall time)
+    before caching."""
+    return _compile_template(template_str).render(**params)
 
 
 def write_template_to_file(template_str: str, params: dict, path: str, mode: int = 0o644) -> None:
