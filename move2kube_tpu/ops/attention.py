@@ -5,7 +5,11 @@ K/V blocks resident in VMEM, maintaining running max / sum / accumulator,
 so the full [seq, seq] score matrix never touches HBM. Scores accumulate in
 float32 on the MXU (pallas_guide.md: "Math and Compute Operations" —
 jnp.dot with preferred_element_type=jnp.float32; tiling constraints
-(8/16, 128) motivate the 128-multiple block sizes).
+(8/16, 128) motivate the 128-multiple block sizes; bigger tiles amortize
+the per-block softmax bookkeeping across more MXU work — the 256x512
+defaults measured ~35% over 128x128 within one chip session, and the
+session-to-session bench capture roughly doubled; the stable comparator
+is vs_official_kernel in BENCH_OPPORTUNISTIC.json, same shape and chip).
 
 Off-TPU (tests run on a CPU mesh) the public entrypoint falls back to a
 mathematically identical jnp implementation.
@@ -103,8 +107,26 @@ def _merge_heads(t):
     return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
 
+def _pick_block(preferred: int, seq: int) -> int:
+    """Largest 128-multiple block <= preferred that divides seq (grids
+    are seq // block; a non-divisor would silently drop rows): seq 384
+    with preferred 512 -> 384, seq 768 with preferred 512 -> 384, seq 384
+    with preferred 256 -> 128. Sub-128 seqs (interpret-mode tests) fall
+    back to halving."""
+    b = min(preferred, seq)
+    b -= b % 128
+    while b >= 128:
+        if seq % b == 0:
+            return b
+        b -= 128
+    b = min(preferred, seq)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
 def _flash_attention_tpu(q, k, v, causal: bool, scale: float,
-                         block_q: int = 128, block_k: int = 128,
+                         block_q: int = 256, block_k: int = 512,
                          interpret: bool | None = None,
                          return_residuals: bool = False):
     """``interpret=True`` runs the kernel body through the Pallas
@@ -119,8 +141,8 @@ def _flash_attention_tpu(q, k, v, causal: bool, scale: float,
         interpret = _INTERPRET
     b, s, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, s)
-    block_k = min(block_k, sk)
+    block_q = _pick_block(block_q, s)
+    block_k = _pick_block(block_k, sk)
     qm, km, vm = _merge_heads(q), _merge_heads(k), _merge_heads(v)
     grid = (b * h, s // block_q)
     out_shape = [jax.ShapeDtypeStruct((b * h, s, d), q.dtype)]
@@ -246,7 +268,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_attention_bwd_tpu(q, k, v, o, lse, g, causal: bool, scale: float,
-                             block_q: int = 128, block_k: int = 128,
+                             block_q: int = 256, block_k: int = 512,
                              interpret: bool | None = None):
     """Blockwise flash-attention backward: dq gridded over Q blocks, dk/dv
     gridded over K blocks, probabilities recomputed from ``lse``. HBM
@@ -259,8 +281,8 @@ def _flash_attention_bwd_tpu(q, k, v, o, lse, g, causal: bool, scale: float,
         interpret = _INTERPRET
     b, s, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, s)
-    block_k = min(block_k, sk)
+    block_q = _pick_block(block_q, s)
+    block_k = _pick_block(block_k, sk)
     qm, km, vm = _merge_heads(q), _merge_heads(k), _merge_heads(v)
     om, gm = _merge_heads(o), _merge_heads(g)
     # delta_i = rowsum(dO_i * O_i): cheap elementwise, fused by XLA; lane-

@@ -281,6 +281,38 @@ def test_pallas_flash_bwd_kernels_match_reference_grad():
                                    atol=2e-4)
 
 
+def test_pallas_flash_block_picker_covers_indivisible_seq():
+    """seq=384 divides by 128 but not by the 256/512 preferred blocks:
+    the block picker must fall to a divisor (a non-divisor grid silently
+    drops rows — caught as NaNs when the defaults were first raised)."""
+    from move2kube_tpu.ops import attention
+
+    assert attention._pick_block(256, 384) == 128
+    assert attention._pick_block(512, 384) == 384
+    assert attention._pick_block(512, 2048) == 512
+    # steps down by 128-multiples, not halving: 768 keeps a 384 tile
+    assert attention._pick_block(512, 768) == 384
+    assert attention._pick_block(512, 1152) == 384
+
+    b, s, h, d = 1, 384, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(3), 4)
+    q, k, v, g = (jax.random.normal(kk, (b, s, h, d), jnp.float32)
+                  for kk in ks)
+    scale = d ** -0.5
+    o, lse = attention._flash_attention_tpu(
+        q, k, v, True, scale, interpret=True, return_residuals=True)
+    ref = attention._reference_attention(q, k, v, True, scale)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=1e-4)
+    dq, dk, dv = attention._flash_attention_bwd_tpu(
+        q, k, v, o, lse, g, True, scale, interpret=True)
+    _, vjp = jax.vjp(
+        lambda a, b_, c: attention._reference_attention(a, b_, c, True,
+                                                        scale), q, k, v)
+    for got, want in zip((dq, dk, dv), vjp(g)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4)
+
+
 def test_flash_attention_custom_vjp_matches_reference_grad(monkeypatch):
     """jax.grad through _flash_attention_diff's custom_vjp with the REAL
     forward + backward kernels in interpret mode: verifies the residual
