@@ -2,12 +2,16 @@
 """Benchmark: translated-workload training throughput on the attached TPU.
 
 Measures BASELINE config 2 (PyTorch ResNet-50 CUDA train.py -> jax-xla
-containerizer, single v5e chip, img/s) as the primary metric and BASELINE
-config 3 (HF BERT fine-tune, samples/s) plus a Pallas flash-attention
-numeric check in the ``extra`` field — all from ONE plain ``python
-bench.py`` invocation. Both model phases drive the same model-zoo code the
-containerizer vendors into emitted images, i.e. they measure what a
-translated workload actually achieves.
+containerizer, single v5e chip, img/s) as the primary metric, plus in
+``extra``: BASELINE config 3 (HF BERT fine-tune, samples/s), a Pallas
+flash-attention on-silicon proof (fwd + blockwise bwd vs the jnp
+reference, TFLOP/s, and vs_official_kernel against the public hand-
+written TPU kernel), and a long-context Llama-class training phase
+(config 5's single-chip analogue: attn_impl="flash" drives the Pallas
+fwd AND bwd kernels inside a real remat+AdamW train step, tokens/s) —
+all from ONE plain ``python bench.py`` invocation. The model phases
+drive the same model-zoo code the containerizer vendors into emitted
+images, i.e. they measure what a translated workload actually achieves.
 
 Prints exactly ONE JSON line on stdout:
   {"metric", "value", "unit", "vs_baseline", "extra": {...}}
@@ -62,19 +66,20 @@ MAX_WARMUP_CALLS = int(os.environ.get("M2KT_BENCH_MAX_WARMUP", "4"))
 WARM_FAST_S = float(os.environ.get("M2KT_BENCH_WARM_FAST_S", "3.0"))
 MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
-PHASES = ("resnet", "bert", "pallas", "translate")
+PHASES = ("resnet", "bert", "pallas", "llama", "translate")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
     "resnet": ("resnet50_train_throughput_v5e1", "img/s"),
     "bert": ("bert_finetune_throughput_v5e1", "samples/s"),
     "pallas": ("pallas_flash_attention_tflops_v5e1", "TFLOP/s"),
+    "llama": ("llama_train_throughput_v5e1", "tokens/s"),
     "translate": ("gpu2tpu_translate_throughput", "services/s"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
 # never cost the artifact its one always-measurable number
-TPU_PHASES = ("resnet", "bert", "pallas")
+TPU_PHASES = ("resnet", "bert", "pallas", "llama")
 # On-silicon results captured opportunistically during a builder session
 # (``--opportunistic``): when the tunnel is down at the driver's single
 # end-of-round invocation, run_parent folds these in (clearly labeled
@@ -241,6 +246,89 @@ def bench_bert(n: int) -> dict:
         "mfu": round(mfu, 4),
         "batch": batch,
         "vs_baseline": round(samples_s / BERT_ANCHOR, 3),
+    }
+
+
+LLAMA_BATCH = int(os.environ.get("M2KT_BENCH_LLAMA_BATCH", "4"))
+LLAMA_SEQ = int(os.environ.get("M2KT_BENCH_LLAMA_SEQ", "2048"))
+
+
+def bench_llama(n: int) -> dict:
+    """Decoder-LM training throughput at long context: a ~200M-param
+    Llama-class model with attn_impl="flash", so the Pallas forward AND
+    blockwise backward kernels run inside a REAL jitted train step (remat
+    + AdamW), not just the pallas phase's isolated grad check. The 6*N*T
+    rule anchors vs_baseline the same way as BERT."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from move2kube_tpu.models import train as m2kt_train
+    from move2kube_tpu.models.llama import Llama, LlamaConfig
+    from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=n))
+    cfg = LlamaConfig(
+        vocab_size=32000, d_model=1024, num_layers=8, num_heads=16,
+        num_kv_heads=8, mlp_dim=2816, max_len=LLAMA_SEQ,
+        attn_impl="flash")
+
+    def n_params(c):
+        per_layer = (c.d_model * (c.num_heads + 2 * c.num_kv_heads)
+                     * (c.d_model // c.num_heads)   # qkv
+                     + c.d_model * c.d_model         # attn_out
+                     + 3 * c.d_model * c.mlp_dim)    # gate_up + down
+        return (c.vocab_size * c.d_model * 2         # embed + lm_head
+                + c.num_layers * per_layer)
+
+    flops_per_token = 6 * n_params(cfg)
+
+    def measure_at(batch: int):
+        ids0 = jnp.zeros((batch, LLAMA_SEQ), jnp.int32)
+        state = m2kt_train.create_sharded_state(
+            jax.random.PRNGKey(0), Llama(cfg), {"input_ids": ids0},
+            optax.adamw(3e-4), mesh)
+        step = m2kt_train.make_lm_train_step(mesh)
+        make = jax.jit(lambda key: {"input_ids": jax.random.randint(
+            key, (batch, LLAMA_SEQ), 0, cfg.vocab_size, jnp.int32)})
+        batch_data = make(jax.random.PRNGKey(1))
+        float(jnp.sum(batch_data["input_ids"]))  # transfer = true sync
+        # no scan wrapper here (make_lm_train_step is single-step); the
+        # adaptive warmup below absorbs executable streaming, and each
+        # measured call is seconds long so dispatch latency is noise
+        for i in range(MAX_WARMUP_CALLS):
+            t0 = time.perf_counter()
+            state, loss = step(state, batch_data)
+            float(loss)
+            dt = time.perf_counter() - t0
+            if dt < WARM_FAST_S:
+                break
+            print(f"[bench] llama warmup call {i}: {dt:.1f}s",
+                  file=sys.stderr)
+        t0 = time.perf_counter()
+        for _ in range(MEASURE_CALLS):
+            state, loss = step(state, batch_data)
+        final_loss = float(loss)
+        dt = time.perf_counter() - t0
+        if final_loss != final_loss:
+            raise RuntimeError(f"training diverged: loss={final_loss}")
+        return MEASURE_CALLS * batch * LLAMA_SEQ / dt, final_loss
+
+    (tok_s, loss), batch = _with_batch_fallback(measure_at, LLAMA_BATCH,
+                                                min_batch=1, phase="llama")
+    mfu = tok_s * flops_per_token / V5E_PEAK_BF16_FLOPS
+    print(f"[bench] llama loss={loss:.3f} mfu={mfu:.1%}", file=sys.stderr)
+    metric, unit = PHASE_METRICS["llama"]
+    anchor = V5E_PEAK_BF16_FLOPS * ANCHOR_MFU / flops_per_token
+    return {
+        "phase": "llama",
+        "metric": metric,
+        "value": round(tok_s, 1),
+        "unit": unit,
+        "mfu": round(mfu, 4),
+        "batch": batch,
+        "seq_len": LLAMA_SEQ,
+        "vs_baseline": round(tok_s / anchor, 3),
     }
 
 
@@ -420,7 +508,8 @@ def run_child(phases: list[str]) -> int:
                   file=sys.stderr)
             return 1
     fns = {"resnet": bench_resnet, "bert": bench_bert,
-           "pallas": bench_pallas, "translate": bench_translate}
+           "pallas": bench_pallas, "llama": bench_llama,
+           "translate": bench_translate}
     ok = True
     for phase in phases:
         try:
@@ -443,9 +532,10 @@ MAX_PHASE_FAILS = 2  # in-child exceptions per phase before giving up on it
 
 
 # env var carrying a phase's batch size into the child (module constants
-# RESNET_BATCH/BERT_BATCH read these at import)
+# RESNET_BATCH/BERT_BATCH/LLAMA_BATCH read these at import)
 PHASE_BATCH_ENV = {"resnet": "M2KT_BENCH_RESNET_BATCH",
-                   "bert": "M2KT_BENCH_BERT_BATCH"}
+                   "bert": "M2KT_BENCH_BERT_BATCH",
+                   "llama": "M2KT_BENCH_LLAMA_BATCH"}
 
 
 def _harvest(text: str, results: dict, fails: dict,
