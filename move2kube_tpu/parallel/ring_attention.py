@@ -8,6 +8,14 @@ accumulation (running max + weighted sums) keeps the result exact.
 
 Used inside ``shard_map`` over a mesh with a non-trivial ``seq`` axis; for
 seq=1 meshes it degrades to one local block (no collectives).
+
+Memory contract: each ring step materializes one [b, h, s_local,
+s_local] score block (s_local = seq / seq_axis_size), transient and
+freed per step. Size the ``seq`` axis so shards stay <= ~4k (65k context
+-> seq>=16, or seq=8 with 8k shards at ~0.5GB/step for b=1, h=8 f32);
+a fused Pallas ring step (flash per block + lse-merge, whole-ring
+custom_vjp) can replace _block_attn without changing callers if longer
+shards are needed.
 """
 
 from __future__ import annotations
