@@ -12,7 +12,8 @@ seq=1 meshes it degrades to one local block (no collectives).
 Memory contract: each ring step materializes one [b, h, s_local,
 s_local] score block (s_local = seq / seq_axis_size), transient and
 freed per step. Size the ``seq`` axis so shards stay <= ~4k (65k context
--> seq>=16, or seq=8 with 8k shards at ~0.5GB/step for b=1, h=8 f32);
+-> seq>=16; seq=8 leaves 8k shards whose score block alone is ~2GB/step
+for b=1, h=8 f32 — too close to HBM limits);
 a fused Pallas ring step (flash per block + lse-merge, whole-ring
 custom_vjp) can replace _block_attn without changing callers if longer
 shards are needed.
