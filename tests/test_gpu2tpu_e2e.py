@@ -625,3 +625,24 @@ def test_translate_bert_finetune(tmp_path):
     )
     assert run.returncode == 0, run.stderr[-2000:]
     assert "[m2kt] done" in run.stdout
+
+    # and with REAL data (M2KT_DATA): npz -> host-sharded loader ->
+    # prefetch thread -> row gather, inside the emitted program — the
+    # full input pipeline rather than the synthetic fallback (the bert
+    # step consumes input_ids/label, exactly what the npz carries)
+    import numpy as np
+
+    gen = np.random.default_rng(0)
+    np.savez(cdir / "train.npz",
+             input_ids=gen.integers(0, 512, (64, 16)).astype(np.int32),
+             label=gen.integers(0, 2, 64).astype(np.int32))
+    run = run_emitted_program(
+        cdir, M2KT_DATA="train.npz",
+        M2KT_STEPS="2", M2KT_BATCH_PER_DEVICE="1", M2KT_SEQ_LEN="16",
+        M2KT_NUM_CLASSES="2", M2KT_VOCAB="512", M2KT_LAYERS="2",
+        M2KT_HEADS="2", M2KT_DMODEL="64", M2KT_MLP_DIM="128",
+        M2KT_MESH_DATA="8", M2KT_MESH_FSDP="1", M2KT_MESH_PIPE="1",
+        M2KT_MESH_TENSOR="1", M2KT_MESH_SEQ="1", M2KT_MESH_EXPERT="1",
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+    assert "[m2kt] done" in run.stdout
