@@ -781,6 +781,8 @@ def run_opportunistic() -> int:
         datetime.timezone.utc).isoformat(timespec="seconds")
     data["captured_at"] = now
     data["source"] = "opportunistic_capture"
+    data["note"] = ("latest opportunistic on-silicon capture (newest per "
+                    "phase wins; each phase carries its own captured_at)")
     for phase, r in results.items():
         # newest capture wins: the artifact must reflect what the CURRENT
         # code measures, including fixes that legitimately lower a number

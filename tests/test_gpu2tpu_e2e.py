@@ -6,7 +6,12 @@ import os
 import subprocess
 import sys
 
+import pytest
 import yaml
+
+# every test here translates a sample tree and most execute the emitted
+# trainer in a subprocess (20-100s each) — the definition of "slow"
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SAMPLES = os.path.join(REPO, "samples")
