@@ -27,14 +27,52 @@ DEFAULT_PORT = 8080
 
 
 class KnativeServiceAPIResource(APIResource):
+    """``create=False`` (k8s output mode): only converts cached knative
+    objects — lowering them to Deployment+Service on clusters without the
+    serving.knative.dev group. ``create=True`` (knative output mode,
+    parity ``internal/apiresource/knativeservice.go:41-70``): also
+    creates one knative Service per IR service and keeps knative objects
+    as knative regardless of cluster support — the user chose knative
+    output, so lowering would defeat the choice (the reference's
+    ConvertToClusterSupportedKinds likewise always passes them through).
+    """
+
+    def __init__(self, create: bool = False) -> None:
+        self.create = create
+
     def get_supported_kinds(self) -> list[str]:
         return ["Service"]
 
     def get_supported_groups(self) -> set[str]:
         return {KNATIVE_GROUP}
 
+    def owns(self, obj: dict) -> bool:
+        if self.create:
+            # knative output mode claims EVERY serving.knative.dev kind
+            # (Route, Configuration, Revision...) so cached ones ride the
+            # keep-as-knative path below instead of the unowned pass
+            # where ignore_unsupported_kinds would drop them
+            return group_of(obj.get("apiVersion", "")) == KNATIVE_GROUP
+        return super().owns(obj)
+
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
-        return []  # creation lives in KnativeTransformer (knative output mode)
+        if not self.create:
+            return []  # k8s output mode: conversion of cached objects only
+        objs = []
+        for svc in ir.services.values():
+            if not svc.containers or svc.job:
+                continue  # knative serves long-running HTTP, not batch jobs
+            pod_spec = svc.pod_spec()
+            # knative revisions are restarted by the autoscaler; parity:
+            # knativeservice.go:46 pins RestartPolicy Always
+            pod_spec["restartPolicy"] = "Always"
+            labels = {"app": svc.name, **svc.labels}
+            obj = make_obj("Service", f"{KNATIVE_GROUP}/v1", svc.name, labels)
+            if svc.annotations:
+                obj["metadata"]["annotations"] = dict(svc.annotations)
+            obj["spec"] = {"template": {"spec": pod_spec}}
+            objs.append(obj)
+        return objs
 
     def _supported_on(self, cluster) -> set[str]:
         if not cluster.api_kind_version_map:
@@ -48,7 +86,7 @@ class KnativeServiceAPIResource(APIResource):
     def convert_to_cluster_supported_kinds(
         self, obj: dict, supported_kinds: set[str], other_objs: list[dict], ir: IR,
     ) -> list[dict]:
-        if supported_kinds:
+        if self.create or supported_kinds:
             return [obj]
         name = obj_name(obj)
         tmpl = (obj.get("spec", {}).get("template", {}) or {})
@@ -72,3 +110,17 @@ class KnativeServiceAPIResource(APIResource):
         }
         log.info("lowered knative service %s to Deployment+Service", name)
         return [deployment, service]
+
+    def _fix_version(self, obj, cluster, ir):
+        if not self.create or group_of(obj.get("apiVersion", "")) != KNATIVE_GROUP:
+            return super()._fix_version(obj, cluster, ir)
+        # knative output mode: convert to the cluster's advertised knative
+        # version when there is one; otherwise keep the object's version
+        # (the user chose knative output — never drop or lower here)
+        knative_versions = [
+            v for v in cluster.get_supported_versions(obj.get("kind", ""))
+            if group_of(v) == KNATIVE_GROUP
+        ]
+        if knative_versions:
+            obj["apiVersion"] = knative_versions[0]
+        return [obj]

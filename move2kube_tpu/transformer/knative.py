@@ -2,13 +2,18 @@
 
 Parity: ``internal/transformer/knativetransformer.go:46-100`` +
 ``internal/apiresourceset/knativeapiresourceset.go`` — one Knative Service
-per IR service, deploy script, README.
+per IR service (built by ``KnativeServiceAPIResource(create=True)``),
+routed through the same apiresource engine as the K8s transformer so
+cached knative objects merge by name and every emitted object gets the
+write-time cluster version fix, then deploy script + README.
 """
 
 from __future__ import annotations
 
 import os
 
+from move2kube_tpu.apiresource.base import convert_objects
+from move2kube_tpu.apiresource.knative import KnativeServiceAPIResource
 from move2kube_tpu.transformer import templates
 from move2kube_tpu.transformer.base import Transformer, write_containers, write_objects
 from move2kube_tpu.types.ir import IR
@@ -20,24 +25,7 @@ class KnativeTransformer(Transformer):
         self.objs: list[dict] = []
 
     def transform(self, ir: IR) -> None:
-        self.objs = []
-        for svc in ir.services.values():
-            if not svc.containers or svc.job:
-                continue
-            obj = {
-                "apiVersion": "serving.knative.dev/v1",
-                "kind": "Service",
-                "metadata": {"name": svc.name},
-                "spec": {"template": {"spec": {
-                    "containers": [dict(c) for c in svc.containers],
-                }}},
-            }
-            self.objs.append(obj)
-        # pass through cached knative objects
-        for obj in ir.cached_objects:
-            if str(obj.get("apiVersion", "")).startswith("serving.knative.dev"):
-                if obj not in self.objs:
-                    self.objs.append(obj)
+        self.objs = convert_objects(ir, [KnativeServiceAPIResource(create=True)])
 
     def write_objects(self, out_dir: str, ir: IR) -> None:
         proj = common.make_dns_label(ir.name)
