@@ -61,13 +61,15 @@ def _convert_ingress_backend_v1_to_v1beta1(b: dict | None) -> dict | None:
     return out
 
 
-def convert_ingress_spec(obj: dict, to_group: str) -> None:
-    """Rewrite an Ingress spec between networking.k8s.io/v1 and
-    extensions/v1beta1 schemas in place: the backend shape and pathType
-    changed across the group rename, so an apiVersion bump alone emits
-    schema-invalid yaml."""
+def convert_ingress_spec(obj: dict, to_version: str) -> None:
+    """Rewrite an Ingress spec between the v1 and v1beta1 schemas in
+    place: the backend shape and pathType changed at networking.k8s.io/v1,
+    so an apiVersion bump alone emits schema-invalid yaml. Keyed on the
+    target VERSION, not the group — ``networking.k8s.io/v1beta1`` (the
+    EKS/AKS/GKE vintage in the reference tables, constants.go) uses the
+    same legacy backend shape as ``extensions/v1beta1``."""
     spec = obj.get("spec") or {}
-    modern = to_group == "networking.k8s.io"
+    modern = to_version == "networking.k8s.io/v1"
     conv = (_convert_ingress_backend_v1beta1_to_v1 if modern
             else _convert_ingress_backend_v1_to_v1beta1)
     if modern and "backend" in spec:
@@ -85,6 +87,140 @@ def convert_ingress_spec(obj: dict, to_group: str) -> None:
                 path.setdefault("pathType", "ImplementationSpecific")
             else:
                 path.pop("pathType", None)
+
+
+# metric-source key per HPA metric type (v2 field names; v2beta1 uses the
+# same keys with flat target fields inside)
+_HPA_SOURCE_KEYS = {"Resource": "resource", "ContainerResource":
+                    "containerResource", "Pods": "pods", "Object": "object",
+                    "External": "external"}
+
+
+def _hpa_metric_to_v2beta1(m: dict) -> dict:
+    """One metric entry: v2/v2beta2 shape -> v2beta1 flat fields, for
+    every metric type (Resource/ContainerResource keep ``name``;
+    Pods/Object/External carry ``metricName``/``selector`` flat)."""
+    key = _HPA_SOURCE_KEYS.get(m.get("type", ""))
+    if not key or not isinstance(m.get(key), dict):
+        return m
+    src = dict(m[key])
+    target = src.pop("target", None)
+    metric = src.pop("metric", None)
+    if isinstance(metric, dict):
+        src["metricName"] = metric.get("name")
+        if metric.get("selector") is not None:
+            src["selector" if key != "external" else "metricSelector"] = \
+                metric["selector"]
+    if isinstance(target, dict):
+        for vkey, legacy in (("averageUtilization", "targetAverageUtilization"),
+                             ("averageValue", "targetAverageValue"),
+                             ("value", "targetValue")):
+            if vkey in target:
+                src[legacy] = target[vkey]
+    out = dict(m)
+    out[key] = src
+    return out
+
+
+def _hpa_metric_from_v2beta1(m: dict) -> dict:
+    """One metric entry: v2beta1 flat fields -> v2/v2beta2 shape, for
+    every metric type."""
+    key = _HPA_SOURCE_KEYS.get(m.get("type", ""))
+    if not key or not isinstance(m.get(key), dict):
+        return m
+    src = dict(m[key])
+    if "target" in src:
+        return m  # already modern-shaped
+    target: dict = {}
+    if "targetAverageUtilization" in src:
+        target = {"type": "Utilization",
+                  "averageUtilization": src.pop("targetAverageUtilization")}
+    elif "targetAverageValue" in src:
+        target = {"type": "AverageValue",
+                  "averageValue": src.pop("targetAverageValue")}
+    elif "targetValue" in src:
+        target = {"type": "Value", "value": src.pop("targetValue")}
+    metric_name = src.pop("metricName", None)
+    selector = src.pop("metricSelector" if key == "external" else "selector",
+                       None)
+    if metric_name is not None:
+        metric: dict = {"name": metric_name}
+        if selector is not None:
+            metric["selector"] = selector
+        src["metric"] = metric
+    if target:
+        src["target"] = target
+    out = dict(m)
+    out[key] = src
+    return out
+
+
+def _hpa_cpu_utilization(m: dict) -> int | None:
+    """CPU utilization percentage of a metric entry (any v2 shape)."""
+    res = m.get("resource") or {}
+    if m.get("type") != "Resource" or res.get("name") != "cpu":
+        return None
+    target = res.get("target") or {}
+    return target.get("averageUtilization",
+                      res.get("targetAverageUtilization"))
+
+
+def _convert_hpa_spec(obj: dict, to_version: str) -> None:
+    """HorizontalPodAutoscaler version rewrites (the reference vintage
+    tables prefer ``autoscaling/v1`` everywhere, constants.go):
+
+    - to v1: the metrics list collapses to its CPU-utilization entry
+      (``targetCPUUtilizationPercentage``); anything else cannot be
+      expressed and is dropped with a warning.
+    - to v2beta1: per-metric ``target`` objects flatten to the legacy
+      ``targetAverageUtilization``/``targetAverageValue`` fields.
+    - to v2/v2beta2: flat v2beta1 fields re-expand into ``target``
+      objects, and a v1 ``targetCPUUtilizationPercentage`` becomes a
+      CPU-utilization metric."""
+    spec = obj.get("spec") or {}
+    if to_version == "autoscaling/v1":
+        metrics = spec.pop("metrics", None) or []
+        spec.pop("behavior", None)
+        for m in metrics:
+            util = _hpa_cpu_utilization(m)
+            if util is not None:
+                spec["targetCPUUtilizationPercentage"] = util
+            else:
+                log.warning("dropping HPA metric %s on %s (only CPU "
+                            "utilization is expressible in autoscaling/v1)",
+                            m.get("type"), obj_name(obj))
+    elif to_version.startswith("autoscaling/v2"):
+        if to_version == "autoscaling/v2beta1":
+            spec.pop("behavior", None)  # behavior exists from v2beta2 on
+            conv = _hpa_metric_to_v2beta1
+        else:
+            conv = _hpa_metric_from_v2beta1
+        if spec.get("metrics"):
+            spec["metrics"] = [conv(m) for m in spec["metrics"]]
+        util = spec.pop("targetCPUUtilizationPercentage", None)
+        if util is not None and not spec.get("metrics"):
+            res = ({"name": "cpu", "targetAverageUtilization": util}
+                   if to_version == "autoscaling/v2beta1" else
+                   {"name": "cpu", "target": {"type": "Utilization",
+                                              "averageUtilization": util}})
+            spec["metrics"] = [{"type": "Resource", "resource": res}]
+
+
+def convert_spec_between_versions(obj: dict, to_version: str) -> None:
+    """Schema rewrites that must accompany an apiVersion change (parity:
+    the reference's per-kind convert functions driven by the cluster's
+    preferred-version tables, k8stransformer.go:94-156). Kinds not listed
+    here (Deployment apps/v1beta*/extensions, CronJob batch/v1beta1,
+    DaemonSet/StatefulSet vintages) are schema-compatible across their
+    listed versions for everything this tool emits, so the apiVersion
+    bump alone is valid."""
+    if obj.get("apiVersion") == to_version:
+        return
+    kind = obj_kind(obj)
+    if kind == "Ingress":
+        convert_ingress_spec(obj, to_version)
+    elif kind == "HorizontalPodAutoscaler":
+        _convert_hpa_spec(obj, to_version)
 
 
 def make_obj(kind: str, api_version: str, name: str, labels: dict | None = None) -> dict:
@@ -171,33 +307,42 @@ class APIResource:
         )
 
     def _fix_version(self, obj: dict, cluster: ClusterMetadataSpec, ir: IR) -> list[dict]:
-        kind = obj_kind(obj)
-        versions = cluster.get_supported_versions(kind)
-        if not cluster.api_kind_version_map:
+        return fix_object_version(
+            obj, cluster, ir.kubernetes.ignore_unsupported_kinds)
+
+
+def fix_object_version(obj: dict, cluster: ClusterMetadataSpec,
+                       ignore_unsupported: bool) -> list[dict]:
+    """Convert ``obj`` to the cluster's preferred supported version
+    (parity: the reference converts EVERY written object this way —
+    ``k8stransformer.go:108-142`` — so this also runs on cached kinds no
+    APIResource owns, e.g. CronJob/HPA)."""
+    kind = obj_kind(obj)
+    versions = cluster.get_supported_versions(kind)
+    if not cluster.api_kind_version_map:
+        return [obj]
+    if versions:
+        # same-group versions only: "Service v1" supported does NOT
+        # make a serving.knative.dev Service expressible as core v1
+        grp = group_of(obj.get("apiVersion", ""))
+        same_group = [v for v in versions if group_of(v) == grp]
+        if not same_group:
+            # pre-1.16 "extensions" umbrella split into real groups;
+            # crossing that rename is an apiVersion bump for most
+            # kinds, plus a spec rewrite for Ingress
+            for alias in _GROUP_ALIASES.get(grp, ()):
+                same_group = [v for v in versions if group_of(v) == alias]
+                if same_group:
+                    break
+        if same_group:
+            convert_spec_between_versions(obj, same_group[0])
+            obj["apiVersion"] = same_group[0]
             return [obj]
-        if versions:
-            # same-group versions only: "Service v1" supported does NOT
-            # make a serving.knative.dev Service expressible as core v1
-            grp = group_of(obj.get("apiVersion", ""))
-            same_group = [v for v in versions if group_of(v) == grp]
-            if not same_group:
-                # pre-1.16 "extensions" umbrella split into real groups;
-                # crossing that rename is an apiVersion bump for most
-                # kinds, plus a spec rewrite for Ingress
-                for alias in _GROUP_ALIASES.get(grp, ()):
-                    same_group = [v for v in versions if group_of(v) == alias]
-                    if same_group:
-                        if kind == "Ingress":
-                            convert_ingress_spec(obj, group_of(same_group[0]))
-                        break
-            if same_group:
-                obj["apiVersion"] = same_group[0]
-                return [obj]
-            versions = []  # cross-group only: fall through as unsupported
-        if ir.kubernetes.ignore_unsupported_kinds:
-            log.warning("dropping unsupported kind %s/%s", kind, obj_name(obj))
-            return []
-        return [obj]  # keep as-is; user asked to keep unsupported kinds
+        versions = []  # cross-group only: fall through as unsupported
+    if ignore_unsupported:
+        log.warning("dropping unsupported kind %s/%s", kind, obj_name(obj))
+        return []
+    return [obj]  # keep as-is; user asked to keep unsupported kinds
 
 
 def convert_objects(ir: IR, resources: list[APIResource]) -> list[dict]:
@@ -212,7 +357,10 @@ def convert_objects(ir: IR, resources: list[APIResource]) -> list[dict]:
             log.warning("apiresource %s failed: %s", type(r).__name__, e)
     for obj in ir.cached_objects:
         if not any(r.owns(obj) for r in resources):
-            out.append(obj)
+            # unowned kinds still get the write-time version fix — the
+            # reference converts every written object (k8stransformer.go:108)
+            out.extend(fix_object_version(
+                obj, cluster, ir.kubernetes.ignore_unsupported_kinds))
     _fixup_dangling_pvcs(out, cluster)
     return out
 

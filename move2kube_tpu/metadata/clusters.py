@@ -2,11 +2,21 @@
 
 Parity: ``internal/metadata/clusters/constants.go`` — kind -> preferred
 group/version tables for AWS-EKS, Azure-AKS, GCP-GKE, IBM-IKS,
-IBM-Openshift, Kubernetes, Openshift.
+IBM-Openshift, Kubernetes, Openshift. Each profile carries the
+multi-version preference lists of the cluster vintage it names
+(constants.go:23-1116): the FIRST same-group entry wins at write time
+(apiresource/base.py ``_fix_version``), so e.g. an EKS target downgrades
+emitted Ingresses to ``networking.k8s.io/v1beta1`` (with the legacy
+backend schema) and CronJobs to ``batch/v1beta1``, while the vintage
+Openshift profiles keep ``extensions/v1beta1`` Ingresses.
 
-Net-new: the **GCP-GKE-TPU** profile adds JobSet (jobset.x-k8s.io) so TPU
-training services emit multi-host JobSets; it is the default target when a
-plan contains Gpu2Tpu services.
+Net-new profiles:
+- **GCP-GKE-TPU** adds JobSet (jobset.x-k8s.io) + modern versions so TPU
+  training services emit multi-host JobSets; it is the default target
+  when a plan contains Gpu2Tpu services.
+- **Kubernetes-Knative** advertises ``serving.knative.dev`` so the
+  Knative transformer's write-time version fix has a knative-capable
+  builtin target.
 """
 
 from __future__ import annotations
@@ -21,16 +31,31 @@ _COMMON_CORE: dict[str, list[str]] = {
     "PersistentVolumeClaim": ["v1"],
     "ServiceAccount": ["v1"],
     "ReplicationController": ["v1"],
-    "Role": ["rbac.authorization.k8s.io/v1"],
-    "RoleBinding": ["rbac.authorization.k8s.io/v1"],
+    "Role": ["rbac.authorization.k8s.io/v1", "rbac.authorization.k8s.io/v1beta1"],
+    "RoleBinding": ["rbac.authorization.k8s.io/v1",
+                    "rbac.authorization.k8s.io/v1beta1"],
     "Deployment": ["apps/v1"],
     "DaemonSet": ["apps/v1"],
     "StatefulSet": ["apps/v1"],
     "Job": ["batch/v1"],
-    "CronJob": ["batch/v1"],
-    "Ingress": ["networking.k8s.io/v1"],
+    # cluster vintages captured by the reference tables: CronJob GA'd
+    # (batch/v1) only in k8s 1.21, so every profile prefers v1beta1
+    "CronJob": ["batch/v1beta1"],
+    "Ingress": ["networking.k8s.io/v1", "networking.k8s.io/v1beta1",
+                "extensions/v1beta1"],
     "NetworkPolicy": ["networking.k8s.io/v1"],
-    "HorizontalPodAutoscaler": ["autoscaling/v2"],
+    "HorizontalPodAutoscaler": ["autoscaling/v1", "autoscaling/v2beta1",
+                                "autoscaling/v2beta2"],
+    "PodSecurityPolicy": ["policy/v1beta1"],
+}
+
+# EKS/AKS/GKE vintage: Ingress pre-dates networking.k8s.io/v1
+_HOSTED_CLOUD_OVERRIDES: dict[str, list[str]] = {
+    "Ingress": ["networking.k8s.io/v1beta1", "extensions/v1beta1"],
+}
+
+_IKS_OVERRIDES: dict[str, list[str]] = {
+    "CronJob": ["batch/v1beta1", "batch/v2alpha1"],
 }
 
 _OPENSHIFT_EXTRAS: dict[str, list[str]] = {
@@ -38,6 +63,24 @@ _OPENSHIFT_EXTRAS: dict[str, list[str]] = {
     "Route": ["route.openshift.io/v1"],
     "ImageStream": ["image.openshift.io/v1"],
     "BuildConfig": ["build.openshift.io/v1"],
+    # vintage 3.x/4.x Openshift: legacy apps groups still served, and
+    # Ingress only via the extensions umbrella (Routes are the native way)
+    "Deployment": ["apps/v1", "apps/v1beta1", "apps/v1beta2",
+                   "extensions/v1beta1"],
+    "DaemonSet": ["apps/v1", "apps/v1beta2", "extensions/v1beta1"],
+    "StatefulSet": ["apps/v1", "apps/v1beta1", "apps/v1beta2"],
+    "Ingress": ["extensions/v1beta1"],
+    "NetworkPolicy": ["networking.k8s.io/v1", "extensions/v1beta1"],
+    "HorizontalPodAutoscaler": ["autoscaling/v1", "autoscaling/v2beta1"],
+    "PodSecurityPolicy": ["extensions/v1beta1", "policy/v1beta1"],
+}
+
+# modern-cluster overrides for the TPU profile: JobSet needs k8s >= 1.27,
+# where the legacy groups are long gone and CronJob/HPA are GA
+_MODERN_OVERRIDES: dict[str, list[str]] = {
+    "CronJob": ["batch/v1"],
+    "Ingress": ["networking.k8s.io/v1"],
+    "HorizontalPodAutoscaler": ["autoscaling/v2"],
 }
 
 _TEKTON: dict[str, list[str]] = {
@@ -74,17 +117,24 @@ def _profile(name: str, extra: dict[str, list[str]] | None = None,
 
 def builtin_clusters() -> dict[str, ClusterMetadata]:
     profiles = {
-        "Kubernetes": _profile("Kubernetes"),
-        "AWS-EKS": _profile("AWS-EKS", storage_classes=["gp2", "default"]),
-        "Azure-AKS": _profile("Azure-AKS", storage_classes=["managed-premium", "default"]),
-        "GCP-GKE": _profile("GCP-GKE", storage_classes=["standard-rwo", "standard"]),
-        "IBM-IKS": _profile("IBM-IKS", storage_classes=["ibmc-file-gold", "default"]),
+        "Kubernetes": _profile("Kubernetes", extra=_IKS_OVERRIDES),
+        "AWS-EKS": _profile("AWS-EKS", extra=_HOSTED_CLOUD_OVERRIDES,
+                            storage_classes=["gp2", "default"]),
+        "Azure-AKS": _profile("Azure-AKS", extra=_HOSTED_CLOUD_OVERRIDES,
+                              storage_classes=["managed-premium", "default"]),
+        "GCP-GKE": _profile("GCP-GKE", extra=_HOSTED_CLOUD_OVERRIDES,
+                            storage_classes=["standard-rwo", "standard"]),
+        "IBM-IKS": _profile("IBM-IKS", extra=_IKS_OVERRIDES,
+                            storage_classes=["ibmc-file-gold", "default"]),
         "IBM-Openshift": _profile("IBM-Openshift", extra=_OPENSHIFT_EXTRAS,
                                   storage_classes=["ibmc-file-gold", "default"]),
         "Openshift": _profile("Openshift", extra=_OPENSHIFT_EXTRAS),
+        "Kubernetes-Knative": _profile("Kubernetes-Knative",
+                                       extra=_IKS_OVERRIDES | _KNATIVE),
         "GCP-GKE-TPU": _profile(
             "GCP-GKE-TPU",
-            extra={"JobSet": ["jobset.x-k8s.io/v1alpha2"]},
+            extra=_MODERN_OVERRIDES | {"JobSet": ["jobset.x-k8s.io/v1alpha2"]},
+            drop=["PodSecurityPolicy"],  # removed in k8s 1.25; JobSet needs 1.27
             storage_classes=["standard-rwo", "standard"],
             tpu_accelerators=[
                 "tpu-v4-podslice",
