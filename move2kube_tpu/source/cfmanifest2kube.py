@@ -10,6 +10,7 @@ with env vars, instance counts, and the PORT convention.
 from __future__ import annotations
 
 import os
+import re
 
 from move2kube_tpu import containerizer
 from move2kube_tpu.source.base import Translator
@@ -27,6 +28,41 @@ from move2kube_tpu.utils.log import get_logger
 log = get_logger("source.cfmanifest")
 
 CF_MANIFEST_NAMES = ["manifest.yml", "manifest.yaml"]
+
+# bosh-style manifest variables: ((var)), ((var.subfield)), ((var-name))
+_CF_VAR_RE = re.compile(r"\(\(([\w.\-]+)\)\)")
+
+
+def interpolate_cf_variables(node, artifact_type, found: set[str]):
+    """Rewrite ``((var))`` placeholders inside the parsed manifest tree.
+
+    Parity: ``cfmanifest2kube.go:422-470`` (ReadApplicationManifest) —
+    unresolved manifest variables become Helm-resolvable template refs
+    (``{{ index .Values "globalvariables" "var" }}`` for Helm output,
+    ``{{ $var }}`` otherwise) and are collected so the translator can
+    register them as Helm global values. Operates on the YAML tree, not
+    the raw text: a text substitution would turn unquoted scalars like
+    ``instances: ((count))`` into invalid YAML."""
+    from move2kube_tpu.types.plan import TargetArtifactType
+
+    def placeholder(var: str) -> str:
+        if artifact_type == TargetArtifactType.HELM:
+            return '{{ index .Values "globalvariables" "%s" }}' % var
+        return "{{ $%s }}" % var
+
+    def walk(n):
+        if isinstance(n, str):
+            def sub(m):
+                found.add(m.group(1))
+                return placeholder(m.group(1))
+            return _CF_VAR_RE.sub(sub, n)
+        if isinstance(n, dict):
+            return {walk(k): walk(v) for k, v in n.items()}
+        if isinstance(n, list):
+            return [walk(x) for x in n]
+        return n
+
+    return walk(node)
 
 
 def find_cf_manifests(root: str) -> list[tuple[str, list[dict]]]:
@@ -113,18 +149,26 @@ class CfManifestTranslator(Translator):
     def translate(self, services: list[PlanService], plan: Plan) -> irtypes.IR:
         ir = irtypes.IR(name=plan.name)
         collected = _load_collected_apps(plan)
+        artifact_type = plan.kubernetes.effective_artifact_type()
         for plan_svc in services:
             manifests = plan_svc.source_artifacts.get(PlanService.CFMANIFEST_ARTIFACT, [])
             app_def: dict = {}
+            manifest_vars: set[str] = set()
             for m in manifests:
                 try:
                     doc = common.read_yaml(m)
+                    doc = interpolate_cf_variables(doc, artifact_type,
+                                                   manifest_vars)
                     for a in doc.get("applications", []):
                         if common.make_dns_label(str(a.get("name", ""))) == plan_svc.service_name:
                             app_def = a
                             break
                 except Exception:  # noqa: BLE001
                     continue
+            # unresolved ((var)) placeholders become Helm globals the
+            # user fills in values.yaml (cfmanifest2kube.go:304-307)
+            for var in sorted(manifest_vars):
+                ir.values.global_variables[var] = var
             try:
                 container = containerizer.get_container(plan, plan_svc)
             except Exception as e:  # noqa: BLE001
@@ -152,7 +196,12 @@ class CfManifestTranslator(Translator):
                         env.append({"name": k, "value": v})
                 svc.replicas = max(1, running.instances)
             if app_def.get("instances"):
-                svc.replicas = max(1, int(app_def["instances"]))
+                try:
+                    svc.replicas = max(1, int(app_def["instances"]))
+                except (TypeError, ValueError):
+                    # an interpolated ((var)) placeholder — keep default;
+                    # the value rides values.yaml globalvariables instead
+                    pass
             svc.containers.append({
                 "name": svc.name,
                 "image": image,

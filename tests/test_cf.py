@@ -194,3 +194,68 @@ def test_cf_pagination_followed(monkeypatch):
     merged = cfapps._cf_curl_all_pages("/v2/apps")
     apps = apps_from_v2_payload(merged)
     assert [a.name for a in apps.apps] == ["a", "b"]
+
+
+def test_interpolate_cf_variables_helm_and_plain():
+    """VERDICT r4 #8: ((var)) manifest placeholders become Helm-resolvable
+    template refs and are collected (cfmanifest2kube.go:422-470)."""
+    from move2kube_tpu.source.cfmanifest2kube import interpolate_cf_variables
+    from move2kube_tpu.types.plan import TargetArtifactType
+
+    doc = {"applications": [{
+        "name": "pay",
+        "instances": "((count))",
+        "env": {"API_KEY": "((api_key))", "MIXED": "pre-((zone))-post"},
+    }]}
+    found: set[str] = set()
+    out = interpolate_cf_variables(doc, TargetArtifactType.HELM, found)
+    assert found == {"count", "api_key", "zone"}
+    app = out["applications"][0]
+    assert app["instances"] == '{{ index .Values "globalvariables" "count" }}'
+    assert app["env"]["API_KEY"] == \
+        '{{ index .Values "globalvariables" "api_key" }}'
+    assert app["env"]["MIXED"] == \
+        'pre-{{ index .Values "globalvariables" "zone" }}-post'
+    # non-helm output: bare template variables (reference parity)
+    found2: set[str] = set()
+    out2 = interpolate_cf_variables(doc, TargetArtifactType.YAMLS, found2)
+    assert out2["applications"][0]["env"]["API_KEY"] == "{{ $api_key }}"
+    # original untouched
+    assert doc["applications"][0]["env"]["API_KEY"] == "((api_key))"
+
+
+def test_cf_manifest_variables_become_helm_globals(tmp_path, monkeypatch):
+    """Translate end: unresolved manifest variables land in
+    ir.values.global_variables; a variable replica count degrades to the
+    default instead of crashing int()."""
+    from move2kube_tpu import containerizer
+    from move2kube_tpu.source.cfmanifest2kube import CfManifestTranslator
+    from move2kube_tpu.types import ir as irtypes
+    from move2kube_tpu.types.plan import TargetArtifactType
+
+    src = tmp_path / "cfapp"
+    src.mkdir()
+    (src / "manifest.yml").write_text(
+        "applications:\n"
+        "- name: pay\n"
+        "  instances: ((count))\n"
+        "  env:\n"
+        "    API_KEY: ((api_key))\n"
+    )
+    plan = Plan(name="t", root_dir=str(src))
+    plan.kubernetes.artifact_type = TargetArtifactType.HELM
+    svc = PlanService(service_name="pay",
+                      container_build_type=ContainerBuildType.MANUAL)
+    svc.add_source_artifact(PlanService.CFMANIFEST_ARTIFACT,
+                            str(src / "manifest.yml"))
+    monkeypatch.setattr(
+        containerizer, "get_container",
+        lambda plan, s: irtypes.Container(image_names=["pay:latest"],
+                                          exposed_ports=[9000]))
+    ir = CfManifestTranslator().translate([svc], plan)
+    assert ir.values.global_variables == {"api_key": "api_key",
+                                          "count": "count"}
+    service = ir.services["pay"]
+    assert service.replicas == 1  # template string didn't crash int()
+    env = {e["name"]: e["value"] for e in service.containers[0]["env"]}
+    assert env["API_KEY"] == '{{ index .Values "globalvariables" "api_key" }}'
