@@ -156,14 +156,44 @@ class PrefetchLoader:
         self._thread: threading.Thread | None = None
         self._dead: BaseException | None = None
         self._terminated = False  # the one None sentinel was consumed
+        self._closed = False
 
     def _pump(self):
         try:
-            while True:
-                self._q.put(next(self._inner))
+            while not self._closed:
+                item = next(self._inner)
+                # bounded put so an abandoned loader (consumer broke out
+                # mid-epoch) unblocks and exits once close() is called,
+                # instead of pinning depth+1 batches for the process life
+                while not self._closed:
+                    try:
+                        self._q.put(item, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
         except BaseException as e:  # noqa: BLE001 - re-raised in __next__
             self._dead = e
             self._q.put(None)
+
+    def close(self) -> None:
+        """Stop the pump thread and drop buffered batches. Call when
+        abandoning iteration early (the emitted trainers drain fully and
+        don't need it; context-manager use covers ad-hoc consumers)."""
+        self._closed = True
+        if self._thread is not None:
+            while True:  # drain so a put-blocked pump can observe _closed
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def skip(self, n: int) -> None:
         if self._thread is not None:
@@ -174,6 +204,10 @@ class PrefetchLoader:
         return self
 
     def __next__(self):
+        if self._closed:
+            # close() drained the queue and stopped the pump; there is
+            # nothing left to deliver and nothing to block on
+            raise StopIteration
         if self._terminated:
             # the pump thread is dead and its one sentinel was already
             # consumed — keep raising instead of blocking forever on an

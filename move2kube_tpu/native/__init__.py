@@ -42,6 +42,11 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
         return np.ascontiguousarray(src[idx])
     out = np.empty(out_shape, src.dtype)
     idx64 = np.ascontiguousarray(idx, np.int64)
+    # normalize negative indices to numpy's wrapping semantics so the C
+    # path (which rejects out-of-range) behaves identically to the numpy
+    # fallback regardless of whether the extension is built
+    if idx64.size and (idx64 < 0).any():
+        idx64 = np.where(idx64 < 0, idx64 + src.shape[0], idx64)
     _fastgather.gather(
         memoryview(src).cast("B"), memoryview(out).cast("B"),
         memoryview(idx64).cast("B"), row_bytes, src.shape[0], _THREADS)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 
 import jax
 import jax.numpy as jnp
@@ -357,20 +358,29 @@ def _flash_diff_bwd(causal, scale, residuals, g):
     # Blockwise Pallas backward (dq/dk/dv with logsumexp recompute): the
     # [b, h, s, s] score matrix never materializes, matching the forward
     # kernel's memory profile in training. The jnp reference vjp remains
-    # as a trace-time fallback so a Mosaic regression degrades throughput,
-    # not correctness.
+    # as a TRACE-TIME fallback: a Mosaic/XLA failure that only surfaces
+    # when the enclosing jit compiles happens outside this handler and
+    # cannot be caught here. For that case operators can export
+    # M2KT_FORCE_REFERENCE_VJP=1 to skip the Pallas backward outright
+    # (correctness over throughput until the backend regression is fixed).
     q, k, v, o, lse = residuals
+
+    def reference_vjp():
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal,
+                                                    scale),
+            q, k, v)
+        return vjp(g)
+
+    if os.environ.get("M2KT_FORCE_REFERENCE_VJP", "") not in ("", "0"):
+        return reference_vjp()  # deliberate operator opt-out: no warning
     try:
         return _flash_attention_bwd_tpu(q, k, v, o, lse, g, causal, scale)
     except Exception as e:  # noqa: BLE001 - fall back rather than fail
         logging.getLogger(__name__).warning(
             "pallas flash attention backward failed (%s: %s); falling back "
             "to jnp reference vjp", type(e).__name__, e)
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal,
-                                                    scale),
-            q, k, v)
-        return vjp(g)
+        return reference_vjp()
 
 
 _flash_attention_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)

@@ -1,0 +1,3 @@
+"""Marks ``scripts`` as a regular package so ``-p scripts.cov`` resolves
+from any CWD / pytest entrypoint (namespace-package resolution only works
+when the repo root happens to be on sys.path)."""

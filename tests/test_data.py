@@ -185,3 +185,43 @@ def test_prefetch_loader_exhaustion_keeps_stopping():
     for _ in range(3):
         with pytest.raises(StopIteration):
             next(pre)
+
+
+def test_prefetch_loader_close_stops_pump_thread():
+    """ADVICE r4: abandoning iteration early must not leak a pump thread
+    blocked on queue.put for the process lifetime — close() (or the
+    context manager) unblocks and joins it."""
+    import itertools
+    import threading
+
+    before = threading.active_count()
+    with m2kt_data.PrefetchLoader(itertools.repeat({"x": 1}), depth=1) as pre:
+        assert next(pre)["x"] == 1  # starts the pump; queue fills
+    # pump thread observed _closed and exited (join happened in close)
+    deadline = 50
+    while threading.active_count() > before and deadline:
+        deadline -= 1
+        import time
+        time.sleep(0.1)
+    assert threading.active_count() <= before
+
+
+def test_native_gather_negative_indices_match_numpy():
+    """ADVICE r4: negative indices wrap identically on the C path and the
+    numpy fallback (install-independent behavior)."""
+    from move2kube_tpu import native
+
+    gen = np.random.default_rng(1)
+    src = gen.standard_normal((4096, 96)).astype(np.float32)
+    idx = gen.integers(-len(src), len(src), 257)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_prefetch_loader_next_after_close_stops():
+    import itertools
+
+    pre = m2kt_data.PrefetchLoader(itertools.repeat({"x": 1}), depth=1)
+    assert next(pre)["x"] == 1
+    pre.close()
+    with pytest.raises(StopIteration):
+        next(pre)
