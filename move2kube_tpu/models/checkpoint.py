@@ -52,7 +52,7 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
 
-    def restore_or_init(self, state):
+    def restore_or_init(self, state, ported_restore=None):
         """Return (state, start_step): the latest checkpoint restored into
         ``state``'s sharding layout, or ``state`` itself at step 0.
 
@@ -60,7 +60,14 @@ class CheckpointManager:
         saves from the training loop), and a params-only dict written by
         ``port_weights.py`` (torch weights converted to our layout) — the
         latter grafts params into the fresh state, keeping new optimizer
-        state, so a GPU fine-tune resumes from its pretrained weights."""
+        state, so a GPU fine-tune resumes from its pretrained weights.
+
+        ``ported_restore``: optional ``(abstract_params, graft_fn)`` for
+        states whose param layout differs from the ported flat layout —
+        the pipeline trainers' staged trees (models/{gpt2,llama}_pipe
+        ``flat_param_shapes`` + ``graft_ported_params``). The checkpoint
+        is restored into ``abstract_params`` and ``graft_fn(state,
+        flat_params)`` regroups it into the live state."""
         import orbax.checkpoint as ocp
 
         step = self._mngr.latest_step()
@@ -72,15 +79,25 @@ class CheckpointManager:
             log.info("resumed from checkpoint step %d", step)
             return restored, step
         except (ValueError, KeyError, TypeError):
-            partial = {"params": abstract.params}
-            if getattr(state, "batch_stats", None) is not None:
-                partial["batch_stats"] = abstract.batch_stats
-            restored = self._mngr.restore(step, args=ocp.args.StandardRestore(partial))
-            state = state.replace(params=restored["params"])
-            if restored.get("batch_stats") is not None:
-                state = state.replace(batch_stats=restored["batch_stats"])
-            log.info("loaded ported weights from checkpoint step %d", step)
-            return state, 0
+            pass
+        if ported_restore is not None:
+            flat_abstract, graft_fn = ported_restore
+            try:
+                restored = self._mngr.restore(
+                    step, args=ocp.args.StandardRestore({"params": flat_abstract}))
+                log.info("grafted ported weights from checkpoint step %d", step)
+                return graft_fn(state, restored["params"]), 0
+            except (ValueError, KeyError, TypeError):
+                pass  # not the flat-ported layout either; try partial
+        partial = {"params": abstract.params}
+        if getattr(state, "batch_stats", None) is not None:
+            partial["batch_stats"] = abstract.batch_stats
+        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(partial))
+        state = state.replace(params=restored["params"])
+        if restored.get("batch_stats") is not None:
+            state = state.replace(batch_stats=restored["batch_stats"])
+        log.info("loaded ported weights from checkpoint step %d", step)
+        return state, 0
 
     def maybe_save(self, step: int, state, force: bool = False) -> bool:
         """Save when ``step`` hits the cadence (async; returns immediately)."""

@@ -45,6 +45,10 @@ class GPT2Config:
     # for detected sequence-parallel fine-tunes (dense folds to flash:
     # this model has no separate einsum path)
     attn_impl: str = "flash"
+    # False inside the compiled GPipe stages (models/gpt2_pipe.py):
+    # sharding constraints are invalid under shard_map's manual axes
+    # (same flag as LlamaConfig.shard_activations)
+    shard_activations: bool = True
 
 
 def gpt2_small() -> GPT2Config:
@@ -70,7 +74,8 @@ class GPT2Block(nn.Module):
                          name="ln_1")(x)
         # fused qkv, HF Conv1D layout [in, 3*d] == flax Dense kernel
         qkv = nn.Dense(3 * d, dtype=cfg.dtype, name="c_attn")(h.astype(cfg.dtype))
-        qkv = _maybe_shard(qkv, P(("data", "fsdp"), None, "tensor"))
+        if cfg.shard_activations:
+            qkv = _maybe_shard(qkv, P(("data", "fsdp"), None, "tensor"))
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, s, cfg.num_heads, head_dim)
         k = k.reshape(b, s, cfg.num_heads, head_dim)
@@ -90,7 +95,8 @@ class GPT2Block(nn.Module):
         h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
                          name="ln_2")(x)
         h = nn.Dense(4 * d, dtype=cfg.dtype, name="c_fc")(h.astype(cfg.dtype))
-        h = _maybe_shard(h, P(("data", "fsdp"), None, "tensor"))
+        if cfg.shard_activations:
+            h = _maybe_shard(h, P(("data", "fsdp"), None, "tensor"))
         h = nn.gelu(h, approximate=True)  # HF gelu_new
         h = nn.Dense(d, dtype=cfg.dtype, name="mlp_out")(h)
         return x + h

@@ -96,6 +96,30 @@ def create_pipeline_lm_state(rng, cfg: LlamaConfig, num_stages: int,
     return TrainState.create(apply_fn=None, params=params, tx=tx)
 
 
+def graft_ported_params(state: TrainState, flat_params: dict,
+                        cfg: LlamaConfig, num_stages: int,
+                        mesh: Mesh) -> TrainState:
+    """Regroup a ported flat Llama param tree (port_weights.py layout:
+    ``embed``/``layer_i``/``final_norm``/``lm_head``) into the staged
+    pipeline layout and graft it into ``state`` with the pipe shardings
+    (same adapter as models/gpt2_pipe.py)."""
+    staged = {
+        "embed": flat_params["embed"],
+        "stages": _regroup_stages(flat_params, cfg.num_layers, num_stages),
+        "final_norm": flat_params["final_norm"],
+        "lm_head": flat_params["lm_head"],
+    }
+    staged = jax.device_put(staged, pipeline_param_shardings(staged, mesh))
+    return state.replace(params=staged)
+
+
+def flat_param_shapes(cfg: LlamaConfig):
+    """Abstract flat Llama param tree (the ported-checkpoint layout)."""
+    return jax.eval_shape(
+        lambda r: Llama(cfg).init(r, jnp.zeros((1, 8), jnp.int32))["params"],
+        jax.random.PRNGKey(0))
+
+
 def apply_pipeline_lm(cfg: LlamaConfig, num_stages: int, mesh: Mesh, params,
                       input_ids, *, num_microbatches: int,
                       remat: bool = True):

@@ -26,7 +26,12 @@ class TrainState(train_state.TrainState):
 
 def _mesh_context(mesh: Mesh):
     """Context that makes bare PartitionSpecs resolvable inside traced code
-    (models annotate activations with P(...) without threading the mesh)."""
+    (models annotate activations with P(...) without threading the mesh).
+    AbstractMesh gets its own context manager: the shape-verification
+    path (tests/test_memory_plan.py) traces train steps on device-less
+    meshes and ``use_mesh``/``set_mesh`` only accept concrete meshes."""
+    if isinstance(mesh, jax.sharding.AbstractMesh):
+        return jax.sharding.use_abstract_mesh(mesh)
     use_mesh = getattr(jax.sharding, "use_mesh", None) or getattr(jax, "set_mesh", None)
     return use_mesh(mesh) if use_mesh is not None else mesh
 
@@ -56,8 +61,19 @@ def lm_loss(logits, input_ids) -> jax.Array:
     return cross_entropy_loss(logits[:, :-1], input_ids[:, 1:])
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(("data", "fsdp")))
+def batch_sharding(mesh: Mesh):
+    return _sharding(mesh, P(("data", "fsdp")))
+
+
+def _sharding(mesh, spec: P):
+    """NamedSharding for a concrete Mesh; the bare PartitionSpec for an
+    AbstractMesh (with_sharding_constraint resolves it against the
+    ambient mesh, letting train steps trace under ``jax.eval_shape`` on
+    device-less meshes — the BASELINE config-5 shape-verification path,
+    tests/test_memory_plan.py)."""
+    if isinstance(mesh, jax.sharding.AbstractMesh):
+        return spec
+    return NamedSharding(mesh, spec)
 
 
 def create_sharded_state(
@@ -137,7 +153,7 @@ def make_classifier_train_step(mesh: Mesh, has_batch_stats: bool = False,
 
     def one_step(state: TrainState, batch: dict):
         x = jax.lax.with_sharding_constraint(
-            batch["input"], NamedSharding(mesh, P(("data", "fsdp"))))
+            batch["input"], _sharding(mesh, P(("data", "fsdp"))))
         y = batch["label"]
 
         def loss_fn(params):
@@ -175,7 +191,7 @@ def make_bert_train_step(mesh: Mesh, scan_steps: int | None = None):
     """
 
     def one_step(state: TrainState, batch: dict):
-        sh = NamedSharding(mesh, P(("data", "fsdp")))
+        sh = _sharding(mesh, P(("data", "fsdp")))
         ids = jax.lax.with_sharding_constraint(batch["input_ids"], sh)
         mask = batch.get("attention_mask")
 
@@ -213,7 +229,7 @@ def make_diffusion_train_step(mesh: Mesh, scan_steps: int | None = None,
     alpha_bars = ddpm_alpha_bars(num_diffusion_steps)
 
     def one_step(state: TrainState, batch: dict):
-        sh = NamedSharding(mesh, P(("data", "fsdp")))
+        sh = _sharding(mesh, P(("data", "fsdp")))
         x0 = jax.lax.with_sharding_constraint(batch["image"], sh)
         noise = jax.lax.with_sharding_constraint(batch["noise"], sh)
         t = batch["t"]
@@ -252,7 +268,7 @@ def make_lm_train_step(mesh: Mesh, remat: bool = True,
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: dict):
         ids = jax.lax.with_sharding_constraint(
-            batch["input_ids"], NamedSharding(mesh, P(("data", "fsdp"))))
+            batch["input_ids"], _sharding(mesh, P(("data", "fsdp"))))
 
         def loss_fn(params):
             def fwd(p, x):

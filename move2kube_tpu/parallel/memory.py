@@ -1,0 +1,135 @@
+"""Per-chip HBM budgeting for sharded training (shape-level, no devices).
+
+Answers "does this training run FIT?" for an emitted translation before
+any hardware exists: parameter/gradient/optimizer bytes are computed from
+``jax.eval_shape`` of the model init and the same logical-axis sharding
+rules ``create_sharded_state`` applies (``infer_param_axes`` +
+``ShardingRules``), activations from the remat policy of the LM train
+step (per-layer checkpoint boundaries + the largest transient working
+set, which for decoder LMs is the float32 logits block).
+
+Used by the BASELINE config-5 gate (DeepSpeed Llama-3-8B ZeRO-3 ->
+v5p-64): tests/test_memory_plan.py eval-shapes the full train step on an
+abstract 64-chip mesh and asserts the plan fits v5p HBM.
+
+TPU HBM per chip (public specs): v5e 16 GB, v5p 95 GB, v4 32 GB,
+v6e 32 GB.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from move2kube_tpu.parallel.sharding import ShardingRules, infer_param_axes
+
+HBM_BYTES = {
+    "tpu-v5-lite-podslice": 16e9,
+    "tpu-v5p-slice": 95e9,
+    "tpu-v4-podslice": 32e9,
+    "tpu-v6e-slice": 32e9,
+}
+
+
+@dataclass
+class MemoryPlan:
+    """Byte budget per chip; ``total`` is the sum the fit check gates on."""
+
+    params: int = 0
+    grads: int = 0
+    opt_state: int = 0
+    activations: int = 0
+    # largest single leaves, for the "what dominates" question
+    breakdown: list = field(default_factory=list)  # (path, bytes/chip)
+
+    @property
+    def total(self) -> int:
+        return self.params + self.grads + self.opt_state + self.activations
+
+    def fits(self, accelerator: str, headroom: float = 0.9) -> bool:
+        """True when total fits ``headroom`` of the chip's HBM (the
+        remaining fraction covers XLA scratch + fragmentation)."""
+        return self.total <= HBM_BYTES[accelerator] * headroom
+
+
+def _sharded_bytes(shape_dtype, spec, extents: dict[str, int]) -> int:
+    """Bytes per chip for one leaf under a PartitionSpec, mirroring
+    create_sharded_state._sharding_for: a dim whose size isn't divisible
+    by its mesh extent is replicated rather than unevenly sharded."""
+    shape = list(shape_dtype.shape)
+    for dim, entry in enumerate(spec):
+        names = (entry,) if isinstance(entry, str) else (entry or ())
+        extent = 1
+        for nm in names:
+            extent *= extents.get(nm, 1)
+        if extent > 1 and shape[dim] % extent == 0:
+            shape[dim] //= extent
+    return int(np.prod(shape, dtype=np.int64)) * shape_dtype.dtype.itemsize
+
+
+def train_memory_plan(
+    model,
+    sample_input: dict,
+    mesh_extents: dict[str, int],
+    *,
+    rules: ShardingRules | None = None,
+    optimizer_slots: int = 2,  # adam/adamw: m + v
+    seq_len: int | None = None,
+    batch_per_chip: int = 1,
+    d_model: int | None = None,
+    num_layers: int | None = None,
+    vocab_size: int | None = None,
+    activation_dtype_bytes: int = 2,  # bf16 activations
+    top_n: int = 5,
+) -> MemoryPlan:
+    """Shape-level per-chip memory plan for a remat LM train step.
+
+    Parameter-derived terms come from ``jax.eval_shape`` of
+    ``model.init`` + the sharding heuristic (exact). The activation term
+    is the analytic remat model: per-layer checkpoint boundaries
+    (``num_layers * batch * seq * d_model``) plus the dominant transient
+    (float32 logits ``batch * seq * vocab`` for LMs with ``vocab_size``
+    set) — the same policy make_lm_train_step compiles (jax.checkpoint
+    around each block, loss in float32).
+    """
+    rules = rules or ShardingRules.default()
+
+    def init_fn(rng):
+        variables = model.init(rng, **sample_input)
+        return {k: v for k, v in variables.items()
+                if k in ("params", "batch_stats")}
+
+    shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    axes = infer_param_axes(shapes["params"])
+
+    plan = MemoryPlan()
+    leaves: list[tuple[str, int]] = []
+    flat = jax.tree_util.tree_flatten_with_path(shapes["params"])[0]
+    flat_axes = {tuple(p): a for p, a in
+                 jax.tree_util.tree_flatten_with_path(
+                     axes, is_leaf=lambda x: isinstance(x, tuple) or x is None
+                 )[0]}
+    for path, leaf in flat:
+        ax = flat_axes.get(tuple(path))
+        spec = rules.spec(ax) if isinstance(ax, tuple) else ()
+        nbytes = _sharded_bytes(leaf, spec, mesh_extents)
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        leaves.append((name, nbytes))
+        plan.params += nbytes
+    # grads mirror params; adam moments are f32 like the f32 master params
+    plan.grads = plan.params
+    plan.opt_state = optimizer_slots * plan.params
+
+    if seq_len and d_model and num_layers:
+        boundary = (num_layers * batch_per_chip * seq_len * d_model
+                    * activation_dtype_bytes)
+        transient = 0
+        if vocab_size:
+            # f32 logits + log_softmax cotangent (2x) dominate LM steps
+            transient = 2 * 4 * batch_per_chip * seq_len * vocab_size
+        plan.activations = boundary + transient
+
+    plan.breakdown = sorted(leaves, key=lambda t: -t[1])[:top_n]
+    return plan

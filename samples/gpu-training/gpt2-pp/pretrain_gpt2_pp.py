@@ -1,0 +1,31 @@
+"""Megatron-style GPT-2 pretraining with pipeline parallelism (GPU
+source; translation input). Layers are spread across pipeline ranks; a
+runtime scheduler pushes microbatches between GPUs over NCCL p2p."""
+import argparse
+
+import torch
+import torch.distributed as dist
+from transformers import GPT2LMHeadModel
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pipeline-model-parallel-size", type=int, default=2)
+    parser.add_argument("--micro-batch-size", type=int, default=2)
+    parser.add_argument("--global-batch-size", type=int, default=64)
+    args = parser.parse_args()
+
+    dist.init_process_group(backend="nccl")
+    torch.cuda.set_device(dist.get_rank() % torch.cuda.device_count())
+    model = GPT2LMHeadModel.from_pretrained("gpt2-large").cuda()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=5e-5)
+    for step in range(1000):
+        batch = torch.randint(0, 50257, (args.micro_batch_size, 1024)).cuda()
+        loss = model(input_ids=batch, labels=batch).loss
+        loss.backward()
+        optimizer.step()
+        optimizer.zero_grad()
+
+
+if __name__ == "__main__":
+    main()

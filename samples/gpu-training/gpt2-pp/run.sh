@@ -1,0 +1,6 @@
+#!/bin/sh
+# Classic Megatron GPT pipeline run: no ZeRO, layers spread over stages.
+torchrun --nproc_per_node 8 pretrain_gpt2_pp.py \
+  --pipeline-model-parallel-size 2 \
+  --micro-batch-size 2 \
+  --global-batch-size 64

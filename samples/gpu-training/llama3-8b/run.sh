@@ -1,0 +1,2 @@
+#!/bin/sh
+deepspeed --num_gpus 64 train_llama3.py

@@ -1,0 +1,36 @@
+"""DeepSpeed ZeRO-3 Llama-3-8B pretraining (GPU source; translation input).
+
+BASELINE config 5: 64 A100s, ZeRO-3 sharded params, NCCL allreduce.
+"""
+import deepspeed
+import torch
+import torch.distributed as dist
+from transformers import LlamaForCausalLM, LlamaConfig
+
+
+def main():
+    dist.init_process_group(backend="nccl")
+    torch.cuda.set_device(dist.get_rank() % torch.cuda.device_count())
+    # Llama-3-8B dims
+    config = LlamaConfig(
+        vocab_size=128256,
+        hidden_size=4096,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        intermediate_size=14336,
+        max_position_embeddings=8192,
+        rope_theta=500000.0,
+    )
+    model = LlamaForCausalLM(config).cuda()
+    engine, optimizer, _, _ = deepspeed.initialize(
+        model=model, config="ds_config.json")
+    for step in range(1000):
+        batch = torch.randint(0, config.vocab_size, (1, 8192)).cuda()
+        loss = engine(input_ids=batch, labels=batch).loss
+        engine.backward(loss)
+        engine.step()
+
+
+if __name__ == "__main__":
+    main()

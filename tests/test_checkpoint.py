@@ -99,3 +99,42 @@ def test_ported_params_only_checkpoint_grafts_into_fresh_state(tmp_path,
     # fresh optimizer state is preserved (not restored from the ported dict)
     assert jax.tree.structure(restored.opt_state) == jax.tree.structure(state.opt_state)
     mngr2.close()
+
+
+def test_ported_checkpoint_grafts_into_pipeline_state(tmp_path):
+    """A flat ported GPT-2 checkpoint (port_weights.py layout) restores
+    onto the STAGED pipeline state via the ported_restore adapter —
+    without it the staged tree mismatches and restore raises (the
+    r5-review finding on the gpt2 pipeline path)."""
+    from move2kube_tpu.models.gpt2 import GPT2, gpt2_tiny
+    from move2kube_tpu.models.gpt2_pipe import (
+        create_pipeline_gpt2_state, flat_param_shapes, graft_ported_params)
+
+    mesh = make_mesh(MeshConfig(data=4, pipe=2))
+    cfg = gpt2_tiny()
+    ids = jnp.zeros((4, 16), jnp.int32)
+
+    # "ported" flat params: real init, recognizably marked
+    flat = GPT2(cfg).init(jax.random.PRNGKey(1), ids)["params"]
+    flat = jax.tree.map(lambda x: x * 0 + 3.0, flat)
+    mngr = ckpt.CheckpointManager(str(tmp_path / "ported"), every=1)
+    mngr.maybe_save(0, {"params": flat}, force=True)
+    mngr.close()
+
+    state = create_pipeline_gpt2_state(
+        jax.random.PRNGKey(0), cfg, 2, ids, optax.adamw(1e-3), mesh)
+    mngr2 = ckpt.CheckpointManager(str(tmp_path / "ported"), every=1)
+    restored, start = mngr2.restore_or_init(
+        state,
+        ported_restore=(
+            flat_param_shapes(cfg),
+            lambda st, p: graft_ported_params(st, p, cfg, 2, mesh)))
+    mngr2.close()
+    assert start == 0
+    stages = restored.params["stages"]
+    leaf = jax.tree.leaves(stages)[0]
+    np.testing.assert_allclose(np.asarray(leaf, np.float32), 3.0)
+    assert np.allclose(np.asarray(
+        restored.params["wte"]["embedding"], np.float32), 3.0)
+    # staged sharding preserved: stage leaves carry the pipe axis
+    assert "pipe" in str(leaf.sharding.spec)

@@ -651,3 +651,65 @@ def test_translate_bert_finetune(tmp_path):
     )
     assert run.returncode == 0, run.stderr[-2000:]
     assert "[m2kt] done" in run.stdout
+
+
+def test_translate_gpt2_pipeline(tmp_path):
+    """VERDICT r4 #7: Megatron pp=2 on a GPT source -> the TRUE GPT-2
+    architecture with the staged GPipe trainer (models/gpt2_pipe.py),
+    not the Llama-class stand-in."""
+    res = run_cli("translate",
+                  "-s", os.path.join(SAMPLES, "gpu-training", "gpt2-pp"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    cdir = tmp_path / "out" / "containers" / "gpt2-pp"
+    train_src = (cdir / "train_tpu.py").read_text()
+    # 8 "gpus", pp=2, no zero -> data=4 pipe=2 mesh; true GPT-2 staging
+    assert 'M2KT_MESH_PIPE", "2"' in train_src
+    assert "GPT2Config" in train_src
+    assert "create_pipeline_gpt2_state" in train_src
+    assert "make_pipeline_gpt2_train_step" in train_src
+    assert "LlamaConfig" not in train_src
+    assert (cdir / "move2kube_tpu" / "models" / "gpt2_pipe.py").exists()
+    assert (cdir / "move2kube_tpu" / "parallel" / "pipeline.py").exists()
+
+
+def test_emitted_gpt2_pipeline_program_runs(tmp_path):
+    """The generated GPT-2 pipeline trainer must execute (CPU pipe=2
+    mesh, tiny cfg), including the indivisible-layers fallback."""
+    res = run_cli("translate",
+                  "-s", os.path.join(SAMPLES, "gpu-training", "gpt2-pp"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    cdir = tmp_path / "out" / "containers" / "gpt2-pp"
+    env = dict(
+        os.environ,
+        M2KT_STEPS="2", M2KT_BATCH_PER_DEVICE="1", M2KT_SEQ_LEN="32",
+        M2KT_MAX_LEN="32", M2KT_VOCAB="256", M2KT_DMODEL="64",
+        M2KT_LAYERS="2", M2KT_HEADS="4",
+        M2KT_MESH_DATA="4", M2KT_MESH_FSDP="1", M2KT_MESH_PIPE="2",
+        M2KT_MESH_TENSOR="1", M2KT_MESH_SEQ="1", M2KT_MESH_EXPERT="1",
+        M2KT_MICROBATCHES="4",
+        JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    run = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import runpy; runpy.run_path('train_tpu.py', run_name='__main__')"],
+        cwd=str(cdir), env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+    assert "[m2kt] done" in run.stdout
+
+    # layer count that doesn't divide into the stages: the program must
+    # fall back to data-parallel sharding instead of crashing
+    env["M2KT_LAYERS"] = "3"
+    run = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import runpy; runpy.run_path('train_tpu.py', run_name='__main__')"],
+        cwd=str(cdir), env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+    assert "falling back" in run.stdout
+    assert "[m2kt] done" in run.stdout

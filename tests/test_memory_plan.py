@@ -1,0 +1,118 @@
+"""BASELINE config 5 gate: Llama-3-8B ZeRO-3 on v5p-64, shape-verified.
+
+VERDICT r4 #4: the 8B emission existed but was never validated at full
+dimensions. These tests (a) eval-shape the FULL train step at 8B dims on
+an abstract 64-chip mesh — no hardware, no compile, real tracing with
+the production sharding annotations — and (b) gate the analytic per-chip
+memory plan against v5p HBM (95 GB).
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import AbstractMesh
+
+from move2kube_tpu.models.llama import Llama, LlamaConfig
+from move2kube_tpu.parallel.memory import HBM_BYTES, train_memory_plan
+
+SEQ = 8192
+
+
+def llama3_8b() -> LlamaConfig:
+    """Llama-3-8B dims (samples/gpu-training/llama3-8b/train_llama3.py)."""
+    return LlamaConfig(
+        vocab_size=128256, d_model=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, mlp_dim=14336, max_len=SEQ, rope_theta=500000.0,
+        attn_impl="flash")
+
+
+MESH_EXTENTS = {"data": 1, "fsdp": 64, "pipe": 1, "tensor": 1, "seq": 1,
+                "expert": 1}
+
+
+def test_8b_param_count():
+    """Sanity: the translated model really is ~8B params."""
+    cfg = llama3_8b()
+    shapes = jax.eval_shape(
+        lambda r: Llama(cfg).init(r, jnp.zeros((1, 8), jnp.int32)),
+        jax.random.PRNGKey(0))
+    n = sum(int(jnp.prod(jnp.array(l.shape)))
+            for l in jax.tree.leaves(shapes["params"]))
+    assert 7.9e9 < n < 8.2e9, n
+
+
+def test_8b_zero3_memory_plan_fits_v5p():
+    """Per-chip budget on the emitted (1, 64) dp x fsdp mesh: params,
+    grads, AdamW moments (sharded 64-way except the replicated vocab
+    embedding) + remat activations must fit 90% of v5p HBM."""
+    cfg = llama3_8b()
+    plan = train_memory_plan(
+        Llama(cfg), {"input_ids": jnp.zeros((1, SEQ), jnp.int32)},
+        MESH_EXTENTS,
+        seq_len=SEQ, batch_per_chip=1, d_model=cfg.d_model,
+        num_layers=cfg.num_layers, vocab_size=cfg.vocab_size)
+    assert plan.fits("tpu-v5p-slice"), (
+        f"8B ZeRO-3 does not fit v5p: {plan.total/1e9:.1f} GB "
+        f"(params {plan.params/1e9:.1f} + grads {plan.grads/1e9:.1f} + "
+        f"opt {plan.opt_state/1e9:.1f} + act {plan.activations/1e9:.1f})")
+    # the documented memory plan: param-derived state stays under ~15 GB,
+    # dominated by the replicated vocab embedding (vocab-parallel only,
+    # see infer_param_axes embedding comment)
+    assert plan.params + plan.grads + plan.opt_state < 20e9
+    # and it must NOT fit a v5e chip — the v5p choice in the topology
+    # table (gpu_detect.map_gpu_to_tpu zero_stage>=3) is load-bearing
+    assert plan.total > HBM_BYTES["tpu-v5-lite-podslice"] * 0.9
+
+
+def test_8b_train_step_eval_shape_on_abstract_64chip_mesh():
+    """The FULL production train step (remat + AdamW + flash-attention
+    path + sharding constraints) traces at 8B dims over an abstract
+    64-device mesh; output shapes/dtypes and state tree come back
+    intact. eval_shape allocates nothing, so this runs anywhere."""
+    from move2kube_tpu.models import train as m2kt_train
+
+    cfg = llama3_8b()
+    model = Llama(cfg)
+    mesh = AbstractMesh((1, 64, 1, 1, 1, 1),
+                        ("data", "fsdp", "pipe", "tensor", "seq", "expert"))
+    ids = jax.ShapeDtypeStruct((64, SEQ), jnp.int32)  # batch 1 per chip
+
+    def init_and_step(rng, batch_ids):
+        params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+        state = m2kt_train.TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.adamw(3e-4))
+        step = m2kt_train.make_lm_train_step(mesh)
+        new_state, loss = step(state, {"input_ids": batch_ids})
+        return new_state.step, loss
+
+    with jax.sharding.use_abstract_mesh(mesh):
+        step_shape, loss_shape = jax.eval_shape(
+            init_and_step, jax.random.PRNGKey(0), ids)
+    assert loss_shape.shape == ()
+    assert loss_shape.dtype == jnp.float32
+
+
+def test_llama3_8b_sample_translates_to_v5p64(tmp_path):
+    """e2e: the DeepSpeed ZeRO-3 8B sample emits a v5p-64 JobSet mesh
+    (BASELINE config 5: mesh (1,64,1,1,1,1) on tpu-v5p-slice/4x4x4)."""
+    import os
+
+    from tests.test_e2e_translate import SAMPLES, load_all_yamls, run_cli
+
+    res = run_cli("translate", "-s",
+                  os.path.join(SAMPLES, "gpu-training", "llama3-8b"),
+                  "-o", "out", "--qa-skip", cwd=str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    out = tmp_path / "out"
+    train = (out / "containers" / "llama3-8b" / "train_tpu.py").read_text()
+    assert 'os.environ.get("M2KT_MESH_FSDP", "64")' in train  # ZeRO-3 -> fsdp=64
+    assert 'os.environ.get("M2KT_MESH_DATA", "1")' in train
+    objs = load_all_yamls(out / "llama3-8b")
+    jobsets = [o for o in objs if o.get("kind") == "JobSet"]
+    assert jobsets, "no JobSet emitted"
+    tmpl = (jobsets[0]["spec"]["replicatedJobs"][0]["template"]["spec"]
+            ["template"]["spec"])
+    sel = tmpl["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "4x4x4"

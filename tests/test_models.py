@@ -429,3 +429,35 @@ def test_llama_flash_impl_matches_dense():
     flash = llama.Llama(dataclasses.replace(base, attn_impl="flash"))
     out = flash.apply({"params": params}, ids)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-4)
+
+
+def test_conv_kernels_replicated_under_fsdp():
+    """VERDICT r4 #2: conv kernels must NOT shard over fsdp (output-
+    channel shards conflict with the fsdp-sharded batch and provoke GSPMD
+    full rematerialization); dense/norm params keep their sharding."""
+    import optax
+
+    from move2kube_tpu.models import train as m2kt_train
+    from move2kube_tpu.models.unet import UNet, unet_tiny
+    from move2kube_tpu.parallel.sharding import infer_param_axes
+
+    mesh = make_mesh(MeshConfig(data=4, fsdp=2))
+    sample = {"x": jnp.zeros((8, 16, 16, 3)), "t": jnp.zeros((8,), jnp.int32)}
+    state = m2kt_train.create_sharded_state(
+        jax.random.PRNGKey(0), UNet(unet_tiny()), sample,
+        optax.adamw(1e-3), mesh)
+    flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    assert any(l.ndim == 4 for _, l in flat), "unet has no conv kernels?"
+    for path, leaf in flat:
+        assert "fsdp" not in str(leaf.sharding.spec), (path, leaf.sharding)
+    # the heuristic: conv-family trees replicate everything (even their
+    # dense kernels — the per-sample-vector projections' batch-contraction
+    # grads provoke the same GSPMD full-remat); non-conv trees keep the
+    # ZeRO-style dense sharding
+    axes = infer_param_axes(
+        {"conv": {"kernel": jnp.zeros((3, 3, 8, 16))},
+         "shift": {"kernel": jnp.zeros((64, 16))}})
+    assert axes["conv"]["kernel"] == (None, None, None, None)
+    assert axes["shift"]["kernel"] == (None, None)
+    dense_only = infer_param_axes({"mlp": {"kernel": jnp.zeros((64, 128))}})
+    assert dense_only["mlp"]["kernel"] == (None, "embed")
