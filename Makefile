@@ -2,7 +2,7 @@
 # no binary build step — pure-Python package + vendored JAX model zoo).
 
 PY ?= python
-CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test test-fast coverage lint ci dist bench dryrun e2e clean
 
