@@ -15,9 +15,11 @@ test-fast:
 # suite + dependency-free line coverage (scripts/cov.py, PEP 669) gated
 # at the floor (parity: reference build.yml uploads coverage per push);
 # report artifact: coverage-report.txt
-COV_MIN ?= 72
+COV_MIN ?= 78
 coverage:
 	$(PY) scripts/cov.py clean
+	@$(PY) setup.py build_ext --inplace >/dev/null 2>&1 || \
+		echo "WARNING: native extension build failed; coverage exercises the numpy fallback paths"
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -p scripts.cov
 	$(PY) scripts/cov.py report --min $(COV_MIN) --out coverage-report.txt
 

@@ -311,8 +311,12 @@ def bench_resnet(n: int) -> dict:
     # north-star comparison (BASELINE.json: >= 90% of hand-ported MFU):
     # the official-recipe hand-port, same batch/chip/session — the conv
     # analogue of the pallas phase's vs_official_kernel. Best-effort: a
-    # comparator failure must not cost the phase its primary number.
+    # comparator failure must not cost the phase its primary number, and
+    # neither may a comparator HANG — flush the primary result line
+    # before measuring it (the parent keeps the LAST RESULT per phase,
+    # so the enriched line below supersedes this one when it lands).
     if os.environ.get("M2KT_BENCH_RESNET_CMP", "1") not in ("", "0"):
+        _emit(result)
         try:
             official_img_s = _bench_official_resnet(batch)
             result["official_img_s"] = round(official_img_s, 1)
@@ -519,6 +523,7 @@ def bench_pallas(n: int) -> dict:
     # per-dispatch tunnel roundtrip doesn't dominate the measurement
     # (o has q's shape, so it feeds back as the next query block)
     scan_iters = 10
+    official_tflops = None
 
     def timed_tflops(call):
         run = jax.jit(lambda q, k, v: jax.lax.scan(
@@ -562,14 +567,23 @@ def bench_pallas(n: int) -> dict:
           f"{tflops:.1f} TFLOP/s vs_official={vs_official}",
           file=sys.stderr)
     result = {"phase": "pallas", "metric": metric,
-              "value": round(tflops, 2), "unit": unit,
-              "vs_baseline": round(tflops * 1e12 / (V5E_PEAK_BF16_FLOPS
-                                                    * ANCHOR_MFU), 3),
-              "pallas_ok": True, "pallas_bwd_ok": True,
-              "max_abs_err": round(err, 5),
-              "bwd_rel_err": round(bwd_err, 5)}
+              "value": round(tflops, 2), "unit": unit}
     if vs_official is not None:
+        # the like-for-like ratio leads: same shape, same chip, same
+        # session as the public hand-written TPU kernel — immune to the
+        # environment's absolute-throughput variance, which the roofline
+        # vs_baseline below is fully exposed to (BENCH_NOTES.md round 4)
         result["vs_official_kernel"] = vs_official
+        result["official_kernel_tflops"] = round(official_tflops, 2)
+    result.update({
+        "vs_baseline": round(tflops * 1e12 / (V5E_PEAK_BF16_FLOPS
+                                              * ANCHOR_MFU), 3),
+        "vs_baseline_note": "roofline anchor (30% of nominal chip peak); "
+                            "vs_official_kernel is the controlled "
+                            "same-chip comparison",
+        "pallas_ok": True, "pallas_bwd_ok": True,
+        "max_abs_err": round(err, 5),
+        "bwd_rel_err": round(bwd_err, 5)})
     return result
 
 

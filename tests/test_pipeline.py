@@ -142,3 +142,48 @@ def test_staged_llama_matches_dense_forward():
                                        num_microbatches=n_micro)
     state, loss = step(state, {"input_ids": ids})
     assert bool(jnp.isfinite(loss))
+
+
+def test_staged_gpt2_matches_dense_forward_and_trains():
+    """gpt2_pipe: the compiled-GPipe staged GPT-2 reproduces the plain
+    GPT2 forward (f32) and executes a train step with finite loss
+    (VERDICT r4 #7 — true GPT-2 architecture on the pipe axis)."""
+    import dataclasses
+
+    import optax
+
+    from move2kube_tpu.models.gpt2 import GPT2, gpt2_tiny
+    from move2kube_tpu.models.gpt2_pipe import (
+        apply_pipeline_gpt2,
+        create_pipeline_gpt2_state,
+        make_pipeline_gpt2_train_step,
+    )
+
+    cfg = dataclasses.replace(gpt2_tiny(), dtype=jnp.float32)  # 2 layers
+    num_stages, n_micro = 2, 2
+    mesh = make_mesh(MeshConfig(data=4, pipe=num_stages))
+    bsz = 8  # bpd 1 x data 4 x microbatches 2
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 200, (bsz, 16)))
+    state = create_pipeline_gpt2_state(
+        jax.random.PRNGKey(0), cfg, num_stages,
+        jnp.zeros((bsz, 16), jnp.int32), optax.adamw(1e-3), mesh)
+
+    p = state.params
+    assert "pipe" in str(jax.tree.leaves(p["stages"])[0].sharding.spec)
+    # regroup staged params into the flat h_i layout for the reference
+    flat = {"wte": p["wte"], "wpe": p["wpe"], "ln_f": p["ln_f"]}
+    for s in range(num_stages):
+        flat[f"h_{s}"] = jax.tree.map(lambda a, s=s: a[s],
+                                      p["stages"]["block_0"])
+
+    logits_pipe = apply_pipeline_gpt2(cfg, num_stages, mesh, p, ids,
+                                      num_microbatches=n_micro, remat=False)
+    logits_ref = GPT2(cfg).apply({"params": flat}, ids)
+    np.testing.assert_allclose(np.asarray(logits_pipe),
+                               np.asarray(logits_ref), atol=1e-4)
+
+    step = make_pipeline_gpt2_train_step(cfg, num_stages, mesh,
+                                         num_microbatches=n_micro)
+    new_state, loss = step(state, {"input_ids": ids})
+    assert np.isfinite(float(loss))
+    assert int(new_state.step) == 1
