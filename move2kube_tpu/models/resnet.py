@@ -5,8 +5,13 @@ Emission target for detected torchvision/CUDA ResNet training scripts
 containerizer, single v5e chip").
 
 TPU notes: NHWC layout (XLA's native conv layout on TPU), bfloat16 compute
-with float32 params/accumulation, batch norm in float32 for stability. Convs
-lower onto the MXU; XLA fuses the BN+ReLU chains into them.
+with float32 params/accumulation. BatchNorm computes in the MODEL dtype
+(the public Flax imagenet recipe): at bf16 this keeps the BN+ReLU chain
+fused into the convs without f32 round-trips on the activation path —
+ResNet-50 is HBM-bound, so those casts cost real throughput (bench.py's
+hand-ported comparator uses the same recipe; f32-dtype instantiations,
+e.g. ported-weight parity tests, still get f32 BN). Convs lower onto the
+MXU.
 """
 
 from __future__ import annotations
@@ -27,7 +32,7 @@ class BottleneckBlock(nn.Module):
     def __call__(self, x, train: bool = True):
         norm = lambda: nn.BatchNorm(  # noqa: E731
             use_running_average=not train, momentum=0.9, epsilon=1e-5,
-            dtype=jnp.float32,
+            dtype=self.dtype,
         )
         residual = x
         y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(x)
@@ -59,7 +64,7 @@ class ResNet(nn.Module):
         x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype)(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=jnp.float32)(x)
+                         epsilon=1e-5, dtype=self.dtype)(x)
         x = nn.relu(x)
         # explicit symmetric pad (torch maxpool pad=1); SAME would pad
         # asymmetrically and diverge from ported torchvision weights

@@ -72,16 +72,33 @@ def test_cli_translate_gpu_training_samples(tmp_path, monkeypatch):
     assert "JobSet" in kinds
 
 
-def test_cli_translate_curate_flag_and_env_defaults(tmp_path, monkeypatch):
-    """--ignore-env + M2KT_* env override (viper parity) in-process."""
+def test_cli_env_override_and_ignore_env(tmp_path, monkeypatch):
+    """M2KT_* env overrides CLI defaults (viper parity): the project name
+    comes from M2KT_NAME; --ignore-env additionally gates environment
+    access (common.IGNORE_ENVIRONMENT, restored after the test — it is a
+    module global the subprocess-based e2e suite never leaked)."""
+    from move2kube_tpu.utils import common
+
     monkeypatch.chdir(tmp_path)
     monkeypatch.setenv("M2KT_NAME", "envnamed")
     _reset_qa()
     try:
         rc = cli_main.main(["translate",
                             "-s", os.path.join(SAMPLES, "python"),
-                            "-o", "out", "--qa-skip", "--ignore-env"])
+                            "-o", "out", "--qa-skip"])
         assert rc == 0
     finally:
         _reset_qa()
-    assert (tmp_path / "out").is_dir()
+    assert (tmp_path / "out" / "envnamed").is_dir()  # env name took effect
+
+    monkeypatch.setattr(common, "IGNORE_ENVIRONMENT", False)
+    _reset_qa()
+    try:
+        rc = cli_main.main(["translate",
+                            "-s", os.path.join(SAMPLES, "python"),
+                            "-o", "out2", "--qa-skip", "--ignore-env"])
+        assert rc == 0
+        assert common.IGNORE_ENVIRONMENT is True
+    finally:
+        _reset_qa()
+    assert (tmp_path / "out2").is_dir()
