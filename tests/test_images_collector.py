@@ -1,0 +1,78 @@
+"""Images collector (collector/images.py) with an injected docker
+inspect — the same injectable-runner approach the cluster collector
+tests use (the reference ships zero ImagesCollector tests)."""
+
+import os
+
+import yaml
+
+from move2kube_tpu.collector import images as images_mod
+
+
+def _write_sources(src):
+    (src / "docker-compose.yml").write_text(
+        "services:\n"
+        "  web:\n    image: nginx:1.25\n"
+        "  db:\n    image: postgres:15\n"
+    )
+    (src / "deploy.yaml").write_text(
+        "apiVersion: apps/v1\nkind: Deployment\n"
+        "metadata:\n  name: app\n"
+        "spec:\n  template:\n    spec:\n      containers:\n"
+        "        - name: app\n          image: registry.io/team/app:2.1\n"
+    )
+
+
+def test_images_from_sources_dedups_and_sorts(tmp_path):
+    _write_sources(tmp_path)
+    got = images_mod.images_from_sources(str(tmp_path))
+    assert got == ["nginx:1.25", "postgres:15", "registry.io/team/app:2.1"]
+
+
+def test_collect_writes_inspected_metadata(tmp_path, monkeypatch):
+    src = tmp_path / "src"
+    src.mkdir()
+    _write_sources(src)
+    out = tmp_path / "out"
+
+    def fake_inspect(image):
+        if "nginx" not in image:
+            return None  # image not present locally -> skipped
+        return {"Config": {
+            "User": "101",
+            "ExposedPorts": {"80/tcp": {}, "443/tcp": {}, "weird": {}},
+            "Env": ["PATH=/usr/bin:/bin", "LANG=C"],
+            "Volumes": {"/var/cache/nginx": {}},
+            "WorkingDir": "/app",
+        }}
+
+    monkeypatch.setattr(images_mod, "_docker_inspect", fake_inspect)
+    images_mod.ImagesCollector().collect(str(src), str(out))
+    files = sorted(os.listdir(out / "images"))
+    assert files == ["nginx-1-25.yaml"]
+    doc = yaml.safe_load((out / "images" / files[0]).read_text())
+    spec = doc["spec"]
+    assert spec["userID"] == 101
+    assert sorted(spec["portsToExpose"]) == [80, 443]
+    assert "/app" in spec["accessedDirs"]
+    assert "/var/cache/nginx" in spec["accessedDirs"]
+    assert "/usr/bin" in spec["accessedDirs"]
+    assert spec["tags"] == ["nginx:1.25"]
+
+
+def test_docker_inspect_gated_by_ignore_environment(monkeypatch):
+    from move2kube_tpu.utils import common
+
+    monkeypatch.setattr(common, "IGNORE_ENVIRONMENT", True)
+    assert images_mod._docker_inspect("nginx:1.25") is None
+
+
+def test_docker_inspect_absent_docker(monkeypatch):
+    """No docker binary / failing inspect -> None, never an exception."""
+    import subprocess
+
+    def boom(*a, **kw):
+        raise OSError("no docker")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    assert images_mod._docker_inspect("nginx:1.25") is None
