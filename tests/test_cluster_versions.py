@@ -6,7 +6,6 @@ object converted to the cluster's preferred supported version at write
 time). The first same-group entry in the profile's list wins.
 """
 
-import yaml
 
 from move2kube_tpu.apiresource.base import convert_objects
 from move2kube_tpu.metadata.clusters import get_cluster

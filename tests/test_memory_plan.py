@@ -10,7 +10,6 @@ memory plan against v5p HBM (95 GB).
 import jax
 import jax.numpy as jnp
 import optax
-import pytest
 from jax.sharding import AbstractMesh
 
 from move2kube_tpu.models.llama import Llama, LlamaConfig
