@@ -69,7 +69,15 @@ def load_arrays(path: str) -> dict[str, np.ndarray]:
     raise ValueError(f"unsupported data path: {path}")
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
+def batch_sharding(mesh: Mesh):
+    """Batch sharding for loader output. On a single-device mesh this is
+    a SingleDeviceSharding, NOT a NamedSharding over the mesh: mesh-ful
+    committed inputs force the train step to compile through the SPMD
+    pipeline, which single-chip training must never pay for (the ~7x
+    CPU-backend tax measured in docs/ROUND5_NOTES.md; train.py
+    ``_trivial`` is the step-side half of the same rule)."""
+    if mesh.devices.size == 1:
+        return jax.sharding.SingleDeviceSharding(mesh.devices.flat[0])
     return NamedSharding(mesh, P(("data", "fsdp")))
 
 

@@ -37,6 +37,9 @@ def _mesh_context(mesh: Mesh):
 
 
 def _with_mesh(mesh: Mesh, fn: Callable) -> Callable:
+    if _trivial(mesh):
+        return fn  # no ambient mesh: keep the plain single-device compile
+
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         with _mesh_context(mesh):
@@ -62,6 +65,10 @@ def lm_loss(logits, input_ids) -> jax.Array:
 
 
 def batch_sharding(mesh: Mesh):
+    """Input-batch sharding; SingleDeviceSharding on trivial meshes so
+    committed batches never trigger the SPMD pipeline (see _trivial)."""
+    if _trivial(mesh):
+        return jax.sharding.SingleDeviceSharding(mesh.devices.flat[0])
     return _sharding(mesh, P(("data", "fsdp")))
 
 
@@ -74,6 +81,27 @@ def _sharding(mesh, spec: P):
     if isinstance(mesh, jax.sharding.AbstractMesh):
         return spec
     return NamedSharding(mesh, spec)
+
+
+def _trivial(mesh) -> bool:
+    """True for a single-device concrete mesh. Trivial meshes compile
+    the PLAIN jit path — no sharding constraints, no mesh context, no
+    out_shardings: semantically identical (every constraint is a no-op
+    at one device) but compiled WITHOUT the SPMD pipeline. Measured:
+    a mesh-compiled ResNet-50 train step runs ~7x slower than the
+    identical plain-jit program on the CPU backend despite structurally
+    identical HLO (round-5 bisection, docs/ROUND5_NOTES.md) — single
+    chips must never pay a partitioner tax for machinery they don't
+    use."""
+    return (not isinstance(mesh, jax.sharding.AbstractMesh)
+            and mesh.devices.size == 1)
+
+
+def _constrain(x, mesh: Mesh, spec: P):
+    """with_sharding_constraint, skipped on trivial meshes."""
+    if _trivial(mesh):
+        return x
+    return jax.lax.with_sharding_constraint(x, _sharding(mesh, spec))
 
 
 def create_sharded_state(
@@ -97,6 +125,18 @@ def create_sharded_state(
         # are per-forward outputs, not state to carry in TrainState
         return {k: v for k, v in variables.items()
                 if k in ("params", "batch_stats")}
+
+    if _trivial(mesh):
+        # single device: SingleDeviceSharding outputs, no NamedShardings
+        # — the train step compiles WITHOUT the SPMD pipeline (see
+        # _trivial; ~7x on the CPU backend) while still landing on the
+        # MESH'S device (which need not be the default one: per-chip
+        # trainer processes build one-device meshes over their own chip)
+        variables = jax.jit(
+            init_fn,
+            out_shardings=jax.sharding.SingleDeviceSharding(
+                mesh.devices.flat[0]))(rng)
+        return _make_state(model, variables, tx)
 
     with _mesh_context(mesh):
         shapes = jax.eval_shape(init_fn, rng)
@@ -131,6 +171,10 @@ def create_sharded_state(
         )
     with _mesh_context(mesh):
         variables = jax.jit(init_fn, out_shardings=out_shardings)(rng)
+    return _make_state(model, variables, tx)
+
+
+def _make_state(model, variables, tx) -> TrainState:
     return TrainState.create(
         apply_fn=model.apply,
         params=variables["params"],
@@ -152,8 +196,7 @@ def make_classifier_train_step(mesh: Mesh, has_batch_stats: bool = False,
     """
 
     def one_step(state: TrainState, batch: dict):
-        x = jax.lax.with_sharding_constraint(
-            batch["input"], _sharding(mesh, P(("data", "fsdp"))))
+        x = _constrain(batch["input"], mesh, P(("data", "fsdp")))
         y = batch["label"]
 
         def loss_fn(params):
@@ -191,8 +234,7 @@ def make_bert_train_step(mesh: Mesh, scan_steps: int | None = None):
     """
 
     def one_step(state: TrainState, batch: dict):
-        sh = _sharding(mesh, P(("data", "fsdp")))
-        ids = jax.lax.with_sharding_constraint(batch["input_ids"], sh)
+        ids = _constrain(batch["input_ids"], mesh, P(("data", "fsdp")))
         mask = batch.get("attention_mask")
 
         def loss_fn(params):
@@ -229,9 +271,8 @@ def make_diffusion_train_step(mesh: Mesh, scan_steps: int | None = None,
     alpha_bars = ddpm_alpha_bars(num_diffusion_steps)
 
     def one_step(state: TrainState, batch: dict):
-        sh = _sharding(mesh, P(("data", "fsdp")))
-        x0 = jax.lax.with_sharding_constraint(batch["image"], sh)
-        noise = jax.lax.with_sharding_constraint(batch["noise"], sh)
+        x0 = _constrain(batch["image"], mesh, P(("data", "fsdp")))
+        noise = _constrain(batch["noise"], mesh, P(("data", "fsdp")))
         t = batch["t"]
         ab = alpha_bars[t][:, None, None, None]
         x_t = (jnp.sqrt(ab) * x0.astype(jnp.float32)
@@ -267,8 +308,7 @@ def make_lm_train_step(mesh: Mesh, remat: bool = True,
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: dict):
-        ids = jax.lax.with_sharding_constraint(
-            batch["input_ids"], _sharding(mesh, P(("data", "fsdp"))))
+        ids = _constrain(batch["input_ids"], mesh, P(("data", "fsdp")))
 
         def loss_fn(params):
             def fwd(p, x):
