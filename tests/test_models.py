@@ -461,3 +461,40 @@ def test_conv_kernels_replicated_under_fsdp():
     assert axes["shift"]["kernel"] == (None, None)
     dense_only = infer_param_axes({"mlp": {"kernel": jnp.zeros((64, 128))}})
     assert dense_only["mlp"]["kernel"] == (None, "embed")
+
+
+def test_single_device_mesh_compiles_plain_path():
+    """Round-5 SPMD-tax regression guard: on a 1-device mesh the state
+    must carry SingleDeviceSharding leaves (not mesh-ful NamedShardings)
+    and a train step must run — the combination that keeps single-chip
+    training out of the SPMD pipeline (docs/ROUND5_NOTES.md; ~7x on the
+    CPU backend for conv programs)."""
+    import optax
+
+    from move2kube_tpu.models import data as m2kt_data
+
+    mesh = make_mesh(MeshConfig(), devices=jax.devices()[:1])
+    model = bert.BertEncoder(vocab_size=64, num_layers=1, num_heads=2,
+                             d_model=16, mlp_dim=32, max_len=16,
+                             num_classes=2)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    state = train.create_sharded_state(
+        jax.random.PRNGKey(0), model, {"input_ids": ids},
+        optax.adamw(1e-3), mesh)
+    for leaf in jax.tree.leaves(state.params):
+        assert isinstance(leaf.sharding, jax.sharding.SingleDeviceSharding), \
+            leaf.sharding
+    assert isinstance(m2kt_data.batch_sharding(mesh),
+                      jax.sharding.SingleDeviceSharding)
+    step = train.make_bert_train_step(mesh)
+    state2, loss = step(state, {
+        "input_ids": ids, "attention_mask": jnp.ones((2, 8), bool),
+        "label": jnp.zeros((2,), jnp.int32)})
+    assert bool(jnp.isfinite(loss))
+    # multi-device meshes keep the sharded machinery
+    mesh8 = make_mesh(MeshConfig(data=4, fsdp=2))
+    state8 = train.create_sharded_state(
+        jax.random.PRNGKey(0), model, {"input_ids": ids},
+        optax.adamw(1e-3), mesh8)
+    assert any("fsdp" in str(l.sharding.spec)
+               for l in jax.tree.leaves(state8.params))
