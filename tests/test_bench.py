@@ -274,3 +274,23 @@ def test_opportunistic_capture_folds_over_transient_failure(
         opportunistic_path=path)
     assert out["value"] == 55.5
     assert out["source"] == "opportunistic_capture"
+
+
+def test_harvest_keeps_last_result_per_phase():
+    """The resnet/pallas phases flush a provisional RESULT before their
+    best-effort comparator runs; the parent must keep the LAST line per
+    phase so the enriched result (vs_official_*) supersedes it — and the
+    provisional one survives if a comparator hang kills the child."""
+    results, fails = {}, {}
+    bench._harvest(
+        'RESULT {"phase": "resnet", "value": 100}\n'
+        'RESULT {"phase": "resnet", "value": 100,'
+        ' "vs_official_resnet": 0.95}\n',
+        results, fails)
+    assert results["resnet"]["vs_official_resnet"] == 0.95
+    # provisional-only (comparator never finished): the phase still counts
+    results2, fails2 = {}, {}
+    bench._harvest('RESULT {"phase": "resnet", "value": 100}\n',
+                   results2, fails2)
+    assert results2["resnet"]["value"] == 100
+    assert "vs_official_resnet" not in results2["resnet"]
