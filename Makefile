@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -60,6 +60,11 @@ dryrun:
 
 e2e:
 	$(CPU_ENV) $(PY) -m pytest tests/test_e2e_translate.py tests/test_gpu2tpu_e2e.py -q
+
+# hot-path perf units in isolation (all CPU-mode): buffer-donation
+# aliasing, device-prefetch overlap, flash block-autotune caching
+perf-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_donation.py tests/test_autotune.py tests/test_data.py -q -m "not slow"
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

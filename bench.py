@@ -636,13 +636,36 @@ def bench_translate(n: int) -> dict:
             "services": n_services, "wall_s": round(dt, 2)}
 
 
+def _setup_compile_cache() -> None:
+    """Persistent XLA compile cache for this child: a re-spawned child
+    (retry, OOM batch-halving) deserializes the previous child's
+    executables instead of recompiling — the compile time that used to
+    eat the wall-clock budget. Import stays inside the child: the parent
+    never touches jax."""
+    try:
+        from move2kube_tpu.models.compile_cache import setup_compilation_cache
+
+        d = setup_compilation_cache()
+        if d:
+            print(f"[bench] compile cache: {d}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - caching is best-effort
+        print(f"[bench] compile cache setup failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
 def run_child(phases: list[str]) -> int:
     """Measure the requested phases, emitting one RESULT line per success.
 
+    TPU phases run first (in PHASES order), pure-CPU phases after: if the
+    child dies mid-run or the parent's budget expires, the scarce TPU
+    numbers are already on stdout — `translate` can run in any child.
     The TPU backend is initialized lazily, only when a TPU phase is
     requested — a CPU-only child must not touch the (possibly hung)
     tunnel. Exit code is advisory (parent trusts RESULT lines, not rc):
     0 iff all requested phases succeeded."""
+    phases = sorted(phases, key=lambda p: (
+        p not in TPU_PHASES, PHASES.index(p) if p in PHASES else len(PHASES)))
+    _setup_compile_cache()
     n = None
     if any(p in TPU_PHASES for p in phases):
         try:

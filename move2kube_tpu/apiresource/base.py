@@ -106,6 +106,11 @@ def _hpa_metric_to_v2beta1(m: dict) -> dict:
     src = dict(m[key])
     target = src.pop("target", None)
     metric = src.pop("metric", None)
+    # Object metrics: v2 names the scaled-object reference
+    # ``describedObject``; v2beta1 calls that same field ``target`` (the
+    # name v2 reuses for the metric target popped above)
+    if "describedObject" in src:
+        src["target"] = src.pop("describedObject")
     if isinstance(metric, dict):
         src["metricName"] = metric.get("name")
         if metric.get("selector") is not None:
@@ -129,8 +134,15 @@ def _hpa_metric_from_v2beta1(m: dict) -> dict:
     if not key or not isinstance(m.get(key), dict):
         return m
     src = dict(m[key])
-    if "target" in src:
-        return m  # already modern-shaped
+    if "metric" in src:
+        # already modern-shaped (Pods/Object/External carry a nested
+        # ``metric``). NOTE: ``"target" in src`` is NOT a modern marker —
+        # a v2beta1 Object metric uses ``target`` for the scaled-object
+        # reference, which v2 renames ``describedObject``
+        return m
+    if m.get("type") == "Object" and isinstance(src.get("target"), dict) \
+            and "name" in src["target"] and "type" not in src["target"]:
+        src["describedObject"] = src.pop("target")
     target: dict = {}
     if "targetAverageUtilization" in src:
         target = {"type": "Utilization",

@@ -62,6 +62,13 @@ def _vendor_package(container: Container) -> None:
                 continue
             with open(os.path.join(sub_dir, fname), encoding="utf-8") as f:
                 container.add_file(f"move2kube_tpu/{sub}/{fname}", f.read())
+    # models/data.py and parallel/sharding.py log through utils.log; ship
+    # just that module under a stub __init__ — the full utils package
+    # would drag yaml and the QA engine into the image
+    container.add_file("move2kube_tpu/utils/__init__.py", "")
+    with open(os.path.join(_PKG_ROOT, "utils", "log.py"),
+              encoding="utf-8") as f:
+        container.add_file("move2kube_tpu/utils/log.py", f.read())
 
 
 TPU_ACCELERATOR_OPTIONS = [
@@ -256,6 +263,9 @@ def emit_container(service: PlanService, plan=None) -> Container:
             "num_hosts": acc.num_hosts,
             "mesh": mesh,
             "moe_experts": moe_experts,
+            # in-image default; pods that mount a durable volume point
+            # M2KT_COMPILE_CACHE_DIR at it to survive restarts
+            "compile_cache_dir": "/app/.jax-cache",
             "steps": 100,
             "lr": (3e-4 if family in ("llama", "gpt", "gpt2")
                    else 1e-4 if family == "unet" else 1e-3),

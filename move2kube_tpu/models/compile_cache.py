@@ -1,0 +1,55 @@
+"""Persistent XLA compilation cache setup.
+
+Shared by every jax entrypoint this repo owns — bench.py children, the
+multichip dryrun (__graft_entry__) and the emitted ``train_tpu.py``
+programs (this module is vendored into images with the rest of
+``models/``). Pointing ``jax_compilation_cache_dir`` at a durable
+directory means a re-spawned bench child, a retried phase, or a
+restarted JobSet pod deserializes yesterday's executable instead of
+recompiling it from scratch — for the bench that is the difference
+between fitting the 440s budget and burning it all on warmup.
+
+Knobs:
+
+- ``M2KT_COMPILE_CACHE=0``      disable entirely
+- ``M2KT_COMPILE_CACHE_DIR``    cache directory (wins over the caller's
+  default — emitted images bake in ``/app/.jax-cache`` but operators can
+  redirect to a mounted volume without editing the program)
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join("~", ".cache", "m2kt-jax-cache")
+
+
+def setup_compilation_cache(default_dir: str | None = None) -> str | None:
+    """Enable jax's persistent compilation cache; returns the directory
+    in use, or None when disabled or unsupported.
+
+    ``default_dir`` is the *caller's* default; the operator env var
+    ``M2KT_COMPILE_CACHE_DIR`` takes precedence, and the user cache dir
+    is the last resort. Safe to call more than once."""
+    if os.environ.get("M2KT_COMPILE_CACHE", "1") == "0":
+        return None
+    path = (os.environ.get("M2KT_COMPILE_CACHE_DIR") or default_dir
+            or _DEFAULT_DIR)
+    path = os.path.abspath(os.path.expanduser(path))
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return None  # read-only filesystem etc: run uncached, don't crash
+
+    import jax  # deferred: the bench parent imports nothing jax-ish
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        # persist every executable, however small/fast: bench children
+        # re-spawn per retry and per OOM batch-halving, and the emitted
+        # trainers recompile identical programs on every pod restart
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001 - a jax without the knobs: uncached
+        return None
+    return path

@@ -28,9 +28,12 @@ import threading
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from move2kube_tpu.native import gather_rows
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("models.data")
 
 
 def _process_slice(n: int) -> tuple[int, int]:
@@ -70,15 +73,16 @@ def load_arrays(path: str) -> dict[str, np.ndarray]:
 
 
 def batch_sharding(mesh: Mesh):
-    """Batch sharding for loader output. On a single-device mesh this is
-    a SingleDeviceSharding, NOT a NamedSharding over the mesh: mesh-ful
-    committed inputs force the train step to compile through the SPMD
-    pipeline, which single-chip training must never pay for (the ~7x
-    CPU-backend tax measured in docs/ROUND5_NOTES.md; train.py
-    ``_trivial`` is the step-side half of the same rule)."""
-    if mesh.devices.size == 1:
-        return jax.sharding.SingleDeviceSharding(mesh.devices.flat[0])
-    return NamedSharding(mesh, P(("data", "fsdp")))
+    """Batch sharding for loader output. Delegates to
+    ``train.batch_sharding`` — the single source of truth for the
+    trivial-mesh rule (SingleDeviceSharding on one-device meshes so
+    committed inputs never force the SPMD compile; the ~7x CPU-backend
+    tax measured in docs/ROUND5_NOTES.md) and for the AbstractMesh guard
+    (``mesh.devices`` raises on device-less meshes; the shape-
+    verification path gets the bare PartitionSpec instead)."""
+    from move2kube_tpu.models.train import batch_sharding as _train_bs
+
+    return _train_bs(mesh)
 
 
 class HostShardedLoader:
@@ -89,9 +93,13 @@ class HostShardedLoader:
     epoch is a true permutation)."""
 
     def __init__(self, arrays: dict[str, np.ndarray], global_batch: int,
-                 mesh: Mesh, seed: int = 0):
+                 mesh: Mesh, seed: int = 0, to_device: bool = True):
         if not arrays:
             raise ValueError("no arrays to load")
+        # to_device=False yields host (numpy) batches and leaves the
+        # device transfer to a downstream PrefetchLoader, so H2D happens
+        # on the pump thread while the previous step computes
+        self.to_device = to_device
         n = min(len(v) for v in arrays.values())
         self.arrays = {k: v[:n] for k, v in arrays.items()}
         self.global_batch = global_batch
@@ -137,8 +145,10 @@ class HostShardedLoader:
             # parallel C row-gather when built (move2kube_tpu/native);
             # numpy fancy-index fallback otherwise
             local = gather_rows(v, take)
-            out[k] = jax.make_array_from_process_local_data(
-                self._sharding, local)
+            if self.to_device:
+                local = jax.make_array_from_process_local_data(
+                    self._sharding, local)
+            out[k] = local
         return out
 
     def skip(self, n: int) -> None:
@@ -150,26 +160,46 @@ class HostShardedLoader:
 
 
 class PrefetchLoader:
-    """Double-buffered host prefetch: a background thread assembles the
-    next batch (shuffle gather + host->device transfer kickoff) while the
-    device runs the current step, hiding host time behind device time.
+    """Double-buffered *device* prefetch: a background thread assembles
+    the next batch (shuffle gather) and — when constructed with the batch
+    ``sharding`` — starts its host->device transfer, all while the device
+    runs the current step. JAX dispatches transfers asynchronously, so by
+    the time the consumer calls ``next()`` the batch is typically already
+    resident on device: steady-state step time is ~max(host, compute)
+    instead of their sum.
 
     ``skip`` must be called before iteration starts (resume fast-forward
     happens before the training loop) — once the thread is running the
     already-buffered batches would be from the pre-skip stream."""
 
-    def __init__(self, inner, depth: int = 2):
+    def __init__(self, inner, depth: int = 2, sharding=None):
         self._inner = inner
+        self._sharding = sharding
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._thread: threading.Thread | None = None
         self._dead: BaseException | None = None
         self._terminated = False  # the one None sentinel was consumed
         self._closed = False
 
+    def _transfer(self, item):
+        """Start the H2D transfer for every host-resident leaf (device
+        arrays pass through untouched — inner loaders that already
+        transferred, or synthetic jnp batches)."""
+        if self._sharding is None:
+            return item
+
+        def leaf(x):
+            if isinstance(x, np.ndarray):
+                return jax.make_array_from_process_local_data(
+                    self._sharding, x)
+            return x
+
+        return jax.tree.map(leaf, item)
+
     def _pump(self):
         try:
             while not self._closed:
-                item = next(self._inner)
+                item = self._transfer(next(self._inner))
                 # bounded put so an abandoned loader (consumer broke out
                 # mid-epoch) unblocks and exits once close() is called,
                 # instead of pinning depth+1 batches for the process life
@@ -181,7 +211,15 @@ class PrefetchLoader:
                         continue
         except BaseException as e:  # noqa: BLE001 - re-raised in __next__
             self._dead = e
-            self._q.put(None)
+            # bounded put for the sentinel too: if close() races this
+            # exception path, the queue may never drain again — the pump
+            # must observe _closed rather than block forever
+            while not self._closed:
+                try:
+                    self._q.put(None, timeout=0.5)
+                    break
+                except queue.Full:
+                    continue
 
     def close(self) -> None:
         """Stop the pump thread and drop buffered batches. Call when
@@ -195,6 +233,11 @@ class PrefetchLoader:
                 except queue.Empty:
                     break
             self._thread.join(timeout=5.0)
+            if self._thread.is_alive():
+                log.warning(
+                    "PrefetchLoader pump thread still alive 5s after "
+                    "close(); leaking a daemon thread (inner loader "
+                    "blocked in next()?)")
 
     def __enter__(self):
         return self
@@ -236,13 +279,17 @@ def make_loader(path: str, global_batch: int, mesh: Mesh,
                 synthetic_fn=None, seed: int = 0, prefetch: bool = True):
     """Return a batch iterator: real data when ``path`` exists, else the
     synthetic generator (the emitted programs' out-of-the-box mode).
-    Real-data loaders are wrapped in a double-buffered prefetch unless
-    ``prefetch=False`` (or M2KT_PREFETCH=0)."""
+    Real-data loaders are wrapped in a double-buffered *device* prefetch
+    unless ``prefetch=False`` (or M2KT_PREFETCH=0): the inner loader
+    stays on the host and the pump thread owns the sharded H2D transfer,
+    overlapping it with the running step."""
     if path and os.path.exists(path):
+        use_prefetch = (prefetch
+                        and os.environ.get("M2KT_PREFETCH", "1") != "0")
         loader = HostShardedLoader(load_arrays(path), global_batch, mesh,
-                                   seed)
-        if prefetch and os.environ.get("M2KT_PREFETCH", "1") != "0":
-            return PrefetchLoader(loader)
+                                   seed, to_device=not use_prefetch)
+        if use_prefetch:
+            return PrefetchLoader(loader, sharding=batch_sharding(mesh))
         return loader
     if synthetic_fn is None:
         raise ValueError(f"data path {path!r} not found and no synthetic fn")

@@ -45,7 +45,52 @@ def _with_mesh(mesh: Mesh, fn: Callable) -> Callable:
         with _mesh_context(mesh):
             return fn(*args, **kwargs)
 
+    # expose the underlying jit function + its mesh so AOT consumers
+    # (assert_state_donated) can .lower() under the right mesh context;
+    # the trivial path above returns the jit object itself, which
+    # carries .lower natively
+    wrapper._m2kt_jit = fn
+    wrapper._m2kt_mesh = mesh
     return wrapper
+
+
+def compiled_alias_count(compiled_text: str) -> int:
+    """Number of input buffers the compiled executable aliases into its
+    outputs (XLA emits one ``may-alias``/``must-alias`` entry per donated
+    buffer in the HloModule ``input_output_alias`` header)."""
+    return (compiled_text.count("may-alias")
+            + compiled_text.count("must-alias"))
+
+
+def assert_state_donated(step_fn, state, batch,
+                         min_aliased: int | None = None) -> int:
+    """Verify that ``step_fn``'s compiled executable really aliases the
+    donated state buffers (donate_argnums alone is a *request* — a jit
+    wrapper, an out-sharding mismatch or an engine change can silently
+    drop it, doubling peak memory). Lowers and compiles for the current
+    backend — works on CPU, no TPU needed — and asserts at least
+    ``min_aliased`` input-output aliases (default: one per param leaf).
+    Returns the alias count."""
+    jit_fn = getattr(step_fn, "_m2kt_jit", step_fn)
+    mesh = getattr(step_fn, "_m2kt_mesh", None)
+    if not hasattr(jit_fn, "lower"):
+        raise TypeError(
+            "step_fn is not jit-compiled (no .lower); donation cannot be "
+            "verified")
+    if mesh is not None:
+        with _mesh_context(mesh):
+            compiled = jit_fn.lower(state, batch).compile()
+    else:
+        compiled = jit_fn.lower(state, batch).compile()
+    n = compiled_alias_count(compiled.as_text())
+    params = getattr(state, "params", state)
+    floor = (min_aliased if min_aliased is not None
+             else len(jax.tree.leaves(params)))
+    if n < floor:
+        raise AssertionError(
+            f"compiled train step aliases only {n} input buffers; expected "
+            f">= {floor} — state donation is not reaching the executable")
+    return n
 
 
 def cross_entropy_loss(logits, labels) -> jax.Array:

@@ -18,8 +18,10 @@ mathematically identical jnp implementation.
 from __future__ import annotations
 
 import functools
+import json
 import logging
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +38,161 @@ _LANES = 128
 # interpreter on CPU (including through the custom_vjp); on TPU it stays
 # False and the kernels compile to Mosaic.
 _INTERPRET = False
+
+
+# --------------------------------------------------------------------------
+# Block-size autotuner
+#
+# The measured-good 256x512 stays the default, but the best (block_q,
+# block_k) shifts with sequence length, head_dim and dtype (VMEM budget
+# per core is ~16 MB; the fori_loop bookkeeping amortizes differently as
+# tiles grow — pallas_guide.md "Tiling Constraints"). On first use per
+# (shape, dtype, causal, platform) the tuner times a small candidate grid
+# with the real kernel, then caches the winner in-process and on disk so
+# steady-state calls (and the next process) pay nothing.
+# --------------------------------------------------------------------------
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+
+# every candidate is a 128-multiple (float32/bf16 lane tiling); _pick_block
+# clamps to divisors of the actual sequence, and duplicates after clamping
+# are swept once
+_BLOCK_CANDIDATES = ((128, 128), (128, 512), (256, 256), (256, 512),
+                     (256, 1024), (512, 512), (512, 1024))
+
+_block_cache: dict[str, tuple[int, int]] = {}
+_disk_cache_path_loaded: str | None = None
+
+
+def _autotune_enabled() -> bool:
+    """M2KT_FLASH_AUTOTUNE=1/0 forces the sweep on/off; default is
+    TPU-only (sweeping the interpreter on CPU would time Python, not
+    silicon)."""
+    flag = os.environ.get("M2KT_FLASH_AUTOTUNE", "")
+    if flag in ("0", "1"):
+        return flag == "1"
+    return jax.default_backend() == "tpu"
+
+
+def _tune_cache_path() -> str:
+    return os.path.expanduser(
+        os.environ.get("M2KT_FLASH_TUNE_CACHE",
+                       "~/.cache/move2kube_tpu/flash_blocks.json"))
+
+
+def _load_disk_cache() -> None:
+    """Merge the on-disk winners into the in-process cache, once per
+    path (a changed M2KT_FLASH_TUNE_CACHE triggers a reload)."""
+    global _disk_cache_path_loaded
+    path = _tune_cache_path()
+    if _disk_cache_path_loaded == path:
+        return
+    _disk_cache_path_loaded = path
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        for k, v in data.items():
+            _block_cache.setdefault(k, (int(v[0]), int(v[1])))
+    except (OSError, ValueError, TypeError, IndexError):
+        pass  # missing or corrupt cache: resweep
+
+
+def _store_disk_cache(key: str, blocks: tuple[int, int]) -> None:
+    path = _tune_cache_path()
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            data = {}
+        data[key] = list(blocks)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=0, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:
+        logging.getLogger(__name__).warning(
+            "flash autotune: cannot persist block cache to %s (%s)", path, e)
+
+
+def _reset_block_cache() -> None:
+    """Testing hook: forget in-process winners and the loaded-disk-path
+    memo so the next get_block_sizes re-reads M2KT_FLASH_TUNE_CACHE."""
+    global _disk_cache_path_loaded
+    _block_cache.clear()
+    _disk_cache_path_loaded = None
+
+
+def _cache_key(q_shape, kv_seq: int, dtype: str, causal: bool) -> str:
+    shape = "x".join(str(int(d)) for d in q_shape)
+    return (f"{jax.default_backend()}:{shape}:k{int(kv_seq)}:{dtype}:"
+            f"{'causal' if causal else 'full'}")
+
+
+def _measure_blocks(q, k, v, causal: bool, scale: float,
+                    block_q: int, block_k: int) -> float:
+    """Wall seconds for a few timed forward calls at the given blocks
+    (compile + one warmup excluded). Separated out so tests can stub the
+    timing without touching the sweep/caching logic."""
+    run = jax.jit(lambda q_, k_, v_: _flash_attention_tpu(
+        q_, k_, v_, causal, scale, block_q=block_q, block_k=block_k))
+    jax.block_until_ready(run(q, k, v))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = run(q, k, v)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def _sweep_blocks(q_shape, kv_seq: int, dtype: str,
+                  causal: bool) -> tuple[int, int]:
+    b, s, h, d = (int(x) for x in q_shape)
+    scale = d ** -0.5
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    jdt = jnp.dtype(dtype)
+    q = jax.random.normal(keys[0], (b, s, h, d), jdt)
+    k = jax.random.normal(keys[1], (b, kv_seq, h, d), jdt)
+    v = jax.random.normal(keys[2], (b, kv_seq, h, d), jdt)
+    best, best_t = (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K), float("inf")
+    seen: set[tuple[int, int]] = set()
+    for bq, bk in _BLOCK_CANDIDATES:
+        eff = (_pick_block(bq, s), _pick_block(bk, kv_seq))
+        if eff in seen:
+            continue
+        seen.add(eff)
+        try:
+            t = _measure_blocks(q, k, v, causal, scale, *eff)
+        except Exception:  # noqa: BLE001 - candidate may exceed VMEM
+            continue
+        if t < best_t:
+            best, best_t = eff, t
+    logging.getLogger(__name__).info(
+        "flash autotune: %s -> block_q=%d block_k=%d",
+        _cache_key(q_shape, kv_seq, dtype, causal), *best)
+    return best
+
+
+def get_block_sizes(q_shape, kv_seq: int, dtype: str, causal: bool,
+                    allow_sweep: bool = True) -> tuple[int, int]:
+    """Tuned (block_q, block_k) for a flash-attention call. Cached
+    winners (in-process, then disk) are returned immediately; otherwise a
+    sweep runs when enabled (see _autotune_enabled) and ``allow_sweep``
+    (False under tracing: timing through a tracer is meaningless). The
+    fallback everywhere else is the measured 256x512 default."""
+    key = _cache_key(q_shape, kv_seq, dtype, causal)
+    if key in _block_cache:
+        return _block_cache[key]
+    _load_disk_cache()
+    if key in _block_cache:
+        return _block_cache[key]
+    if not (allow_sweep and _autotune_enabled()):
+        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    winner = _sweep_blocks(q_shape, kv_seq, dtype, causal)
+    _block_cache[key] = winner
+    _store_disk_cache(key, winner)
+    return winner
 
 
 def _reference_attention(q, k, v, causal: bool, scale: float):
@@ -127,19 +284,30 @@ def _pick_block(preferred: int, seq: int) -> int:
 
 
 def _flash_attention_tpu(q, k, v, causal: bool, scale: float,
-                         block_q: int = 256, block_k: int = 512,
+                         block_q: int | None = None,
+                         block_k: int | None = None,
                          interpret: bool | None = None,
                          return_residuals: bool = False):
     """``interpret=True`` runs the kernel body through the Pallas
     interpreter on any backend — how CI validates the actual kernel math
     without silicon (tests/test_models.py). With ``return_residuals`` the
     call also returns the logsumexp rows ([b*h, s, _LANES], lane-
-    broadcast) the backward kernels consume."""
+    broadcast) the backward kernels consume. ``block_q``/``block_k``
+    default to the autotuned sizes for this shape (cached winner, or the
+    256x512 defaults when tuning is off/off-TPU/under tracing)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
         interpret = _INTERPRET
+    if block_q is None or block_k is None:
+        # tracers carry concrete shapes, so cached winners apply inside
+        # jit; only the timing sweep itself needs concrete arrays
+        tq, tk = get_block_sizes(
+            q.shape, k.shape[1], str(q.dtype), causal,
+            allow_sweep=not (interpret or isinstance(q, jax.core.Tracer)))
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     b, s, h, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(block_q, s)
@@ -269,17 +437,24 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_attention_bwd_tpu(q, k, v, o, lse, g, causal: bool, scale: float,
-                             block_q: int = 256, block_k: int = 512,
+                             block_q: int | None = None,
+                             block_k: int | None = None,
                              interpret: bool | None = None):
     """Blockwise flash-attention backward: dq gridded over Q blocks, dk/dv
     gridded over K blocks, probabilities recomputed from ``lse``. HBM
     traffic and VMEM footprint scale O(seq*d), not O(seq^2), matching the
-    forward kernel's point."""
+    forward kernel's point. Blocks default to the forward pass's tuned
+    sizes (never sweeps here: the backward only runs under grad tracing)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
         interpret = _INTERPRET
+    if block_q is None or block_k is None:
+        tq, tk = get_block_sizes(q.shape, k.shape[1], str(q.dtype), causal,
+                                 allow_sweep=False)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     b, s, h, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(block_q, s)

@@ -8,10 +8,15 @@ maps logical names to mesh axes. This is the GSPMD recipe: annotate,
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("parallel.sharding")
 
 
 @dataclass
@@ -111,9 +116,12 @@ def infer_param_axes(params, tp_layers: tuple[str, ...] = ()):
     - embeddings: (vocab, None) — vocab-parallel only; feature dim
       replicated (see inline comment)
     - biases/norm scales: replicated
-    - conv families (trees containing any 4D kernel): EVERYTHING
-      replicated; the fsdp axis only contributes batch sharding (see
-      the conv_family comment below)
+    - conv-DOMINATED trees (4D kernels holding >= half the params:
+      ResNet, UNet): EVERYTHING replicated; the fsdp axis only
+      contributes batch sharding (see the conv_family comment below).
+      Hybrid models whose conv params are a minority (a conv stem on a
+      transformer) keep ZeRO sharding for their dense kernels — only the
+      4D kernels themselves stay replicated.
     """
 
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
@@ -122,10 +130,24 @@ def infer_param_axes(params, tp_layers: tuple[str, ...] = ()):
     # kernels directly (output-channel vs batch on the same fsdp axis)
     # and the per-sample-vector projections (time-embedding MLPs, FiLM
     # shift/scale) via their batch-contraction kernel grads. In trees
-    # that contain conv kernels, every param is replicated: the fsdp
+    # DOMINATED by conv kernels, every param is replicated: the fsdp
     # axis still contributes batch sharding, so an fsdp>=2 mesh runs
     # clean (tests/test_models.py::test_conv_kernels_replicated_under_fsdp).
-    conv_family = any(getattr(p, "ndim", 0) == 4 for _, p in flat)
+    # A stray conv stem must NOT trigger this — whole-tree replication of
+    # a conv+transformer hybrid would undo ZeRO for the dominant dense
+    # params — so the rule is gated on conv params holding >= half the
+    # tree (4D kernels are individually replicated either way).
+    def _n(p) -> int:
+        return math.prod(getattr(p, "shape", ()) or (1,))
+
+    conv_params = sum(_n(p) for _, p in flat if getattr(p, "ndim", 0) == 4)
+    total_params = sum(_n(p) for _, p in flat) or 1
+    conv_family = conv_params * 2 >= total_params and conv_params > 0
+    if conv_family:
+        log.info(
+            "conv kernels hold %d/%d params (>= 50%%): replicating the "
+            "whole tree (fsdp contributes batch sharding only)",
+            conv_params, total_params)
 
     def axes_for(path, p):
         if conv_family:

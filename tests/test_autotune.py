@@ -1,0 +1,129 @@
+"""Flash-attention block-size autotuner (ops/attention.py)."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from move2kube_tpu.ops import attention
+
+SHAPE = (2, 256, 2, 64)  # (batch, seq, heads, head_dim)
+KV_SEQ = 256
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache(tmp_path, monkeypatch):
+    """Every test gets an empty in-process cache and its own disk file."""
+    monkeypatch.setenv("M2KT_FLASH_TUNE_CACHE", str(tmp_path / "blocks.json"))
+    attention._reset_block_cache()
+    yield
+    attention._reset_block_cache()
+
+
+def test_sweep_once_then_cached(monkeypatch, tmp_path):
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "1")
+    calls = []
+
+    def fake_measure(q, k, v, causal, scale, block_q, block_k):
+        calls.append((block_q, block_k))
+        return 0.5 if (block_q, block_k) == (128, 256) else 1.0
+
+    monkeypatch.setattr(attention, "_measure_blocks", fake_measure)
+    win = attention.get_block_sizes(SHAPE, KV_SEQ, "float32", True)
+    assert win == (128, 256)
+    n_swept = len(calls)
+    assert n_swept >= 2  # really swept a grid, not a single point
+
+    # second call: served from the in-process cache, no re-sweep
+    assert attention.get_block_sizes(SHAPE, KV_SEQ, "float32", True) == win
+    assert len(calls) == n_swept
+
+    # fresh process (cleared in-process cache): disk cache answers,
+    # still no re-sweep
+    attention._reset_block_cache()
+    assert attention.get_block_sizes(SHAPE, KV_SEQ, "float32", True) == win
+    assert len(calls) == n_swept
+    data = json.loads((tmp_path / "blocks.json").read_text())
+    assert list(data.values()) == [[128, 256]]
+
+
+def test_disabled_returns_defaults_without_sweeping(monkeypatch):
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "0")
+
+    def boom(*a, **k):
+        raise AssertionError("sweep must not run when disabled")
+
+    monkeypatch.setattr(attention, "_measure_blocks", boom)
+    assert attention.get_block_sizes(SHAPE, KV_SEQ, "float32", True) == (
+        attention.DEFAULT_BLOCK_Q, attention.DEFAULT_BLOCK_K)
+
+
+def test_off_tpu_default_is_no_sweep(monkeypatch):
+    """Unset env: sweeping is TPU-only (these tests run on CPU), so the
+    measured 256x512 defaults come back untouched."""
+    monkeypatch.delenv("M2KT_FLASH_AUTOTUNE", raising=False)
+    assert jax.default_backend() != "tpu"
+    assert not attention._autotune_enabled()
+    assert attention.get_block_sizes(SHAPE, KV_SEQ, "float32", False) == (
+        attention.DEFAULT_BLOCK_Q, attention.DEFAULT_BLOCK_K)
+
+
+def test_no_sweep_under_tracing(monkeypatch):
+    """Inside jit the shapes are concrete but timing is meaningless: the
+    kernel entry must pass allow_sweep=False for tracer inputs (a cached
+    winner still applies — the key is shape-based)."""
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "1")
+
+    def boom(*a, **k):
+        raise AssertionError("sweep must not run under tracing")
+
+    monkeypatch.setattr(attention, "_measure_blocks", boom)
+
+    q = jnp.zeros((1, 8, 1, 8), jnp.float32)
+
+    @jax.jit
+    def f(q, k, v):
+        return attention._flash_attention_tpu(q, k, v, False, 1.0,
+                                              interpret=True)
+
+    jax.block_until_ready(f(q, q, q))  # would raise via boom if swept
+
+
+def test_cached_winner_used_by_kernel_entry(monkeypatch):
+    """_flash_attention_tpu with no explicit blocks consults the cache:
+    a pre-seeded winner must show up (observed via _pick_block clamping
+    to the 8-long test sequence — exercised through the public resolve
+    path rather than kernel internals)."""
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "1")
+    key = attention._cache_key(SHAPE, KV_SEQ, "float32", True)
+    attention._block_cache[key] = (512, 1024)
+    assert attention.get_block_sizes(SHAPE, KV_SEQ, "float32", True) == (
+        512, 1024)
+
+
+def test_corrupt_disk_cache_is_ignored(monkeypatch, tmp_path):
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "1")
+    (tmp_path / "blocks.json").write_text("{not json")
+    monkeypatch.setattr(attention, "_measure_blocks",
+                        lambda *a, **k: 1.0)
+    win = attention.get_block_sizes(SHAPE, KV_SEQ, "float32", True)
+    assert win in (tuple(c) for c in attention._BLOCK_CANDIDATES)
+    # and the sweep result overwrote the corrupt file with valid json
+    json.loads((tmp_path / "blocks.json").read_text())
+
+
+def test_interpret_mode_flash_matches_reference_with_autotune_defaults():
+    """End-to-end sanity: the autotune-resolved default blocks keep the
+    interpreter-mode kernel numerically identical to the reference."""
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(keys[0], (1, 128, 2, 64), jnp.float32)
+    k = jax.random.normal(keys[1], (1, 128, 2, 64), jnp.float32)
+    v = jax.random.normal(keys[2], (1, 128, 2, 64), jnp.float32)
+    scale = 64 ** -0.5
+    out = attention._flash_attention_tpu(q, k, v, True, scale,
+                                         interpret=True)
+    ref = attention._reference_attention(q, k, v, True, scale)
+    assert jnp.allclose(out, ref, atol=2e-5)

@@ -239,3 +239,35 @@ def test_gke_tpu_profile_drops_psp():
     profile must not advertise it."""
     spec = get_cluster("GCP-GKE-TPU").spec
     assert spec.get_supported_versions("PodSecurityPolicy") == []
+
+
+def test_hpa_object_metric_round_trips_described_object():
+    """Object metrics name the scaled object ``target`` in v2beta1 and
+    ``describedObject`` in v2 — colliding with v2's metric-target
+    ``target``. Both conversion directions must rename it, and the
+    modern-shape marker is the nested ``metric`` (NOT ``target``, which
+    legacy Object metrics also carry)."""
+    from move2kube_tpu.apiresource.base import (
+        _hpa_metric_from_v2beta1, _hpa_metric_to_v2beta1)
+
+    ref = {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+           "name": "main-route"}
+    legacy = {"type": "Object",
+              "object": {"metricName": "requests-per-second",
+                         "targetValue": "10k", "target": dict(ref)}}
+    modern = _hpa_metric_from_v2beta1(legacy)
+    obj = modern["object"]
+    assert obj["describedObject"] == ref
+    assert obj["metric"] == {"name": "requests-per-second"}
+    assert obj["target"] == {"type": "Value", "value": "10k"}
+    assert "metricName" not in obj
+
+    back = _hpa_metric_to_v2beta1(modern)
+    assert back["object"]["target"] == ref
+    assert back["object"]["metricName"] == "requests-per-second"
+    assert back["object"]["targetValue"] == "10k"
+    assert "describedObject" not in back["object"]
+
+    # already-modern input passes through untouched: its structured
+    # metric-target must not be mistaken for an object reference
+    assert _hpa_metric_from_v2beta1(modern) == modern

@@ -1,0 +1,117 @@
+"""Persistent XLA compile cache (models/compile_cache.py) and its wiring
+into the bench child and the emitted container artifact.
+
+The cache is what makes re-spawned bench children and restarted training
+pods skip recompilation; these tests pin the knobs (M2KT_COMPILE_CACHE /
+M2KT_COMPILE_CACHE_DIR) and assert the wiring is actually present in the
+generated ``train_tpu.py`` + Dockerfile — not just in our source tree.
+"""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+import bench
+from move2kube_tpu.models.compile_cache import setup_compilation_cache
+
+
+@pytest.fixture(autouse=True)
+def _clean_env_and_restore_jax(monkeypatch):
+    monkeypatch.delenv("M2KT_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("M2KT_COMPILE_CACHE_DIR", raising=False)
+    old = jax.config.jax_compilation_cache_dir
+    yield
+    jax.config.update("jax_compilation_cache_dir", old)
+
+
+def test_setup_creates_dir_and_configures_jax(tmp_path, monkeypatch):
+    target = tmp_path / "jax-cache"
+    monkeypatch.setenv("M2KT_COMPILE_CACHE_DIR", str(target))
+    got = setup_compilation_cache()
+    assert got == str(target)
+    assert target.is_dir()
+    assert jax.config.jax_compilation_cache_dir == str(target)
+
+
+def test_disable_knob_wins_over_everything(tmp_path, monkeypatch):
+    monkeypatch.setenv("M2KT_COMPILE_CACHE", "0")
+    monkeypatch.setenv("M2KT_COMPILE_CACHE_DIR", str(tmp_path / "env"))
+    before = jax.config.jax_compilation_cache_dir
+    assert setup_compilation_cache(str(tmp_path / "arg")) is None
+    assert not (tmp_path / "env").exists()
+    assert jax.config.jax_compilation_cache_dir == before
+
+
+def test_env_dir_beats_caller_default(tmp_path, monkeypatch):
+    env_dir = tmp_path / "env-cache"
+    monkeypatch.setenv("M2KT_COMPILE_CACHE_DIR", str(env_dir))
+    assert setup_compilation_cache(str(tmp_path / "default")) == str(env_dir)
+    assert env_dir.is_dir()
+
+
+def test_caller_default_used_without_env(tmp_path):
+    d = tmp_path / "default-cache"
+    assert setup_compilation_cache(str(d)) == str(d)
+    assert d.is_dir()
+
+
+def test_unwritable_dir_degrades_to_no_cache(tmp_path, monkeypatch):
+    """A read-only filesystem must not kill the child/trainer."""
+    blocker = tmp_path / "file"
+    blocker.write_text("")
+    monkeypatch.setenv("M2KT_COMPILE_CACHE_DIR", str(blocker / "sub"))
+    assert setup_compilation_cache() is None
+
+
+# -- bench child wiring ------------------------------------------------------
+
+
+def test_run_child_tpu_phases_first_and_cache_setup(monkeypatch, capsys):
+    """S5: the child re-sorts requested phases TPU-first (PHASES order)
+    and sets up the persistent compile cache before anything compiles."""
+    events = []
+    monkeypatch.setattr(bench, "_setup_compile_cache",
+                        lambda: events.append("cache"))
+    for name in bench.PHASES:
+        def fn(n, _name=name):
+            events.append(_name)
+            return {"phase": _name, "metric": "m", "value": 1.0,
+                    "unit": "u", "vs_baseline": 0.0}
+        monkeypatch.setattr(bench, f"bench_{name}", fn)
+    rc = bench.run_child(["translate", "llama", "resnet"])
+    assert rc == 0
+    assert events == ["cache", "resnet", "llama", "translate"]
+    out = capsys.readouterr().out
+    assert out.count("RESULT ") == 3
+
+
+# -- emitted artifact --------------------------------------------------------
+
+
+def _emit(family="resnet"):
+    from move2kube_tpu.containerizer.jax_emit import emit_container
+    from move2kube_tpu.types.plan import AcceleratorInfo, PlanService
+
+    svc = PlanService(
+        service_name=family,
+        containerization_target_options=[family],
+        accelerator=AcceleratorInfo(gpu_count=8, model_family=family),
+    )
+    return emit_container(svc)
+
+
+def test_emitted_trainer_sets_up_compile_cache():
+    c = _emit()
+    train = c.new_files["train_tpu.py"]
+    # baked-in default dir; pods override via M2KT_COMPILE_CACHE_DIR on a
+    # mounted volume to survive restarts
+    assert 'setup_compilation_cache("/app/.jax-cache")' in train
+    assert "move2kube_tpu/models/compile_cache.py" in c.new_files
+    assert "M2KT_COMPILE_CACHE_DIR=/app/.jax-cache" in c.new_files["Dockerfile"]
+
+
+def test_emitted_trainer_carries_donation_verifier():
+    train = _emit().new_files["train_tpu.py"]
+    assert "M2KT_VERIFY_DONATION" in train
+    assert "assert_state_donated" in train

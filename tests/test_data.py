@@ -225,3 +225,138 @@ def test_prefetch_loader_next_after_close_stops():
     pre.close()
     with pytest.raises(StopIteration):
         next(pre)
+
+
+def test_batch_sharding_handles_abstract_mesh():
+    """data.batch_sharding used to crash on AbstractMesh (``.devices``
+    raises there); it now delegates to train.batch_sharding, which
+    returns the bare PartitionSpec for device-less meshes."""
+    from jax.sharding import AbstractMesh, PartitionSpec as P
+
+    amesh = AbstractMesh((("data", 4), ("fsdp", 2), ("pipe", 1),
+                          ("tensor", 1), ("seq", 1), ("expert", 1)))
+    assert m2kt_data.batch_sharding(amesh) == P(("data", "fsdp"))
+
+
+def test_batch_sharding_trivial_and_sharded(mesh):
+    import jax
+
+    one = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    assert isinstance(m2kt_data.batch_sharding(one),
+                      jax.sharding.SingleDeviceSharding)
+    s = m2kt_data.batch_sharding(mesh)
+    assert isinstance(s, jax.sharding.NamedSharding)
+
+
+def test_prefetch_transfers_host_batches_to_device(mesh):
+    """make_loader's prefetch path: the inner loader yields HOST batches
+    (numpy) and the pump thread owns the sharded H2D transfer, so the
+    transfer overlaps the running step instead of blocking at step
+    start."""
+    import jax
+
+    n, d = 32, 4
+    arrays = {"input": np.arange(n * d, dtype=np.float32).reshape(n, d)}
+    inner = m2kt_data.HostShardedLoader(dict(arrays), 8, mesh, seed=1,
+                                        to_device=False)
+    host_batch = next(m2kt_data.HostShardedLoader(
+        dict(arrays), 8, mesh, seed=1, to_device=False))
+    assert isinstance(host_batch["input"], np.ndarray)  # stays on host
+
+    with m2kt_data.PrefetchLoader(
+            inner, sharding=m2kt_data.batch_sharding(mesh)) as pre:
+        batch = next(pre)
+        assert isinstance(batch["input"], jax.Array)
+        assert batch["input"].sharding == m2kt_data.batch_sharding(mesh)
+        assert len(batch["input"].sharding.device_set) == 8
+        np.testing.assert_array_equal(np.asarray(batch["input"]),
+                                      host_batch["input"])
+
+
+def test_make_loader_prefetch_path_is_device_resident(tmp_path, mesh):
+    import jax
+
+    np.savez(tmp_path / "t.npz", input=np.zeros((32, 4), np.float32))
+    loader = m2kt_data.make_loader(str(tmp_path / "t.npz"), 8, mesh)
+    assert isinstance(loader, m2kt_data.PrefetchLoader)
+    assert loader._inner.to_device is False  # pump owns the transfer
+    with loader:
+        b = next(loader)
+        assert isinstance(b["input"], jax.Array)
+        assert len(b["input"].sharding.device_set) == 8
+
+
+def test_prefetch_overlaps_host_time_with_consumer_time():
+    """The point of the prefetcher: with a slow host iterator and a slow
+    consumer, N steps finish in ~max(host, consume) per step, not the
+    sum. Generous margin (0.75 x serial) keeps CI jitter out."""
+    import time
+
+    host_s = consume_s = 0.03
+    n = 15
+
+    class SlowHost:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            time.sleep(host_s)
+            return {"x": 1}
+
+    t0 = time.perf_counter()
+    with m2kt_data.PrefetchLoader(SlowHost(), depth=2) as pre:
+        for _ in range(n):
+            next(pre)
+            time.sleep(consume_s)  # the "train step"
+    overlapped = time.perf_counter() - t0
+    serial = n * (host_s + consume_s)
+    assert overlapped < serial * 0.75, (
+        f"no overlap: {overlapped:.2f}s vs serial {serial:.2f}s")
+
+
+def test_prefetch_close_joins_pump_and_warns_if_stuck(caplog, monkeypatch):
+    """close() must never silently leak: a pump thread that cannot exit
+    within the join timeout is logged (and the normal case leaves no
+    live thread at all)."""
+    import itertools
+    import logging
+    import threading
+
+    pre = m2kt_data.PrefetchLoader(itertools.repeat({"x": 1}), depth=1)
+    assert next(pre)["x"] == 1
+    thread = pre._thread
+    pre.close()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+
+    # stuck pump: inner blocks in next(); close() must return (bounded
+    # join) and warn instead of hanging or staying silent
+    ev = threading.Event()
+
+    class Blocking:
+        def __init__(self):
+            self.calls = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.calls += 1
+            if self.calls == 1:
+                return {"x": 1}  # lets the consumer's first next() return
+            ev.wait(30.0)
+            return {"x": 2}
+
+    pre2 = m2kt_data.PrefetchLoader(Blocking(), depth=1)
+    assert next(pre2)["x"] == 1  # pump now blocked in ev.wait
+
+    orig_join = threading.Thread.join
+    monkeypatch.setattr(  # don't stall the suite for the real 5s timeout
+        threading.Thread, "join",
+        lambda self, timeout=None: orig_join(self, timeout=0.2))
+    # the m2kt logger doesn't propagate (own stderr handler); let caplog see it
+    monkeypatch.setattr(logging.getLogger("m2kt"), "propagate", True)
+    with caplog.at_level(logging.WARNING):
+        pre2.close()
+    assert any("pump thread" in r.getMessage() for r in caplog.records)
+    ev.set()  # release the daemon thread

@@ -31,6 +31,9 @@ def run_emitted_program(cdir, **env_overrides):
         os.environ,
         JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        # keep the trainer's persistent compile cache inside the tmp
+        # container dir (the baked-in default is the image path /app)
+        M2KT_COMPILE_CACHE_DIR=".jax-cache",
         **{k: str(v) for k, v in env_overrides.items()},
     )
     return subprocess.run(

@@ -498,3 +498,31 @@ def test_single_device_mesh_compiles_plain_path():
         optax.adamw(1e-3), mesh8)
     assert any("fsdp" in str(l.sharding.spec)
                for l in jax.tree.leaves(state8.params))
+
+
+def test_conv_stem_on_transformer_keeps_dense_sharding():
+    """Whole-tree replication is gated on conv kernels DOMINATING the
+    param count: a small conv stem (216 params) on a large dense trunk
+    (131k params) must not undo ZeRO sharding for the dense kernels —
+    only the 4D kernel itself stays replicated."""
+    from move2kube_tpu.parallel.sharding import infer_param_axes
+
+    axes = infer_param_axes(
+        {"stem": {"kernel": jnp.zeros((3, 3, 3, 8))},
+         "mlp": {"kernel": jnp.zeros((256, 512))}})
+    assert axes["stem"]["kernel"] == (None, None, None, None)
+    assert axes["mlp"]["kernel"] == (None, "embed")
+
+
+def test_conv_family_replication_is_logged(caplog, monkeypatch):
+    """When conv dominance forces replication, say so: the silent version
+    of this rule cost a debugging session (round-4 verdict #2)."""
+    import logging
+
+    from move2kube_tpu.parallel.sharding import infer_param_axes
+
+    monkeypatch.setattr(logging.getLogger("m2kt"), "propagate", True)
+    with caplog.at_level(logging.INFO, logger="m2kt"):
+        infer_param_axes({"conv": {"kernel": jnp.zeros((3, 3, 8, 16))}})
+    assert any("replicating the whole tree" in r.message
+               for r in caplog.records)
