@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -65,6 +65,12 @@ e2e:
 # aliasing, device-prefetch overlap, flash block-autotune caching
 perf-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_donation.py tests/test_autotune.py tests/test_data.py -q -m "not slow"
+
+# resilience subsystem in isolation (all CPU-mode, deterministic faults):
+# kill-at-step-N -> resume-from-N under the supervisor, corrupt-checkpoint
+# fallback, JobSet failure-policy YAML, goodput accounting
+fault-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

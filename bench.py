@@ -66,7 +66,7 @@ MAX_WARMUP_CALLS = int(os.environ.get("M2KT_BENCH_MAX_WARMUP", "4"))
 WARM_FAST_S = float(os.environ.get("M2KT_BENCH_WARM_FAST_S", "3.0"))
 MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
-PHASES = ("resnet", "bert", "pallas", "llama", "translate")
+PHASES = ("resnet", "bert", "pallas", "llama", "translate", "goodput")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
@@ -75,6 +75,7 @@ PHASE_METRICS = {
     "pallas": ("pallas_flash_attention_tflops_v5e1", "TFLOP/s"),
     "llama": ("llama_train_throughput_v5e1", "tokens/s"),
     "translate": ("gpu2tpu_translate_throughput", "services/s"),
+    "goodput": ("train_goodput_fraction_faulted", "fraction"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
@@ -636,6 +637,61 @@ def bench_translate(n: int) -> dict:
             "services": n_services, "wall_s": round(dt, 2)}
 
 
+def bench_goodput(n: int) -> dict:
+    """Resilience-path goodput: run the supervised minitrain with one
+    injected kill mid-run (resilience subsystem's CI workload) and report
+    the merged productive-time fraction across attempts — the number that
+    decides what preemptible capacity actually costs. Pure CPU; padded
+    steps so the fraction reflects step time, not process startup."""
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="m2kt-goodput-")
+    exit_file = os.path.join(work, "exit.json")
+    env = dict(
+        os.environ,
+        PYTHONPATH=repo,
+        JAX_PLATFORMS="cpu",
+        M2KT_STEPS="12",
+        M2KT_STEP_SLEEP_S="0.05",
+        M2KT_CKPT_DIR=os.path.join(work, "ckpt"),
+        M2KT_CKPT_EVERY="3",
+        M2KT_FAULT_STEP="8",
+        M2KT_FAULT_KIND="exit",
+        M2KT_FAULT_MARKER=os.path.join(work, "fault-fired"),
+        M2KT_RETRY_MAX="2",
+        M2KT_RETRY_BACKOFF_S="0.1",
+        M2KT_EXIT_FILE=exit_file,
+        M2KT_GOODPUT_FILE=os.path.join(work, "goodput.json"),
+    )
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, "-m", "move2kube_tpu.resilience.supervisor", "--",
+         sys.executable, "-m", "move2kube_tpu.resilience.minitrain"],
+        env=env, cwd=work, capture_output=True, text=True, timeout=600)
+    dt = time.perf_counter() - t0
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"supervised minitrain rc={res.returncode}: {res.stderr[-300:]}")
+    with open(exit_file, encoding="utf-8") as f:
+        summary = json.load(f)
+    merged = summary["goodput"]
+    print(f"[bench] goodput {merged['goodput_fraction']:.2%} over "
+          f"{len(summary['attempts'])} attempts "
+          f"(lost {merged['seconds']['lost']:.1f}s) in {dt:.1f}s",
+          file=sys.stderr)
+    metric, unit = PHASE_METRICS["goodput"]
+    # no published baseline for faulted-run goodput on this workload
+    return {"phase": "goodput", "metric": metric,
+            "value": merged["goodput_fraction"], "unit": unit,
+            "vs_baseline": 0.0, "baseline": "none_published",
+            "attempts": len(summary["attempts"]),
+            "lost_s": merged["seconds"]["lost"],
+            "retry_s": merged["seconds"]["retry"],
+            "steps_done": merged["steps_done"], "wall_s": round(dt, 2)}
+
+
 def _setup_compile_cache() -> None:
     """Persistent XLA compile cache for this child: a re-spawned child
     (retry, OOM batch-halving) deserializes the previous child's
@@ -680,7 +736,7 @@ def run_child(phases: list[str]) -> int:
             return 1
     fns = {"resnet": bench_resnet, "bert": bench_bert,
            "pallas": bench_pallas, "llama": bench_llama,
-           "translate": bench_translate}
+           "translate": bench_translate, "goodput": bench_goodput}
     ok = True
     for phase in phases:
         try:

@@ -15,7 +15,10 @@ JobSet.
 
 from __future__ import annotations
 
+import os
+
 from move2kube_tpu.apiresource.base import APIResource, make_obj, obj_kind
+from move2kube_tpu.resilience import preemption
 from move2kube_tpu.types.ir import IR, Service
 from move2kube_tpu.utils.log import get_logger
 
@@ -74,6 +77,10 @@ def _tpu_resources(svc: Service, workload_kind: str = JOB_SET) -> None:
             ("M2KT_NUM_HOSTS", str(acc.num_hosts)),
             ("M2KT_COORDINATOR", coordinator if multihost else ""),
             ("M2KT_CKPT_DIR", ckpt_dir),
+            # preemption watcher budget mirrors the pod's grace period
+            # (same derivation — the YAML and the trainer can't drift)
+            ("M2KT_PREEMPT_GRACE_S", str(preemption.grace_period_seconds())),
+            ("M2KT_PREEMPT_FILE", preemption.DEFAULT_SENTINEL),
         ):
             if value and name not in existing:
                 env.append({"name": name, "value": value})
@@ -97,6 +104,47 @@ def _tpu_resources(svc: Service, workload_kind: str = JOB_SET) -> None:
                                  acc.tpu_accelerator or "tpu-v5-lite-podslice")
     svc.node_selector.setdefault("cloud.google.com/gke-tpu-topology",
                                  acc.tpu_topology or "1x1")
+
+
+def _retry_budget(name: str, env_var: str, qa_suffix: str, desc: str,
+                  default: int) -> int:
+    """Resolve a retry budget knob: env var wins (CI / one-off overrides),
+    else it is a QA problem like every other runtime decision (reference
+    philosophy) with the env-or-builtin value as the headless default."""
+    raw = os.environ.get(env_var, "")
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            log.warning("bad %s=%r; ignoring", env_var, raw)
+    from move2kube_tpu import qa
+
+    answer = qa.fetch_input(
+        f"m2kt.services.{name}.resilience.{qa_suffix}", desc,
+        [f"override via {env_var}"], str(default))
+    try:
+        return max(0, int(answer))
+    except (TypeError, ValueError):
+        log.warning("non-integer answer %r for %s; keeping default %d",
+                    answer, qa_suffix, default)
+        return default
+
+
+def _resilience_pod_hooks(template: dict) -> None:
+    """Preemption plumbing on a training pod template: a termination grace
+    period sized to the checkpoint budget (M2KT_CKPT_BUDGET_S + margin,
+    or M2KT_GRACE_PERIOD_S verbatim) and a preStop hook touching the
+    sentinel the emitted trainer's watcher polls — preStop fires before
+    kubelet delivers SIGTERM, buying the earliest possible warning."""
+    spec = template.setdefault("spec", {})
+    spec["terminationGracePeriodSeconds"] = preemption.grace_period_seconds()
+    for c in spec.get("containers", []):
+        c.setdefault("lifecycle", {}).setdefault("preStop", {
+            "exec": {"command": [
+                "/bin/sh", "-c",
+                f"touch {preemption.DEFAULT_SENTINEL}; sleep 2",
+            ]},
+        })
 
 
 def _chips_per_host(topology: str, num_hosts: int) -> int:
@@ -186,30 +234,71 @@ class DeploymentAPIResource(APIResource):
         if svc.restart_policy == "Always":
             svc.restart_policy = "OnFailure"
         completions = svc.accelerator.num_hosts if svc.accelerator else svc.replicas
+        template = pod_template(svc, labels)
+        if svc.accelerator is not None:
+            _resilience_pod_hooks(template)
         obj["spec"] = {
             "completions": completions,
             "parallelism": completions,
             "completionMode": "Indexed",
-            "backoffLimit": 4,
-            "template": pod_template(svc, labels),
+            "backoffLimit": _retry_budget(
+                svc.name, "M2KT_BACKOFF_LIMIT", "backoffLimit",
+                f"Pod failure budget (backoffLimit) for job [{svc.name}]", 4),
+            "template": template,
         }
         return obj
 
     def _create_jobset(self, svc: Service, labels: dict) -> dict:
-        """GKE TPU multi-host JobSet (jobset.x-k8s.io/v1alpha2)."""
+        """GKE TPU multi-host JobSet (jobset.x-k8s.io/v1alpha2).
+
+        Preemption-aware failure policy: a TPU slice is reclaimed as a
+        unit, so pod disruptions (DisruptionTarget condition: preemption,
+        maintenance, node drain) fail the job *fast* via the pod failure
+        policy and the JobSet-level rule restarts the whole set WITHOUT
+        burning maxRestarts — eviction is the normal case, not a crash.
+        Everything else (a real trainer bug → BackoffLimitExceeded)
+        counts against ``maxRestarts`` so a broken image can't restart
+        forever. In-pod transient retries are cheaper and happen first
+        (resilience.supervisor, the image entrypoint)."""
         acc = svc.accelerator
         obj = make_obj(JOB_SET, "jobset.x-k8s.io/v1alpha2", svc.name, labels)
-        svc.restart_policy = "Never"
+        # a source-declared OnFailure restart policy is honored (kubelet
+        # restarts the container in place, cheapest possible recovery);
+        # anything else is a run-to-completion Never
+        if svc.restart_policy != "OnFailure":
+            svc.restart_policy = "Never"
         svc.subdomain = svc.name  # stable host names for jax.distributed
+        template = pod_template(svc, labels)
+        _resilience_pod_hooks(template)
         job_spec = {
             "parallelism": acc.num_hosts,
             "completions": acc.num_hosts,
             "completionMode": "Indexed",
             "backoffLimit": 0,
-            "template": pod_template(svc, labels),
+            "template": template,
         }
+        if svc.restart_policy == "Never":
+            # podFailurePolicy requires restartPolicy: Never
+            job_spec["podFailurePolicy"] = {"rules": [{
+                "action": "FailJob",
+                "onPodConditions": [
+                    {"type": "DisruptionTarget", "status": "True"},
+                ],
+            }]}
         obj["spec"] = {
-            "failurePolicy": {"maxRestarts": 3},
+            "failurePolicy": {
+                "maxRestarts": _retry_budget(
+                    svc.name, "M2KT_MAX_RESTARTS", "maxRestarts",
+                    f"JobSet restart budget (maxRestarts) for [{svc.name}]",
+                    3),
+                "rules": [{
+                    # host failure / preemption: restart the whole JobSet
+                    # (multihost jax needs a full re-bootstrap) for free
+                    "name": "restart-on-host-failure",
+                    "action": "RestartJobSetAndIgnoreMaxRestarts",
+                    "onJobFailureReasons": ["PodFailurePolicy"],
+                }],
+            },
             "replicatedJobs": [{
                 "name": "workers",
                 "replicas": max(1, acc.num_slices),  # one Job replica per slice

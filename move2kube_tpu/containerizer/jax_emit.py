@@ -33,8 +33,9 @@ _ASSETS = os.path.join(_PKG_ROOT, "assets", "jax")
 # "native" ships its .py fallback AND the C source: the vendored tree is
 # copied, not pip-installed, so the emitted Dockerfile best-effort-builds
 # the extension (transient gcc install, `|| true`); when that fails
-# gather_rows degrades to the numpy fallback
-VENDORED_SUBPACKAGES = ("models", "parallel", "ops", "native")
+# gather_rows degrades to the numpy fallback. "resilience" is the
+# preemption/supervisor/goodput stack the image's entrypoint runs under.
+VENDORED_SUBPACKAGES = ("models", "parallel", "ops", "native", "resilience")
 
 REQUIREMENTS = """jax[tpu]>=0.4.35
 flax
@@ -62,13 +63,15 @@ def _vendor_package(container: Container) -> None:
                 continue
             with open(os.path.join(sub_dir, fname), encoding="utf-8") as f:
                 container.add_file(f"move2kube_tpu/{sub}/{fname}", f.read())
-    # models/data.py and parallel/sharding.py log through utils.log; ship
-    # just that module under a stub __init__ — the full utils package
-    # would drag yaml and the QA engine into the image
+    # models/data.py and parallel/sharding.py log through utils.log, and
+    # resilience/goodput.py mirrors its ledger into utils.trace counters;
+    # ship just those two stdlib-only modules under a stub __init__ — the
+    # full utils package would drag yaml and the QA engine into the image
     container.add_file("move2kube_tpu/utils/__init__.py", "")
-    with open(os.path.join(_PKG_ROOT, "utils", "log.py"),
-              encoding="utf-8") as f:
-        container.add_file("move2kube_tpu/utils/log.py", f.read())
+    for mod in ("log.py", "trace.py"):
+        with open(os.path.join(_PKG_ROOT, "utils", mod),
+                  encoding="utf-8") as f:
+            container.add_file(f"move2kube_tpu/utils/{mod}", f.read())
 
 
 TPU_ACCELERATOR_OPTIONS = [

@@ -53,8 +53,9 @@ class CheckpointManager:
         return self._mngr.latest_step()
 
     def restore_or_init(self, state, ported_restore=None):
-        """Return (state, start_step): the latest checkpoint restored into
-        ``state``'s sharding layout, or ``state`` itself at step 0.
+        """Return (state, start_step): the newest *readable* checkpoint
+        restored into ``state``'s sharding layout, or ``state`` itself at
+        step 0.
 
         Two checkpoint shapes are accepted: a full TrainState (periodic
         saves from the training loop), and a params-only dict written by
@@ -62,17 +63,45 @@ class CheckpointManager:
         latter grafts params into the fresh state, keeping new optimizer
         state, so a GPU fine-tune resumes from its pretrained weights.
 
+        Corrupt-latest fallback: a preempted or failed host can leave the
+        newest step truncated or partially written. Instead of crashing
+        the restarted pod in a loop (which burns the JobSet's maxRestarts
+        on an unfixable artifact), an unreadable step falls back to the
+        previous retained step, oldest-retained last; when *no* retained
+        step restores, training restarts from step 0 with a loud error —
+        forward progress with bounded loss beats a crashloop. Exercised
+        in tier-1 by ``resilience.faults.corrupt_latest``.
+
         ``ported_restore``: optional ``(abstract_params, graft_fn)`` for
         states whose param layout differs from the ported flat layout —
         the pipeline trainers' staged trees (models/{gpt2,llama}_pipe
         ``flat_param_shapes`` + ``graft_ported_params``). The checkpoint
         is restored into ``abstract_params`` and ``graft_fn(state,
         flat_params)`` regroups it into the live state."""
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
+            return state, 0
+        for i, step in enumerate(steps):
+            try:
+                return self._restore_at(step, state, ported_restore)
+            except Exception as e:  # noqa: BLE001 - orbax raises many types
+                log.warning(
+                    "checkpoint step %d unreadable (%s: %s); %s", step,
+                    type(e).__name__, e,
+                    "falling back to previous retained step"
+                    if i + 1 < len(steps) else "no retained steps left")
+        log.error(
+            "no retained checkpoint under %r is restorable; starting from "
+            "step 0 (corrupt artifacts left in place for inspection)",
+            self._mngr.directory if hasattr(self._mngr, "directory") else "?")
+        return state, 0
+
+    def _restore_at(self, step: int, state, ported_restore=None):
+        """Restore one specific step, negotiating the checkpoint shape
+        (full TrainState → ported flat layout → params-only partial).
+        Raises when the step is unreadable in every shape."""
         import orbax.checkpoint as ocp
 
-        step = self._mngr.latest_step()
-        if step is None:
-            return state, 0
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
         try:
             restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
@@ -100,13 +129,27 @@ class CheckpointManager:
         return state, 0
 
     def maybe_save(self, step: int, state, force: bool = False) -> bool:
-        """Save when ``step`` hits the cadence (async; returns immediately)."""
+        """Save when ``step`` hits the cadence (async; returns immediately).
+
+        ``M2KT_CKPT_SYNC=1`` blocks until the save commits — trades the
+        async overlap for a guarantee that every step the goodput ledger
+        reports as saved is actually durable (short runs on flaky
+        capacity, CI fault drills); default async can lose the newest
+        in-flight save to an abrupt death, falling back one cadence."""
         if not force and step % self.every:
             return False
         import orbax.checkpoint as ocp
 
         self._mngr.save(step, args=ocp.args.StandardSave(state))
+        if os.environ.get("M2KT_CKPT_SYNC", "0") == "1":
+            self._mngr.wait_until_finished()
         return True
+
+    def wait(self) -> None:
+        """Block until in-flight async saves commit. The last-chance
+        preemption path and the fault-injection tests need the step
+        durably on disk before the process may die."""
+        self._mngr.wait_until_finished()
 
     def close(self) -> None:
         """Block until in-flight async saves land, then release."""

@@ -158,6 +158,19 @@ class HostShardedLoader:
         for _ in range(n):
             self._advance()
 
+    # uniform loader protocol: every make_loader() product is a context
+    # manager, so retry loops (resilience.supervisor) can hold ANY loader
+    # in a `with` without caring which variant owns a pump thread
+    def close(self) -> None:
+        """Nothing to release (no thread, no buffered device batches)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
 
 class PrefetchLoader:
     """Double-buffered *device* prefetch: a background thread assembles
@@ -308,5 +321,14 @@ def make_loader(path: str, global_batch: int, mesh: Mesh,
 
         def skip(self, n: int) -> None:
             self._i += n
+
+        def close(self) -> None:
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
 
     return _Synthetic()

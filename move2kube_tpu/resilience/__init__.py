@@ -1,0 +1,25 @@
+"""Preemption-aware resilience for emitted TPU training workloads.
+
+GKE TPU slices are preemptible by design: maintenance events, spot
+reclaims and host failures are the normal case, not the exception. This
+package makes the emitted training pods survive them cheaply and makes
+the cost measurable:
+
+- ``preemption``  — SIGTERM / preStop-sentinel watcher that coordinates a
+  multihost last-chance synchronous checkpoint inside the pod's
+  termination grace period;
+- ``supervisor``  — in-pod retry wrapper around the trainer: classifies
+  fatal vs. retryable exits, restarts with exponential backoff, writes a
+  structured exit-reason file;
+- ``faults``      — deterministic CPU-CI fault injection (die at step N,
+  corrupt/truncate the latest checkpoint) so resume paths are provable
+  in tier-1 without TPUs;
+- ``goodput``     — goodput/badput accounting (productive step time vs.
+  compile/restore/save/retry/lost), flushed to a JSON report and
+  mirrored into ``utils.trace`` counters;
+- ``minitrain``   — a tiny real JAX trainer wired through all of the
+  above; the fault-injection harness target for CI and `bench.py`.
+
+Dependency-light on purpose: the jax-xla containerizer vendors this
+package into every emitted image (stdlib + lazy jax imports only).
+"""

@@ -1,0 +1,140 @@
+"""Deterministic fault injection for resume-path testing on CPU.
+
+The resume story ("a pod that dies at step N restarts from N") is only
+real if CI can prove it without TPUs or a cluster. This module gives the
+training loop one cheap hook — :func:`maybe_inject` — driven entirely by
+``M2KT_FAULT_*`` env vars, plus helpers that damage the latest on-disk
+checkpoint the way a preempted host does (partial writes, truncation).
+
+Knobs (all inert when unset — production pods never set them):
+
+- ``M2KT_FAULT_STEP``      — step number at which the fault fires
+- ``M2KT_FAULT_KIND``      — ``exit`` (sys.exit, default) | ``raise``
+  (RuntimeError, reads as a retryable crash) | ``sigkill`` (os.kill
+  SIGKILL: the no-cleanup death a host failure produces)
+- ``M2KT_FAULT_EXIT_CODE`` — exit code for ``exit`` (default 1)
+- ``M2KT_FAULT_MARKER``    — path to an exactly-once marker: the fault
+  fires only when the file is absent and creates it first, so the
+  supervisor's restarted attempt survives. Without a marker the fault
+  fires on every attempt (for testing retry exhaustion).
+
+Stdlib-only; vendored into emitted images (where it stays dormant).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+
+log = logging.getLogger("m2kt.faults")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by the ``raise`` fault kind (classified retryable)."""
+
+
+def _marker_fired(marker: str) -> bool:
+    """True when the exactly-once marker says this fault already fired;
+    otherwise claims it (O_EXCL so concurrent hosts race safely)."""
+    if not marker:
+        return False
+    try:
+        fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return True
+    os.close(fd)
+    return False
+
+
+def maybe_inject(step: int) -> None:
+    """Fire the configured fault when ``step`` matches; no-op otherwise.
+
+    Called once per training step — two env reads when unconfigured,
+    nothing cached so tests can flip the knobs between runs.
+    """
+    raw = os.environ.get("M2KT_FAULT_STEP", "")
+    if not raw:
+        return
+    try:
+        at = int(raw)
+    except ValueError:
+        return
+    if step != at:
+        return
+    if _marker_fired(os.environ.get("M2KT_FAULT_MARKER", "")):
+        return
+    kind = os.environ.get("M2KT_FAULT_KIND", "exit")
+    log.warning("injecting fault kind=%s at step %d", kind, step)
+    print(f"[m2kt] FAULT: injected {kind} at step {step}", flush=True)
+    if kind == "raise":
+        raise FaultInjected(f"injected transient fault at step {step}")
+    if kind == "sigkill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    sys.exit(int(os.environ.get("M2KT_FAULT_EXIT_CODE", "1")))
+
+
+# -- checkpoint damage (what a preempted host leaves behind) ----------------
+
+
+def step_dirs(ckpt_dir: str) -> list[tuple[int, str]]:
+    """(step, path) for every retained orbax step dir, ascending."""
+    out = []
+    try:
+        entries = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    for name in entries:
+        p = os.path.join(ckpt_dir, name)
+        if os.path.isdir(p) and name.isdigit():
+            out.append((int(name), p))
+    return sorted(out)
+
+
+def _payload_files(step_dir: str) -> list[str]:
+    """Every array-payload replica in an orbax step dir. Ocdbt keeps the
+    chunk data twice (merged ``d/`` + per-process ``ocdbt.process_N/d/``)
+    and restore transparently falls back between them, so *all* replicas
+    must be damaged or the corruption is silently healed. When no ``d/``
+    dir exists (layout change), the structure metadata is the victim."""
+    payload, metadata = [], []
+    for dirpath, _dirs, names in os.walk(step_dir):
+        for n in names:
+            p = os.path.join(dirpath, n)
+            if os.path.basename(dirpath) == "d":
+                payload.append(p)
+            elif n == "_METADATA":
+                metadata.append(p)
+    return sorted(payload) or metadata
+
+
+def corrupt_latest(ckpt_dir: str, mode: str = "truncate") -> int:
+    """Damage the newest retained checkpoint; returns the step damaged.
+
+    ``truncate`` halves each payload file (partial write); ``scribble``
+    overwrites their heads with garbage (bit rot / torn block);
+    ``remove`` deletes them (lost objects). Raises FileNotFoundError
+    when there is no checkpoint to damage.
+    """
+    steps = step_dirs(ckpt_dir)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint steps under {ckpt_dir!r}")
+    step, sdir = steps[-1]
+    victims = _payload_files(sdir)
+    if not victims:
+        raise FileNotFoundError(f"checkpoint step dir {sdir!r} is empty")
+    for victim in victims:
+        if mode == "remove":
+            os.remove(victim)
+        elif mode == "scribble":
+            size = os.path.getsize(victim)
+            with open(victim, "r+b") as f:
+                f.write(b"\xde\xad\xbe\xef" * max(1, min(size, 4096) // 4))
+        else:  # truncate
+            size = os.path.getsize(victim)
+            with open(victim, "r+b") as f:
+                f.truncate(size // 2)
+    log.warning("corrupted checkpoint step %d (%s x%d: %s ...)",
+                step, mode, len(victims), victims[0])
+    return step

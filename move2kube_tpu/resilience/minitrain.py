@@ -1,0 +1,132 @@
+"""Tiny real trainer wired through the whole resilience stack.
+
+The fault-injection harness needs a *runnable* training child — real
+jit-compiled steps, real orbax checkpoints, real resume — that finishes
+in seconds on one CPU device. This module is that child: the CI target
+for kill-at-step-N / corrupt-checkpoint proofs (``tests/test_resilience``,
+``make fault-smoke``) and the workload behind ``bench.py``'s goodput
+phase. It deliberately mirrors the structure of the emitted
+``train_tpu.py`` loop (restore → step/fault/save → preempt check →
+goodput flush) so what CI proves here is the same control flow the
+emitted trainers run on a slice.
+
+Run under the supervisor::
+
+    python -m move2kube_tpu.resilience.supervisor -- \
+        python -m move2kube_tpu.resilience.minitrain
+
+Knobs: ``M2KT_STEPS`` (default 8), ``M2KT_CKPT_DIR``/``M2KT_CKPT_EVERY``
+(checkpointing off when unset, like the emitted trainers),
+``M2KT_STEP_SLEEP_S`` (default 0 — pad steps so goodput numbers have
+visible magnitude), plus every ``M2KT_FAULT_*`` / ``M2KT_PREEMPT_*``
+knob from :mod:`faults` and :mod:`preemption`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def main() -> None:
+    # a CPU harness by definition: never grab a TPU someone is using
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import linen as nn
+
+    from move2kube_tpu.models import checkpoint as m2kt_ckpt
+    from move2kube_tpu.models import train as m2kt_train
+    from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+    from move2kube_tpu.resilience import faults, goodput, preemption
+
+    steps = int(os.environ.get("M2KT_STEPS", "8"))
+    step_sleep = float(os.environ.get("M2KT_STEP_SLEEP_S", "0"))
+    batch, dim = 4, 8
+
+    gp = goodput.GoodputTracker()
+    watcher = preemption.from_env()
+    if watcher is not None:
+        watcher.install()
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.relu(nn.Dense(8)(x)))
+
+    mesh = make_mesh(MeshConfig(data=jax.device_count()))
+    sample = {"x": jnp.zeros((batch, dim))}
+    state = m2kt_train.create_sharded_state(
+        jax.random.PRNGKey(0), Tiny(), sample, optax.sgd(1e-2), mesh)
+
+    def step_fn(state, x):
+        def loss_fn(params):
+            out = state.apply_fn({"params": params}, x)
+            return jnp.mean(out * out)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    step_fn = jax.jit(step_fn, donate_argnums=(0,))
+
+    ckpt = m2kt_ckpt.from_env(default_every=1)
+    start = 0
+    if ckpt is not None:
+        with gp.phase("restore"):
+            state, start = ckpt.restore_or_init(state)
+        if start:
+            gp.note_resume(start)
+            gp.note_saved(start)
+            print(f"[m2kt] resumed from step {start}", flush=True)
+
+    def make_batch(i: int) -> jnp.ndarray:
+        return jnp.asarray(
+            np.random.default_rng(i).random((batch, dim), np.float32))
+
+    preempted_at = None
+    loss = None
+    for i in range(start + 1, steps + 1):
+        faults.maybe_inject(i)
+        t0 = time.perf_counter()
+        state, loss = step_fn(state, make_batch(i))
+        jax.block_until_ready(loss)
+        if step_sleep:
+            time.sleep(step_sleep)
+        gp.add("compile" if i == start + 1 else "productive",
+               time.perf_counter() - t0, steps=1)
+        if ckpt is not None and ckpt.maybe_save(i, state):
+            # synchronous commit: the fault tests assert resume-from-N, so
+            # a save the loop reports must be durable before a kill can land
+            ckpt.wait()
+            gp.note_saved(i)
+            gp.write()
+        if watcher is not None and watcher.should_stop(i):
+            preempted_at = i
+            break
+    if ckpt is not None:
+        last = preempted_at if preempted_at is not None else steps
+        with gp.phase("save"):
+            if last >= start + 1:
+                ckpt.maybe_save(last, state, force=True)
+            ckpt.close()  # block: the last save must land before exit
+        gp.note_saved(last)
+    if loss is not None:
+        print(f"[m2kt] step={gp.steps_done} loss={float(loss):.4f}",
+              flush=True)
+    gp.write()
+    rep = gp.report()
+    if preempted_at is not None:
+        print(f"[m2kt] preempted: last-chance checkpoint at step "
+              f"{preempted_at}; goodput={rep['goodput_fraction']:.2%}",
+              flush=True)
+        sys.exit(143)
+    print(f"[m2kt] done steps={steps} "
+          f"goodput={rep['goodput_fraction']:.2%}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

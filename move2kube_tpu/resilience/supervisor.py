@@ -1,0 +1,287 @@
+"""In-pod supervisor: retry the trainer, classify its deaths, keep score.
+
+The JobSet failure policy restarts whole jobs, but a full JobSet restart
+re-runs ``jax.distributed`` bootstrap, re-schedules pods and (uncached)
+recompiles — tens of badput minutes on big models. Cheap transient
+failures (a flaky coordinator connection, an injected test fault, a
+spurious crash) are better retried *inside* the pod, where the compile
+cache and the mounted checkpoint are warm. This wrapper is the emitted
+image's entrypoint::
+
+    python -m move2kube_tpu.resilience.supervisor -- python train_tpu.py
+
+Behavior:
+
+- runs the trainer as a child, streaming its stderr through while
+  keeping a tail for exit classification;
+- classifies each death as ``ok`` / ``preempted`` / ``retryable`` /
+  ``fatal`` (table below) and restarts retryable ones with exponential
+  backoff, up to ``M2KT_RETRY_MAX`` attempts;
+- forwards SIGTERM to the child and stops retrying — a preempted pod is
+  going away; the last-chance checkpoint already happened in the child;
+- merges the per-attempt goodput reports (``resilience.goodput``) into a
+  pod-level summary, mirrored into ``utils.trace`` counters and the pod
+  metrics file;
+- writes a structured exit-reason file (``M2KT_EXIT_FILE``, default
+  ``m2kt-exit.json``) so the JobSet controller's restart decision — and
+  the human debugging it — sees *why* the pod died, not just the code.
+
+Classification table (first match wins):
+
+====================  ==========  =======================================
+signal / pattern      class       rationale
+====================  ==========  =======================================
+rc 0                  ok          trainer finished
+SIGTERM / rc 143      preempted   node reclaim; don't fight the eviction
+SIGKILL / rc 137      retryable   OOM-killer or host kill; warm restart
+SyntaxError,          fatal       the image is broken; a retry loop
+ImportError,                      cannot fix code
+ModuleNotFoundError
+"exceeds the",        fatal       config rejected at startup (positional
+"not divisible"                   table, mesh shape); deterministic
+DEADLINE_EXCEEDED,    retryable   transient runtime/collective trouble
+UNAVAILABLE,
+connection/barrier/
+heartbeat, libtpu,
+RESOURCE_EXHAUSTED
+anything else         retryable   optimistic but bounded by the retry
+                                  budget; exhaustion reports the last rc
+====================  ==========  =======================================
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+from move2kube_tpu.resilience import goodput
+
+log = logging.getLogger("m2kt.supervisor")
+
+OK = "ok"
+PREEMPTED = "preempted"
+RETRYABLE = "retryable"
+FATAL = "fatal"
+
+# substring tables over the stderr tail; fatal checked first
+FATAL_PATTERNS = (
+    "SyntaxError", "ImportError", "ModuleNotFoundError",
+    "exceeds the", "not divisible",
+)
+RETRYABLE_PATTERNS = (
+    "DEADLINE_EXCEEDED", "UNAVAILABLE", "RESOURCE_EXHAUSTED",
+    "onnection", "Broken pipe", "barrier", "heartbeat",
+    "libtpu", "TPU initialization", "FaultInjected", "injected transient",
+)
+
+STDERR_TAIL_CHARS = 4000
+BACKOFF_CAP_S = 60.0
+
+
+def classify(returncode: int, stderr_tail: str = "") -> str:
+    """Map a child exit to ok / preempted / retryable / fatal."""
+    if returncode == 0:
+        return OK
+    if returncode in (-signal.SIGTERM, 128 + signal.SIGTERM):
+        return PREEMPTED
+    if returncode in (-signal.SIGKILL, 128 + signal.SIGKILL):
+        return RETRYABLE
+    for pat in FATAL_PATTERNS:
+        if pat in stderr_tail:
+            return FATAL
+    for pat in RETRYABLE_PATTERNS:
+        if pat in stderr_tail:
+            return RETRYABLE
+    return RETRYABLE
+
+
+def exit_file_path() -> str:
+    explicit = os.environ.get("M2KT_EXIT_FILE", "")
+    if explicit:
+        return explicit
+    out_dir = os.environ.get("M2KT_METRICS_DIR", "") or "."
+    return os.path.join(out_dir, "m2kt-exit.json")
+
+
+class Supervisor:
+    def __init__(self, cmd: list[str], max_retries: int | None = None,
+                 backoff_s: float | None = None,
+                 exit_file: str | None = None):
+        if max_retries is None:
+            max_retries = int(os.environ.get("M2KT_RETRY_MAX", "3"))
+        if backoff_s is None:
+            backoff_s = float(os.environ.get("M2KT_RETRY_BACKOFF_S", "5"))
+        self.cmd = list(cmd)
+        self.max_retries = max(0, max_retries)
+        self.backoff_s = max(0.0, backoff_s)
+        self.exit_file = exit_file or exit_file_path()
+        self._child: subprocess.Popen | None = None
+        self._got_sigterm = False
+        self._attempts: list[dict] = []
+        self._retry_sleep_total = 0.0
+
+    # -- signal forwarding --------------------------------------------------
+
+    def _on_sigterm(self, signum, frame) -> None:
+        self._got_sigterm = True
+        child = self._child
+        if child is not None and child.poll() is None:
+            try:
+                child.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+
+    # -- one attempt --------------------------------------------------------
+
+    def _run_once(self) -> tuple[int, str, float]:
+        """Run the child once; returns (rc, stderr_tail, wall_seconds).
+        Child stdout passes straight through; stderr is tee'd so the pod
+        log is intact AND the tail is available for classification."""
+        tail: deque[str] = deque(maxlen=200)
+        t0 = time.monotonic()
+        self._child = subprocess.Popen(
+            self.cmd, stderr=subprocess.PIPE, text=True, errors="replace")
+
+        def _tee(pipe):
+            for line in pipe:
+                sys.stderr.write(line)
+                tail.append(line)
+            pipe.close()
+
+        t = threading.Thread(target=_tee, args=(self._child.stderr,),
+                             daemon=True)
+        t.start()
+        rc = self._child.wait()
+        t.join(timeout=10.0)
+        self._child = None
+        return rc, "".join(tail)[-STDERR_TAIL_CHARS:], time.monotonic() - t0
+
+    # -- the loop -----------------------------------------------------------
+
+    def run(self) -> int:
+        prev = signal.signal(signal.SIGTERM, self._on_sigterm)
+        try:
+            return self._run_supervised()
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def _run_supervised(self) -> int:
+        gp_path = goodput.report_path()
+        attempt = 0
+        while True:
+            attempt += 1
+            # stale report from the previous attempt must not be re-read
+            # if this attempt dies before its first flush
+            try:
+                os.remove(gp_path)
+            except OSError:
+                pass
+            rc, tail, wall = self._run_once()
+            clazz = classify(rc, tail)
+            if self._got_sigterm:
+                clazz = PREEMPTED
+            report = goodput.read_report(gp_path)
+            self._attempts.append({
+                "attempt": attempt, "returncode": rc, "class": clazz,
+                "wall_seconds": round(wall, 3),
+                "stderr_tail": tail[-2000:],
+                "report": report, "ok": clazz == OK,
+            })
+            log.warning("attempt %d exited rc=%d class=%s", attempt, rc, clazz)
+            if clazz == OK:
+                return self._finish(OK, 0)
+            if clazz == PREEMPTED:
+                return self._finish(PREEMPTED, 128 + signal.SIGTERM)
+            if clazz == FATAL:
+                return self._finish(FATAL, self._normalize_rc(rc))
+            if attempt > self.max_retries:
+                return self._finish("retries_exhausted",
+                                    self._normalize_rc(rc))
+            delay = min(BACKOFF_CAP_S, self.backoff_s * (2 ** (attempt - 1)))
+            print(f"[m2kt] supervisor: attempt {attempt} {clazz} (rc={rc}); "
+                  f"restarting in {delay:.1f}s "
+                  f"({self.max_retries - attempt + 1} retries left)",
+                  flush=True)
+            time.sleep(delay)
+            self._retry_sleep_total += delay
+
+    @staticmethod
+    def _normalize_rc(rc: int) -> int:
+        return 128 - rc if rc < 0 else (rc or 1)
+
+    def _finish(self, exit_class: str, code: int) -> int:
+        merged = goodput.merge_attempts(self._attempts)
+        merged["seconds"]["retry"] = round(
+            merged["seconds"].get("retry", 0.0) + self._retry_sleep_total, 3)
+        summary = {
+            "exit_class": exit_class,
+            "returncode": code,
+            "cmd": self.cmd,
+            "attempts": [
+                {k: v for k, v in a.items() if k != "ok"}
+                for a in self._attempts
+            ],
+            "goodput": merged,
+        }
+        try:
+            d = os.path.dirname(os.path.abspath(self.exit_file))
+            os.makedirs(d, exist_ok=True)
+            tmp = self.exit_file + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(summary, f, indent=2, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, self.exit_file)
+        except OSError as e:
+            log.warning("could not write exit-reason file %s: %s",
+                        self.exit_file, e)
+        goodput.mirror_to_trace(merged)
+        metrics_dir = os.environ.get("M2KT_METRICS_DIR", "")
+        if metrics_dir:
+            try:
+                from move2kube_tpu.utils import trace
+
+                trace.write_metrics(metrics_dir)
+            except Exception as e:  # noqa: BLE001 - metrics are best-effort
+                log.warning("could not write pod metrics: %s", e)
+        print(f"[m2kt] supervisor: {exit_class} after "
+              f"{len(self._attempts)} attempt(s); goodput="
+              f"{merged['goodput_fraction']:.2%} "
+              f"(lost {merged['seconds']['lost']:.1f}s, "
+              f"retry {merged['seconds']['retry']:.1f}s)", flush=True)
+        return code
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        opts, cmd = argv[:split], argv[split + 1:]
+    else:
+        opts, cmd = [], argv
+    if not cmd:
+        print("usage: python -m move2kube_tpu.resilience.supervisor "
+              "[--max-retries N] [--backoff-s S] -- <command...>",
+              file=sys.stderr)
+        return 2
+    max_retries = backoff = None
+    it = iter(opts)
+    for tok in it:
+        if tok == "--max-retries":
+            max_retries = int(next(it, "3"))
+        elif tok == "--backoff-s":
+            backoff = float(next(it, "5"))
+        else:
+            print(f"unknown supervisor option {tok!r}", file=sys.stderr)
+            return 2
+    return Supervisor(cmd, max_retries=max_retries, backoff_s=backoff).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

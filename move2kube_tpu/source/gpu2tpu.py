@@ -35,6 +35,75 @@ log = get_logger("source.gpu2tpu")
 
 _SKIP_DIR_NAMES = {".git", "node_modules", "__pycache__", ".venv", "venv", "vendor"}
 
+_COMPOSE_NAMES = ("docker-compose.yaml", "docker-compose.yml",
+                  "compose.yaml", "compose.yml")
+
+
+def source_restart_policy(src_dir: str) -> str:
+    """K8s restart policy declared by a compose file in the claimed GPU
+    training directory, "" when none is declared.
+
+    The workload author's operational intent survives translation: a
+    trainer they ran with ``restart: on-failure`` keeps kubelet-level
+    in-place restarts (the cheapest recovery — no pod reschedule, warm
+    page cache); ``restart: "no"`` stays Never. ``always`` class policies
+    map to OnFailure — a run-to-completion Job has no Always. When the
+    compose file has several services, the one with a GPU reservation
+    wins; else a single restart-declaring service wins; else ambiguous
+    declarations are ignored (logged)."""
+    import yaml
+
+    path = next((os.path.join(src_dir, n) for n in _COMPOSE_NAMES
+                 if os.path.isfile(os.path.join(src_dir, n))), None)
+    if path is None:
+        return ""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = yaml.safe_load(f) or {}
+    except (OSError, yaml.YAMLError) as e:
+        log.warning("unreadable compose file %s: %s", path, e)
+        return ""
+    services = doc.get("services") or {}
+    if not isinstance(services, dict):
+        return ""
+
+    def _restart_of(svc_def: dict) -> str:
+        deploy = svc_def.get("deploy") or {}
+        raw = str(svc_def.get("restart", "")
+                  or (deploy.get("restart_policy") or {}).get("condition", ""))
+        if raw in ("no", "none"):
+            return "Never"
+        if raw.startswith("on-failure"):
+            return "OnFailure"
+        if raw in ("always", "any", "unless-stopped"):
+            log.info("compose restart %r maps to OnFailure for the "
+                     "run-to-completion training Job", raw)
+            return "OnFailure"
+        return ""
+
+    def _has_gpu(svc_def: dict) -> bool:
+        if svc_def.get("runtime") == "nvidia":
+            return True
+        devices = ((svc_def.get("deploy") or {}).get("resources", {})
+                   .get("reservations", {}).get("devices", []))
+        return any("gpu" in (d.get("capabilities") or [])
+                   for d in devices if isinstance(d, dict))
+
+    declared = {n: _restart_of(s) for n, s in services.items()
+                if isinstance(s, dict) and _restart_of(s)}
+    if not declared:
+        return ""
+    gpu_declared = [p for n, p in declared.items()
+                    if isinstance(services.get(n), dict)
+                    and _has_gpu(services[n])]
+    if gpu_declared:
+        return gpu_declared[0]
+    if len(declared) == 1:
+        return next(iter(declared.values()))
+    log.info("compose file %s declares %d differing restart policies and "
+             "no GPU service; ignoring", path, len(declared))
+    return ""
+
 
 class Gpu2TpuTranslator(Translator):
     def get_translation_type(self) -> str:
@@ -122,7 +191,12 @@ class Gpu2TpuTranslator(Translator):
             ir.add_container(container)
             svc = irtypes.service_from_plan(plan_svc)
             svc.job = True  # run-to-completion training workload
-            svc.restart_policy = "Never"
+            # a compose file next to the training code states the author's
+            # restart intent; default Never when nothing is declared
+            src_dirs = plan_svc.source_artifacts.get(
+                PlanService.SOURCE_DIR_ARTIFACT, [])
+            declared = source_restart_policy(src_dirs[0]) if src_dirs else ""
+            svc.restart_policy = declared or "Never"
             svc.accelerator = plan_svc.accelerator
             image = container.image_names[0] if container.image_names else svc.name + ":latest"
             svc.containers.append({"name": svc.name, "image": image})

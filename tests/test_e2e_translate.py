@@ -289,6 +289,26 @@ def test_k8s_gpu_deployment_becomes_tpu_jobset(tmp_path):
     assert "cloud.google.com/gke-accelerator" not in sel  # GPU selector gone
     assert not any("nvidia" in (t.get("key") or "")
                    for t in tmpl.get("tolerations", []))
+    # preemption-aware resilience plumbing rides along end-to-end:
+    # JobSet failure policy (preemption restarts are free, crashes are
+    # budgeted), grace period sized to the checkpoint budget, preStop
+    # hook touching the watcher's sentinel
+    fp = js["spec"]["failurePolicy"]
+    assert fp["maxRestarts"] >= 1
+    assert any(r["action"] == "RestartJobSetAndIgnoreMaxRestarts"
+               and r["onJobFailureReasons"] == ["PodFailurePolicy"]
+               for r in fp["rules"])
+    job_spec = js["spec"]["replicatedJobs"][0]["template"]["spec"]
+    assert any(r["action"] == "FailJob"
+               and {"type": "DisruptionTarget", "status": "True"}
+               in r["onPodConditions"]
+               for r in job_spec["podFailurePolicy"]["rules"])
+    assert tmpl["terminationGracePeriodSeconds"] >= 60
+    prestop = c["lifecycle"]["preStop"]["exec"]["command"]
+    assert "m2kt-preempt" in " ".join(prestop)
+    env = {e["name"]: e.get("value") for e in c.get("env", [])}
+    assert env["M2KT_PREEMPT_GRACE_S"] == str(
+        tmpl["terminationGracePeriodSeconds"])  # YAML and trainer agree
 
 
 def test_ingress_downgrade_to_extensions_converts_schema():

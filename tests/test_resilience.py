@@ -1,0 +1,453 @@
+"""Resilience subsystem (move2kube_tpu/resilience): kill-at-step-N →
+resume-from-N under the supervisor, corrupt-checkpoint fallback, exit
+classification, preemption watcher, goodput accounting, and the JobSet
+failure-policy YAML. All CPU-only and deterministic."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from flax import linen as nn
+
+from move2kube_tpu.models import checkpoint as m2kt_ckpt
+from move2kube_tpu.models import train as m2kt_train
+from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+from move2kube_tpu.resilience import faults, goodput, preemption, supervisor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- the headline proof: kill at step N, supervisor restarts, resume ---------
+
+
+def test_kill_at_step_resumes_from_checkpoint(tmp_path):
+    """The full in-pod story in one subprocess: minitrain dies at step 5
+    (injected, exactly-once), the supervisor classifies it retryable and
+    restarts it, the second attempt resumes from the step-4 checkpoint —
+    not step 0 — and the merged goodput report carries the lost span."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        M2KT_STEPS="8",
+        M2KT_CKPT_DIR=str(tmp_path / "ckpt"),
+        M2KT_CKPT_EVERY="2",
+        M2KT_FAULT_STEP="5",
+        M2KT_FAULT_KIND="exit",
+        M2KT_FAULT_MARKER=str(tmp_path / "fault-fired"),
+        M2KT_RETRY_MAX="2",
+        M2KT_RETRY_BACKOFF_S="0.1",
+        M2KT_EXIT_FILE=str(tmp_path / "exit.json"),
+        M2KT_GOODPUT_FILE=str(tmp_path / "goodput.json"),
+    )
+    env.pop("M2KT_METRICS_DIR", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "move2kube_tpu.resilience.supervisor", "--",
+         sys.executable, "-m", "move2kube_tpu.resilience.minitrain"],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300)
+    assert res.returncode == 0, res.stderr
+    assert "FAULT: injected exit at step 5" in res.stdout
+    assert "resumed from step 4" in res.stdout  # N, not 0
+    assert "done steps=8" in res.stdout
+
+    summary = json.loads((tmp_path / "exit.json").read_text())
+    assert summary["exit_class"] == "ok"
+    assert [a["class"] for a in summary["attempts"]] == ["retryable", "ok"]
+    assert summary["attempts"][1]["report"]["resumed_from"] == 4
+    merged = summary["goodput"]
+    assert merged["last_saved_step"] == 8
+    # attempt 1's death tail (post-flush work) is attributed to lost
+    assert merged["seconds"]["lost"] > 0
+    assert merged["seconds"]["retry"] > 0
+    assert 0 < merged["goodput_fraction"] < 1
+
+
+def test_retry_exhaustion_reports_last_rc(tmp_path):
+    """Without a marker the fault fires every attempt; the supervisor must
+    give up after M2KT_RETRY_MAX retries and surface the child's rc."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        M2KT_STEPS="4",
+        M2KT_FAULT_STEP="2",
+        M2KT_FAULT_KIND="exit",
+        M2KT_FAULT_EXIT_CODE="7",
+        M2KT_RETRY_MAX="1",
+        M2KT_RETRY_BACKOFF_S="0.05",
+        M2KT_EXIT_FILE=str(tmp_path / "exit.json"),
+        M2KT_GOODPUT_FILE=str(tmp_path / "goodput.json"),
+    )
+    env.pop("M2KT_CKPT_DIR", None)
+    env.pop("M2KT_FAULT_MARKER", None)
+    env.pop("M2KT_METRICS_DIR", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "move2kube_tpu.resilience.supervisor", "--",
+         sys.executable, "-m", "move2kube_tpu.resilience.minitrain"],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300)
+    assert res.returncode == 7
+    summary = json.loads((tmp_path / "exit.json").read_text())
+    assert summary["exit_class"] == "retries_exhausted"
+    assert len(summary["attempts"]) == 2  # first try + one retry
+
+
+# -- corrupt-checkpoint fallback ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.relu(nn.Dense(8)(x)))
+
+    mesh = make_mesh(MeshConfig(data=jax.device_count()))
+    return m2kt_train.create_sharded_state(
+        jax.random.PRNGKey(0), Tiny(), {"x": jnp.zeros((8, 8))},
+        optax.sgd(1e-2), mesh)
+
+
+def _save_steps(ckpt_dir, state, steps=(2, 4)):
+    mngr = m2kt_ckpt.CheckpointManager(str(ckpt_dir), every=2)
+    for s in steps:
+        assert mngr.maybe_save(s, state)
+    mngr.close()
+
+
+@pytest.mark.parametrize("mode", ["truncate", "scribble", "remove"])
+def test_corrupt_latest_falls_back_to_previous_step(tmp_path, tiny_state, mode):
+    d = tmp_path / "ckpt"
+    _save_steps(d, tiny_state)
+    assert faults.corrupt_latest(str(d), mode=mode) == 4
+    mngr = m2kt_ckpt.CheckpointManager(str(d), every=2)
+    restored, start = mngr.restore_or_init(tiny_state)
+    assert start == 2  # previous retained step, not a crash, not 0
+    assert restored is not tiny_state
+    mngr.close()
+
+
+def test_all_corrupt_restarts_from_zero(tmp_path, tiny_state):
+    """Every retained step unreadable → loud error + fresh start, never a
+    crashloop that burns the JobSet's maxRestarts on a dead artifact."""
+    d = tmp_path / "ckpt"
+    _save_steps(d, tiny_state)
+    faults.corrupt_latest(str(d))           # step 4
+    for _step, sdir in faults.step_dirs(str(d)):
+        for dirpath, _dirs, names in os.walk(sdir):
+            if os.path.basename(dirpath) == "d":
+                for n in names:
+                    os.remove(os.path.join(dirpath, n))
+    mngr = m2kt_ckpt.CheckpointManager(str(d), every=2)
+    restored, start = mngr.restore_or_init(tiny_state)
+    assert start == 0
+    assert restored is tiny_state
+    mngr.close()
+
+
+def test_corrupt_latest_empty_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        faults.corrupt_latest(str(tmp_path))
+
+
+# -- fault injection ---------------------------------------------------------
+
+
+def test_fault_marker_fires_exactly_once(tmp_path, monkeypatch):
+    marker = tmp_path / "marker"
+    monkeypatch.setenv("M2KT_FAULT_STEP", "3")
+    monkeypatch.setenv("M2KT_FAULT_KIND", "raise")
+    monkeypatch.setenv("M2KT_FAULT_MARKER", str(marker))
+    faults.maybe_inject(2)  # off-step: no-op
+    with pytest.raises(faults.FaultInjected):
+        faults.maybe_inject(3)
+    assert marker.exists()
+    faults.maybe_inject(3)  # second hit: marker claims it, no fault
+
+
+def test_fault_unconfigured_is_inert(monkeypatch):
+    monkeypatch.delenv("M2KT_FAULT_STEP", raising=False)
+    faults.maybe_inject(1)
+    monkeypatch.setenv("M2KT_FAULT_STEP", "banana")
+    faults.maybe_inject(1)  # malformed knob must not kill a real run
+
+
+# -- exit classification -----------------------------------------------------
+
+
+@pytest.mark.parametrize("rc,tail,expected", [
+    (0, "", supervisor.OK),
+    (-signal.SIGTERM, "", supervisor.PREEMPTED),
+    (143, "", supervisor.PREEMPTED),
+    (-signal.SIGKILL, "", supervisor.RETRYABLE),
+    (137, "", supervisor.RETRYABLE),
+    (1, "ImportError: No module named flax", supervisor.FATAL),
+    (1, "ValueError: global batch 7 not divisible by 8", supervisor.FATAL),
+    (1, "DEADLINE_EXCEEDED: barrier timed out", supervisor.RETRYABLE),
+    (1, "FaultInjected: injected transient fault", supervisor.RETRYABLE),
+    (1, "something unprecedented", supervisor.RETRYABLE),
+])
+def test_classification_table(rc, tail, expected):
+    assert supervisor.classify(rc, tail) == expected
+
+
+# -- preemption watcher ------------------------------------------------------
+
+
+def test_watcher_sigterm_triggers_stop(tmp_path):
+    w = preemption.PreemptionWatcher(
+        grace_seconds=30.0, sentinel=str(tmp_path / "nope"))
+    w.install()
+    try:
+        assert not w.requested()
+        assert not w.should_stop(1)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert w.requested()
+        assert w.should_stop(2)  # single-process: no cadence wait
+        left = w.time_left()
+        assert left is not None and 0 < left <= 30.0
+    finally:
+        w.uninstall()
+
+
+def test_watcher_sentinel_file(tmp_path):
+    sentinel = tmp_path / "m2kt-preempt"
+    w = preemption.PreemptionWatcher(sentinel=str(sentinel))
+    assert not w.requested()
+    sentinel.touch()  # what the emitted preStop hook does
+    assert w.requested()
+    assert w.should_stop(7)
+
+
+def test_watcher_from_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("M2KT_PREEMPT", "0")
+    assert preemption.from_env() is None
+    monkeypatch.setenv("M2KT_PREEMPT", "1")
+    monkeypatch.setenv("M2KT_PREEMPT_GRACE_S", "77")
+    monkeypatch.setenv("M2KT_PREEMPT_FILE", str(tmp_path / "s"))
+    monkeypatch.setenv("M2KT_PREEMPT_SYNC_EVERY", "5")
+    w = preemption.from_env()
+    assert w is not None
+    assert w.grace_seconds == 77.0
+    assert w.sync_every == 5
+
+
+def test_grace_period_derivation(monkeypatch):
+    monkeypatch.delenv("M2KT_GRACE_PERIOD_S", raising=False)
+    monkeypatch.delenv("M2KT_CKPT_BUDGET_S", raising=False)
+    assert preemption.grace_period_seconds() == 300  # 240 budget + 60 margin
+    monkeypatch.setenv("M2KT_CKPT_BUDGET_S", "100")
+    assert preemption.grace_period_seconds() == 160
+    monkeypatch.setenv("M2KT_GRACE_PERIOD_S", "42")  # explicit wins verbatim
+    assert preemption.grace_period_seconds() == 42
+
+
+# -- goodput accounting ------------------------------------------------------
+
+
+def test_goodput_tracker_roundtrip(tmp_path):
+    gp = goodput.GoodputTracker()
+    gp.note_resume(4)  # restore happens before any stepping
+    with gp.phase("restore"):
+        pass
+    gp.add("compile", 1.0, steps=1)
+    gp.add("productive", 3.0, steps=6)
+    gp.note_saved(10)
+    gp.note_saved(8)  # monotonic max
+    path = gp.write(str(tmp_path / "gp.json"))
+    rep = goodput.read_report(path)
+    assert rep["last_saved_step"] == 10
+    assert rep["resumed_from"] == 4
+    assert rep["steps_done"] == 4 + 6 + 1
+    # accounted time (4s) >> wall here, so the denominator is accounted
+    assert rep["goodput_fraction"] == pytest.approx(3.0 / 4.0, abs=0.01)
+    assert goodput.read_report(str(tmp_path / "absent.json")) is None
+
+
+def test_goodput_merge_charges_lost_to_failed_attempts():
+    flushed = {"seconds": {"productive": 2.0, "compile": 1.0},
+               "steps_done": 4, "last_saved_step": 4}
+    attempts = [
+        # died 5s in; flushed report only accounts for 3s → 2s lost
+        {"report": flushed, "wall_seconds": 5.0, "ok": False},
+        # clean finish: nothing lost
+        {"report": {"seconds": {"productive": 3.0}, "steps_done": 8,
+                    "last_saved_step": 8}, "wall_seconds": 4.0, "ok": True},
+        # died before its first flush: the whole attempt is lost
+        {"report": None, "wall_seconds": 1.0, "ok": False},
+    ]
+    merged = goodput.merge_attempts(attempts)
+    assert merged["seconds"]["lost"] == pytest.approx(3.0)
+    assert merged["seconds"]["productive"] == pytest.approx(5.0)
+    assert merged["steps_done"] == 8
+    assert merged["last_saved_step"] == 8
+    assert merged["wall_seconds"] == pytest.approx(10.0)
+    assert merged["goodput_fraction"] == pytest.approx(0.5)
+
+
+def test_goodput_report_path_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("M2KT_GOODPUT_FILE", "/x/y.json")
+    assert goodput.report_path() == "/x/y.json"
+    monkeypatch.delenv("M2KT_GOODPUT_FILE")
+    monkeypatch.setenv("M2KT_METRICS_DIR", str(tmp_path))
+    assert goodput.report_path() == str(tmp_path / "m2kt-goodput.json")
+
+
+# -- JobSet failure-policy emission ------------------------------------------
+
+
+def _train_service(name="trainer", restart_policy=""):
+    from move2kube_tpu.types.ir import Service
+    from move2kube_tpu.types.plan import AcceleratorInfo
+
+    svc = Service(name=name)
+    svc.containers = [{"name": "t", "image": "x"}]
+    svc.accelerator = AcceleratorInfo(
+        gpu_count=8, tpu_accelerator="tpu-v5-lite-podslice",
+        tpu_topology="2x4", num_hosts=2)
+    svc.job = True
+    if restart_policy:
+        svc.restart_policy = restart_policy
+    return svc
+
+
+def test_jobset_carries_failure_policy_grace_and_prestop(monkeypatch):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    for var in ("M2KT_MAX_RESTARTS", "M2KT_BACKOFF_LIMIT",
+                "M2KT_GRACE_PERIOD_S", "M2KT_CKPT_BUDGET_S"):
+        monkeypatch.delenv(var, raising=False)
+    obj = DeploymentAPIResource()._create_workload(_train_service(), {"JobSet"})
+    fp = obj["spec"]["failurePolicy"]
+    assert fp["maxRestarts"] == 3
+    [rule] = fp["rules"]
+    assert rule["action"] == "RestartJobSetAndIgnoreMaxRestarts"
+    assert rule["onJobFailureReasons"] == ["PodFailurePolicy"]
+
+    job_spec = obj["spec"]["replicatedJobs"][0]["template"]["spec"]
+    # preemption fails the job fast via the DisruptionTarget condition...
+    [pod_rule] = job_spec["podFailurePolicy"]["rules"]
+    assert pod_rule["action"] == "FailJob"
+    assert pod_rule["onPodConditions"] == [
+        {"type": "DisruptionTarget", "status": "True"}]
+
+    pod = job_spec["template"]["spec"]
+    assert pod["restartPolicy"] == "Never"  # podFailurePolicy requires it
+    # grace sized to the checkpoint budget, same number the env mirrors
+    assert pod["terminationGracePeriodSeconds"] == 300
+    c = pod["containers"][0]
+    prestop = c["lifecycle"]["preStop"]["exec"]["command"]
+    assert preemption.DEFAULT_SENTINEL in " ".join(prestop)
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["M2KT_PREEMPT_GRACE_S"] == "300"
+    assert env["M2KT_PREEMPT_FILE"] == preemption.DEFAULT_SENTINEL
+
+
+def test_jobset_honors_source_declared_onfailure(monkeypatch):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    monkeypatch.delenv("M2KT_MAX_RESTARTS", raising=False)
+    svc = _train_service(restart_policy="OnFailure")
+    obj = DeploymentAPIResource()._create_workload(svc, {"JobSet"})
+    job_spec = obj["spec"]["replicatedJobs"][0]["template"]["spec"]
+    assert job_spec["template"]["spec"]["restartPolicy"] == "OnFailure"
+    # podFailurePolicy is only legal with restartPolicy Never
+    assert "podFailurePolicy" not in job_spec
+
+
+def test_retry_budgets_env_overrides(monkeypatch):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    monkeypatch.setenv("M2KT_MAX_RESTARTS", "7")
+    obj = DeploymentAPIResource()._create_workload(_train_service(), {"JobSet"})
+    assert obj["spec"]["failurePolicy"]["maxRestarts"] == 7
+
+    # cluster without JobSet → indexed Job; backoffLimit knob drives it
+    monkeypatch.setenv("M2KT_BACKOFF_LIMIT", "9")
+    obj = DeploymentAPIResource()._create_workload(_train_service(), {"Job"})
+    assert obj["kind"] == "Job"
+    assert obj["spec"]["backoffLimit"] == 9
+    pod = obj["spec"]["template"]["spec"]
+    assert pod["terminationGracePeriodSeconds"] == 300  # TPU job: same hooks
+
+    monkeypatch.setenv("M2KT_BACKOFF_LIMIT", "not-a-number")
+    obj = DeploymentAPIResource()._create_workload(_train_service(), {"Job"})
+    assert obj["spec"]["backoffLimit"] == 4  # bad override → builtin default
+
+
+# -- compose restart-policy passthrough --------------------------------------
+
+
+def test_source_restart_policy_from_compose(tmp_path):
+    from move2kube_tpu.source.gpu2tpu import source_restart_policy
+
+    (tmp_path / "docker-compose.yaml").write_text(
+        "services:\n  train:\n    image: x\n    restart: on-failure:3\n")
+    assert source_restart_policy(str(tmp_path)) == "OnFailure"
+
+    (tmp_path / "docker-compose.yaml").write_text(
+        'services:\n  train:\n    image: x\n    restart: "no"\n')
+    assert source_restart_policy(str(tmp_path)) == "Never"
+
+    # always has no Job equivalent → OnFailure (logged)
+    (tmp_path / "docker-compose.yaml").write_text(
+        "services:\n  train:\n    image: x\n    restart: always\n")
+    assert source_restart_policy(str(tmp_path)) == "OnFailure"
+
+    # several services disagree, none GPU → ambiguous, ignored
+    (tmp_path / "docker-compose.yaml").write_text(
+        "services:\n"
+        "  a:\n    image: x\n    restart: always\n"
+        '  b:\n    image: y\n    restart: "no"\n')
+    assert source_restart_policy(str(tmp_path)) == ""
+
+    # the GPU-reserving service's declaration wins
+    (tmp_path / "docker-compose.yaml").write_text(
+        "services:\n"
+        '  web:\n    image: x\n    restart: "no"\n'
+        "  train:\n"
+        "    image: y\n    restart: on-failure\n"
+        "    deploy:\n      resources:\n        reservations:\n"
+        "          devices:\n            - capabilities: [gpu]\n")
+    assert source_restart_policy(str(tmp_path)) == "OnFailure"
+
+
+def test_source_restart_policy_absent_or_broken(tmp_path):
+    from move2kube_tpu.source.gpu2tpu import source_restart_policy
+
+    assert source_restart_policy(str(tmp_path)) == ""  # no compose file
+    (tmp_path / "compose.yaml").write_text(": {{ not yaml")
+    assert source_restart_policy(str(tmp_path)) == ""
+
+
+# -- loader context-manager protocol -----------------------------------------
+
+
+def test_every_loader_variant_is_a_context_manager(tmp_path):
+    from move2kube_tpu.models import data as m2kt_data
+
+    mesh = make_mesh(MeshConfig(data=jax.device_count()))
+    with m2kt_data.make_loader(
+            "", 8, mesh, synthetic_fn=lambda i: {"x": jnp.zeros((8, 2))}
+    ) as loader:
+        batch = next(iter(loader))
+        assert batch["x"].shape == (8, 2)
+
+    import numpy as np
+    np.savez(tmp_path / "d.npz", x=np.zeros((32, 2), np.float32))
+    with m2kt_data.make_loader(str(tmp_path / "d.npz"), 8, mesh) as loader:
+        batch = next(iter(loader))
+        assert batch["x"].shape == (8, 2)
+    # the pump thread is down: iterating a closed prefetch loader raises
+    with pytest.raises((StopIteration, RuntimeError)):
+        for _ in range(10):
+            next(loader)
