@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -65,6 +65,14 @@ e2e:
 # aliasing, device-prefetch overlap, flash block-autotune caching
 perf-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_donation.py tests/test_autotune.py tests/test_data.py -q -m "not slow"
+
+# topology-aware multichip stack in isolation (8 forced host devices):
+# ICI mesh planner goldens, overlapped gradient accumulation vs the
+# sequential reference, interleaved-1F1B vs GPipe equivalence, and the
+# bench scaling phase (one-line-JSON RESULT discipline like fault-smoke)
+multichip-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_topology.py -q
+	$(CPU_ENV) $(PY) bench.py --model scaling
 
 # resilience subsystem in isolation (all CPU-mode, deterministic faults):
 # kill-at-step-N -> resume-from-N under the supervisor, corrupt-checkpoint

@@ -77,6 +77,13 @@ def _tpu_resources(svc: Service, workload_kind: str = JOB_SET) -> None:
             ("M2KT_NUM_HOSTS", str(acc.num_hosts)),
             ("M2KT_COORDINATOR", coordinator if multihost else ""),
             ("M2KT_CKPT_DIR", ckpt_dir),
+            # physical topology for the trainer's ICI mesh planner
+            # (parallel/topology.py): same strings as the node selectors
+            # below, so the mesh the planner lays out matches the slice
+            # the scheduler actually places the pods on
+            ("M2KT_TPU_TOPOLOGY", acc.tpu_topology or "1x1"),
+            ("M2KT_TPU_ACCELERATOR",
+             acc.tpu_accelerator or "tpu-v5-lite-podslice"),
             # preemption watcher budget mirrors the pod's grace period
             # (same derivation — the YAML and the trainer can't drift)
             ("M2KT_PREEMPT_GRACE_S", str(preemption.grace_period_seconds())),

@@ -170,6 +170,42 @@ def _ask_tpu_slice(name: str, acc: AcceleratorInfo, plan=None) -> None:
     acc.gpu_count = acc.num_slices * chips
 
 
+def _ask_training_knobs(name: str, family: str) -> tuple[str, int]:
+    """Precision and gradient-accumulation are QA problems with cached
+    defaults, same engine as the slice choice. The IDs are shared with
+    ``passes/optimize.py``'s tpu_training_optimizer — one logical knob,
+    asked once, cached answer reused by both the emitted trainer template
+    and the JobSet env injection."""
+    from move2kube_tpu import qa
+    from move2kube_tpu.models.precision import PRECISION_OPTIONS
+
+    default_precision = "bf16" if family in ("llama", "gpt", "gpt2",
+                                             "bert") else "fp32"
+    precision = qa.fetch_select(
+        f"m2kt.services.{name}.tpu.precision",
+        f"Select the training precision policy for [{name}]",
+        ["bf16 compute + fp32 master weights; bf16-scaled adds loss "
+         "scaling; fp32 for conv nets / numerics debugging"],
+        default_precision, list(PRECISION_OPTIONS))
+    if precision not in PRECISION_OPTIONS:
+        log.warning("unknown precision answer %r for %s; keeping %s",
+                    precision, name, default_precision)
+        precision = default_precision
+    raw = qa.fetch_input(
+        f"m2kt.services.{name}.tpu.gradaccum",
+        f"Enter gradient accumulation microbatches for [{name}]",
+        ["1 disables accumulation; k>1 folds k microbatches into one "
+         "optimizer update (overlapped ring reduction on pure-DP meshes)"],
+        "1")
+    try:
+        grad_accum = max(1, int(raw))
+    except (TypeError, ValueError):
+        log.warning("invalid grad-accum answer %r for %s; using 1",
+                    raw, name)
+        grad_accum = 1
+    return precision, grad_accum
+
+
 def emit_container(service: PlanService, plan=None) -> Container:
     acc = service.accelerator or AcceleratorInfo()
     family = (service.containerization_target_options[0]
@@ -210,14 +246,19 @@ def emit_container(service: PlanService, plan=None) -> Container:
     # sharding annotations as llama.py, so detected tp/sp map straight
     # onto the tensor/seq mesh axes.)
     fold_tp_sp = use_pipe
-    mesh = infer_mesh_config(
-        max(1, acc.gpu_count),
-        zero_stage=zero if use_pipe else max(zero, 2 if pp > 1 else 0),
-        tensor_parallel=1 if fold_tp_sp else acc.parallelism.get("tp", 1),
-        seq_parallel=1 if fold_tp_sp else acc.parallelism.get("sp", 1),
-        pipeline_parallel=pp if use_pipe else 1,
-        expert_parallel=acc.parallelism.get("ep", 1) if moe_experts else 1,
-    )
+    # the emitted trainer re-derives the mesh AT RUNTIME from the actual
+    # device count + M2KT_TPU_TOPOLOGY (parallel/topology.py planner), so
+    # the same parallelism degrees are both resolved here (for logging /
+    # plan inspection) and baked into the template as planner arguments
+    degrees = {
+        "zero_stage": zero if use_pipe else max(zero, 2 if pp > 1 else 0),
+        "tensor_parallel": 1 if fold_tp_sp else acc.parallelism.get("tp", 1),
+        "seq_parallel": 1 if fold_tp_sp else acc.parallelism.get("sp", 1),
+        "pipeline_parallel": pp if use_pipe else 1,
+        "expert_parallel": acc.parallelism.get("ep", 1) if moe_experts else 1,
+    }
+    mesh = infer_mesh_config(max(1, acc.gpu_count), **degrees)
+    precision, grad_accum = _ask_training_knobs(name, family)
 
     image_name = service.image or f"{name}:latest"
     # HF GPT-2 fine-tunes (family gpt) emit the true GPT-2 architecture
@@ -265,6 +306,13 @@ def emit_container(service: PlanService, plan=None) -> Container:
             "tpu_topology": acc.tpu_topology or "1x1",
             "num_hosts": acc.num_hosts,
             "mesh": mesh,
+            "zero_stage": degrees["zero_stage"],
+            "tensor_parallel": degrees["tensor_parallel"],
+            "seq_parallel": degrees["seq_parallel"],
+            "pipeline_parallel": degrees["pipeline_parallel"],
+            "expert_parallel": degrees["expert_parallel"],
+            "precision": precision,
+            "grad_accum": grad_accum,
             "moe_experts": moe_experts,
             # in-image default; pods that mount a durable volume point
             # M2KT_COMPILE_CACHE_DIR at it to survive restarts

@@ -15,27 +15,63 @@ Knobs:
 - ``M2KT_COMPILE_CACHE_DIR``    cache directory (wins over the caller's
   default — emitted images bake in ``/app/.jax-cache`` but operators can
   redirect to a mounted volume without editing the program)
+
+Executables compiled for different meshes are NOT interchangeable: the
+same train step lowered on a 1x8 fsdp mesh and a 4x2 dp x tp mesh are
+different programs, and a cache dir mounted across heterogeneous slices
+(or across a topology change of the same JobSet) must not mix them.
+``setup_compilation_cache(..., mesh=mesh)`` partitions the directory by
+a :func:`topology_fingerprint` — device kind, device count, mesh dims
+and axis names — so every (hardware, mesh) pair gets its own namespace.
 """
 
 from __future__ import annotations
 
 import os
+import re
 
 _DEFAULT_DIR = os.path.join("~", ".cache", "m2kt-jax-cache")
 
 
-def setup_compilation_cache(default_dir: str | None = None) -> str | None:
+def topology_fingerprint(mesh) -> str:
+    """Filesystem-safe cache-key component for a concrete mesh:
+    ``<device_kind>-n<ndev>-<dim x dim x ...>-<axisinitials>``. Empty
+    string for None or device-less (abstract) meshes — those callers get
+    the unpartitioned directory."""
+    if mesh is None:
+        return ""
+    try:
+        devs = mesh.devices.ravel()
+        kind = str(devs[0].device_kind)
+        dims = "x".join(str(s) for s in mesh.devices.shape)
+        axes = "".join(str(a)[0] for a in mesh.axis_names)
+        n = devs.size
+    except Exception:  # noqa: BLE001 - AbstractMesh etc: no fingerprint
+        return ""
+    kind = re.sub(r"[^A-Za-z0-9_.-]+", "_", kind)
+    return f"{kind}-n{n}-{dims}-{axes}"
+
+
+def setup_compilation_cache(default_dir: str | None = None,
+                            mesh=None) -> str | None:
     """Enable jax's persistent compilation cache; returns the directory
     in use, or None when disabled or unsupported.
 
     ``default_dir`` is the *caller's* default; the operator env var
     ``M2KT_COMPILE_CACHE_DIR`` takes precedence, and the user cache dir
-    is the last resort. Safe to call more than once."""
+    is the last resort. With ``mesh`` given, executables land in a
+    per-(device kind, mesh shape, axis names) subdirectory — see
+    :func:`topology_fingerprint`. Safe to call more than once: emitted
+    trainers call it early (warmup compiles cached too) and again with
+    ``mesh=`` once the planner has built one."""
     if os.environ.get("M2KT_COMPILE_CACHE", "1") == "0":
         return None
     path = (os.environ.get("M2KT_COMPILE_CACHE_DIR") or default_dir
             or _DEFAULT_DIR)
     path = os.path.abspath(os.path.expanduser(path))
+    fp = topology_fingerprint(mesh)
+    if fp:
+        path = os.path.join(path, fp)
     try:
         os.makedirs(path, exist_ok=True)
     except OSError:

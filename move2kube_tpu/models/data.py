@@ -27,6 +27,7 @@ import queue
 import threading
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
@@ -286,6 +287,42 @@ class PrefetchLoader:
             self._terminated = True
             raise self._dead if self._dead is not None else StopIteration
         return item
+
+
+class AccumLoader:
+    """Group ``k`` consecutive microbatches from an inner loader into one
+    stacked batch with a leading ``[k, ...]`` axis — the shape the
+    ``grad_accum=k`` train steps consume (one optimizer update per
+    ``next()``). ``skip`` counts in optimizer steps, so a resumed run
+    fast-forwards ``n * k`` microbatches."""
+
+    def __init__(self, inner, k: int):
+        if k < 1:
+            raise ValueError(f"accumulation factor must be >= 1, got {k}")
+        self._inner = inner
+        self._k = k
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        micro = [next(self._inner) for _ in range(self._k)]
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *micro)
+
+    def skip(self, n: int) -> None:
+        self._inner.skip(n * self._k)
+
+    def close(self) -> None:
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 def make_loader(path: str, global_batch: int, mesh: Mesh,

@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 
 # activation sharding constraint, pruned to the active mesh (shared with
 # moe.py; a no-op when no mesh context is set, so single-chip runs work)
+from move2kube_tpu.parallel.compat import get_abstract_mesh, shard_map
 from move2kube_tpu.parallel.sharding import maybe_shard as _maybe_shard
 
 
@@ -97,7 +98,7 @@ class RMSNorm(nn.Module):
 
 def _seq_axis_size() -> int:
     """Size of the ambient mesh's ``seq`` axis (1 when no mesh is set)."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = get_abstract_mesh()
     if getattr(mesh, "empty", True) or "seq" not in mesh.axis_names:
         return 1
     return mesh.shape["seq"]
@@ -120,9 +121,9 @@ def _attention(q, k, v, mask, impl: str):
 
         fn = ring_attention if impl == "ring" else ulysses_attention
         spec = P(("data", "fsdp"), "seq", "tensor", None)
-        run = jax.shard_map(
+        run = shard_map(
             functools.partial(fn, axis_name="seq", causal=True),
-            in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+            in_specs=(spec, spec, spec), out_specs=spec,
         )
         return run(q, k, v)
     if impl in ("flash", "ring", "ulysses"):

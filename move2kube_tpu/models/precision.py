@@ -1,0 +1,121 @@
+"""Mixed-precision policy: bf16 compute, fp32 master weights.
+
+TPU MXUs run bf16 matmuls at full rate and fp32 at a fraction of it, so
+the zoo's compute dtype is the single biggest MFU knob after sharding.
+The policy split is standard: parameters and optimizer state live in
+float32 (flax's default param dtype — the "master weights"), activations
+and matmuls run in the policy's compute dtype (modules cast at use via
+their ``dtype`` config field), and gradients are computed/accumulated in
+fp32.  bf16 shares fp32's exponent range so it needs no loss scaling;
+the optional ``bf16-scaled`` policy multiplies the loss by a constant
+scale and divides it back out of the gradients — the hook a future fp16
+or fp8 recipe needs, wired through ``apply_if_finite`` so a rare
+non-finite scaled step is skipped instead of poisoning the weights.
+
+Resolved once at trainer startup from ``M2KT_PRECISION`` (emitted
+default comes from the QA answer recorded at translate time) with
+``M2KT_LOSS_SCALE`` as a numeric override.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+PRECISION_OPTIONS = ("bf16", "fp32", "bf16-scaled")
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    name: str = "bf16"
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"  # master weights + optimizer state
+    loss_scale: float = 0.0  # 0 = off (bf16 needs none)
+
+    @property
+    def jnp_compute_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.dtype(self.compute_dtype)
+
+    def cast_params(self, params):
+        """Compute-dtype view of the fp32 master weights (identity for
+        fp32 policies); non-float leaves pass through untouched."""
+        import jax
+        import jax.numpy as jnp
+
+        target = self.jnp_compute_dtype
+        if target == jnp.float32:
+            return params
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(target)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            params,
+        )
+
+    def scale_loss(self, loss):
+        return loss * self.loss_scale if self.loss_scale else loss
+
+    def unscale(self, tree):
+        """Undo :meth:`scale_loss` on a loss or gradient tree."""
+        if not self.loss_scale:
+            return tree
+        import jax
+
+        inv = 1.0 / self.loss_scale
+        return jax.tree_util.tree_map(lambda x: x * inv, tree)
+
+    def wrap_optimizer(self, tx):
+        """Skip (not crash on) non-finite updates when loss scaling is
+        active — overflowed scaled grads are expected occasionally."""
+        if not self.loss_scale:
+            return tx
+        import optax
+
+        return optax.apply_if_finite(tx, max_consecutive_errors=10)
+
+    def apply_to_model_config(self, cfg):
+        """Return ``cfg`` with its ``dtype`` field set to the compute
+        dtype (LlamaConfig / GPT2Config style); configs without a dtype
+        field pass through."""
+        if not dataclasses.is_dataclass(cfg) or "dtype" not in {
+            f.name for f in dataclasses.fields(cfg)
+        }:
+            return cfg
+        return dataclasses.replace(cfg, dtype=self.jnp_compute_dtype)
+
+
+_POLICIES = {
+    "bf16": PrecisionPolicy(),
+    "fp32": PrecisionPolicy(name="fp32", compute_dtype="float32"),
+    "bf16-scaled": PrecisionPolicy(name="bf16-scaled", loss_scale=1024.0),
+}
+
+
+def policy(name: str) -> PrecisionPolicy:
+    try:
+        return _POLICIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision policy {name!r}; options: {', '.join(PRECISION_OPTIONS)}"
+        ) from None
+
+
+def from_env(default: str = "bf16", env=None) -> PrecisionPolicy:
+    """``M2KT_PRECISION`` names the policy; ``M2KT_LOSS_SCALE`` (float)
+    overrides its loss scale. Unknown names fall back to ``default``
+    rather than killing a training job over an env typo."""
+    env = os.environ if env is None else env
+    name = env.get("M2KT_PRECISION", "") or default
+    try:
+        pol = policy(name)
+    except ValueError:
+        pol = policy(default)
+    raw_scale = env.get("M2KT_LOSS_SCALE", "")
+    if raw_scale:
+        try:
+            pol = dataclasses.replace(pol, loss_scale=float(raw_scale))
+        except ValueError:
+            pass
+    return pol

@@ -17,6 +17,8 @@ import optax
 from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from move2kube_tpu.parallel.compat import ambient_mesh, bare_spec_constraints_ok
+from move2kube_tpu.parallel.overlap import is_pure_data_parallel, overlapped_accum_grads
 from move2kube_tpu.parallel.sharding import ShardingRules, infer_param_axes
 
 
@@ -27,13 +29,11 @@ class TrainState(train_state.TrainState):
 def _mesh_context(mesh: Mesh):
     """Context that makes bare PartitionSpecs resolvable inside traced code
     (models annotate activations with P(...) without threading the mesh).
-    AbstractMesh gets its own context manager: the shape-verification
-    path (tests/test_memory_plan.py) traces train steps on device-less
-    meshes and ``use_mesh``/``set_mesh`` only accept concrete meshes."""
-    if isinstance(mesh, jax.sharding.AbstractMesh):
-        return jax.sharding.use_abstract_mesh(mesh)
-    use_mesh = getattr(jax.sharding, "use_mesh", None) or getattr(jax, "set_mesh", None)
-    return use_mesh(mesh) if use_mesh is not None else mesh
+    AbstractMesh works too: the shape-verification path
+    (tests/test_memory_plan.py) traces train steps on device-less meshes.
+    Version dispatch (use_mesh vs the legacy resource env + abstract-mesh
+    pair) lives in ``parallel/compat.ambient_mesh``."""
+    return ambient_mesh(mesh)
 
 
 def _with_mesh(mesh: Mesh, fn: Callable) -> Callable:
@@ -109,12 +109,24 @@ def lm_loss(logits, input_ids) -> jax.Array:
     return cross_entropy_loss(logits[:, :-1], input_ids[:, 1:])
 
 
+def data_axes(mesh) -> tuple[str, ...]:
+    """Every data-like mesh axis, in mesh order. The batch dim must shard
+    over dp x fsdp together — a planner-produced mesh may put all devices
+    on ``fsdp`` (ZeRO) or split them dp x fsdp from the memory model, and
+    sharding over only one of the two would replicate the batch across
+    the other, silently multiplying per-device batch work."""
+    names = getattr(mesh, "axis_names", ())
+    axes = tuple(a for a in ("data", "fsdp") if a in names)
+    return axes or ("data", "fsdp")
+
+
 def batch_sharding(mesh: Mesh):
-    """Input-batch sharding; SingleDeviceSharding on trivial meshes so
-    committed batches never trigger the SPMD pipeline (see _trivial)."""
+    """Input-batch sharding over ALL data-like axes (dp x fsdp);
+    SingleDeviceSharding on trivial meshes so committed batches never
+    trigger the SPMD pipeline (see _trivial)."""
     if _trivial(mesh):
         return jax.sharding.SingleDeviceSharding(mesh.devices.flat[0])
-    return _sharding(mesh, P(("data", "fsdp")))
+    return _sharding(mesh, P(data_axes(mesh)))
 
 
 def _sharding(mesh, spec: P):
@@ -143,8 +155,13 @@ def _trivial(mesh) -> bool:
 
 
 def _constrain(x, mesh: Mesh, spec: P):
-    """with_sharding_constraint, skipped on trivial meshes."""
+    """with_sharding_constraint, skipped on trivial meshes (and on legacy
+    jax under an abstract-only mesh, where bare specs can't resolve —
+    shape-inert on that eval_shape verification path)."""
     if _trivial(mesh):
+        return x
+    if (isinstance(mesh, jax.sharding.AbstractMesh)
+            and not bare_spec_constraints_ok()):
         return x
     return jax.lax.with_sharding_constraint(x, _sharding(mesh, spec))
 
@@ -229,7 +246,8 @@ def _make_state(model, variables, tx) -> TrainState:
 
 
 def make_classifier_train_step(mesh: Mesh, has_batch_stats: bool = False,
-                               scan_steps: int | None = None):
+                               scan_steps: int | None = None,
+                               grad_accum: int = 1):
     """Train step for image/sequence classifiers (ResNet, BERT).
 
     With ``scan_steps=k`` the returned function consumes a batch whose
@@ -238,27 +256,57 @@ def make_classifier_train_step(mesh: Mesh, has_batch_stats: bool = False,
     dispatch per k steps matters when the host-device link is
     high-latency (remote TPU tunnels) and lets emitted programs prefetch
     k host batches per device call.
-    """
 
-    def one_step(state: TrainState, batch: dict):
-        x = _constrain(batch["input"], mesh, P(("data", "fsdp")))
+    ``grad_accum=k`` instead folds k stacked microbatches into ONE
+    optimizer update (sequential scan accumulation; BatchNorm stats are
+    threaded through the microbatches so the final stats reflect all k).
+    Mutually exclusive with ``scan_steps``.
+    """
+    if scan_steps is not None and grad_accum > 1:
+        raise ValueError("scan_steps and grad_accum are mutually exclusive")
+
+    def grads_of(state: TrainState, batch: dict, stats):
+        x = _constrain(batch["input"], mesh, P(data_axes(mesh)))
         y = batch["label"]
 
         def loss_fn(params):
             variables = {"params": params}
             if has_batch_stats:
-                variables["batch_stats"] = state.batch_stats
+                variables["batch_stats"] = stats
                 logits, updates = state.apply_fn(
                     variables, x, mutable=["batch_stats"])
                 return cross_entropy_loss(logits, y), updates["batch_stats"]
             logits = state.apply_fn(variables, x)
             return cross_entropy_loss(logits, y), None
 
-        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        return jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+
+    def one_step(state: TrainState, batch: dict):
+        (loss, new_stats), grads = grads_of(state, batch, state.batch_stats)
         state = state.apply_gradients(grads=grads)
         if has_batch_stats:
             state = state.replace(batch_stats=new_stats)
         return state, loss
+
+    if grad_accum > 1:
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step_accum(state: TrainState, batches: dict):
+            def micro(carry, batch):
+                acc, stats = carry
+                (loss, new_stats), g = grads_of(state, batch, stats)
+                return (jax.tree.map(jnp.add, acc, g),
+                        new_stats if has_batch_stats else stats), loss
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (acc, stats), losses = jax.lax.scan(
+                micro, (zeros, state.batch_stats), batches, length=grad_accum)
+            grads = jax.tree.map(lambda g: g / grad_accum, acc)
+            state = state.apply_gradients(grads=grads)
+            if has_batch_stats:
+                state = state.replace(batch_stats=stats)
+            return state, jnp.mean(losses)
+
+        return _with_mesh(mesh, step_accum)
 
     if scan_steps is None:
         step = functools.partial(jax.jit, donate_argnums=(0,))(one_step)
@@ -342,41 +390,105 @@ def make_diffusion_train_step(mesh: Mesh, scan_steps: int | None = None,
 
 
 def make_lm_train_step(mesh: Mesh, remat: bool = True,
-                       moe_aux_weight: float = 0.01):
+                       moe_aux_weight: float = 0.01,
+                       grad_accum: int = 1,
+                       precision=None):
     """Next-token-prediction step for Llama-class models; rematerialises
     per-block activations (jax.checkpoint) to trade FLOPs for HBM.
 
     MoE models sow their load-balancing losses into the ``losses``
     collection (llama.py LlamaBlock); they are summed into the loss with
     weight ``moe_aux_weight`` (no-op for dense models: the collection is
-    empty)."""
+    empty).
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(state: TrainState, batch: dict):
-        ids = _constrain(batch["input_ids"], mesh, P(("data", "fsdp")))
+    ``grad_accum=k`` switches the step to consume ``k`` stacked
+    microbatches (``input_ids`` of shape [k, batch, seq]) per optimizer
+    update.  On a pure data-parallel mesh the per-microbatch gradient
+    reduction rides an explicit ppermute ring that overlaps the next
+    microbatch's backward (parallel/overlap.py); on meshes with
+    model-parallel axes it falls back to a sequential lax.scan
+    accumulation and lets GSPMD place the final reduce.
 
-        def loss_fn(params):
-            def fwd(p, x):
-                return state.apply_fn({"params": p}, x, mutable=["losses"])
+    ``precision`` (models/precision.py PrecisionPolicy) casts the fp32
+    master params to the compute dtype inside the loss and applies/undoes
+    optional loss scaling around the backward; gradients and the reported
+    loss come back unscaled fp32."""
 
-            if remat:
-                fwd = jax.checkpoint(fwd)
-            logits, sown = fwd(params, ids)
-            aux = sum((jnp.sum(v) for v in jax.tree.leaves(sown)),
-                      jnp.float32(0.0))
-            return lm_loss(logits, ids) + moe_aux_weight * aux
+    def _loss(apply_fn, params, ids):
+        if precision is not None:
+            params = precision.cast_params(params)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        def fwd(p, x):
+            return apply_fn({"params": p}, x, mutable=["losses"])
+
+        if remat:
+            fwd = jax.checkpoint(fwd)
+        logits, sown = fwd(params, ids)
+        aux = sum((jnp.sum(v) for v in jax.tree.leaves(sown)),
+                  jnp.float32(0.0))
+        loss = lm_loss(logits, ids) + moe_aux_weight * aux
+        if precision is not None:
+            loss = precision.scale_loss(loss)
+        return loss
+
+    def _finish(state: TrainState, grads, loss):
+        if precision is not None:
+            grads = precision.unscale(grads)
+            loss = precision.unscale(loss)
         return state.apply_gradients(grads=grads), loss
 
-    return _with_mesh(mesh, step)
+    if grad_accum <= 1:
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state: TrainState, batch: dict):
+            ids = _constrain(batch["input_ids"], mesh, P(data_axes(mesh)))
+            loss, grads = jax.value_and_grad(
+                lambda p: _loss(state.apply_fn, p, ids))(state.params)
+            return _finish(state, grads, loss)
+
+        return _with_mesh(mesh, step)
+
+    overlap = not _trivial(mesh) and is_pure_data_parallel(mesh)
+
+    if overlap:
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step_overlap(state: TrainState, batch: dict):
+            grads, loss = overlapped_accum_grads(
+                mesh,
+                lambda p, mb: _loss(state.apply_fn, p, mb["input_ids"]),
+                state.params, batch, axis_name="data")
+            return _finish(state, grads, loss)
+
+        return _with_mesh(mesh, step_overlap)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step_accum(state: TrainState, batch: dict):
+        def micro(acc, ids):
+            ids = _constrain(ids, mesh, P(data_axes(mesh)))
+            loss, g = jax.value_and_grad(
+                lambda p: _loss(state.apply_fn, p, ids))(state.params)
+            return jax.tree.map(jnp.add, acc, g), loss
+
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        acc, losses = jax.lax.scan(micro, zeros, batch["input_ids"])
+        k = batch["input_ids"].shape[0]
+        grads = jax.tree.map(lambda g: g / k, acc)
+        return _finish(state, grads, jnp.mean(losses))
+
+    return _with_mesh(mesh, step_accum)
 
 
 def default_optimizer(lr: float = 1e-3, weight_decay: float = 0.0,
                       warmup_steps: int = 100,
-                      total_steps: int = 10000) -> optax.GradientTransformation:
+                      total_steps: int = 10000,
+                      precision=None) -> optax.GradientTransformation:
+    """Warmup-cosine Adam(W). With a ``PrecisionPolicy`` the transform is
+    wrapped so non-finite grads (loss-scaling overflow under
+    ``bf16-scaled``) skip the update instead of poisoning the fp32
+    master weights."""
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, lr, warmup_steps, max(total_steps, warmup_steps + 1))
-    if weight_decay:
-        return optax.adamw(schedule, weight_decay=weight_decay)
-    return optax.adam(schedule)
+    tx = (optax.adamw(schedule, weight_decay=weight_decay)
+          if weight_decay else optax.adam(schedule))
+    if precision is not None:
+        tx = precision.wrap_optimizer(tx)
+    return tx

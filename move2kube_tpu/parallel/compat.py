@@ -1,0 +1,132 @@
+"""Version-tolerant shard_map + ambient-mesh entry points.
+
+The multichip kernels (pipeline, ring attention, ulysses, overlapped
+gradient reduction) and the sharding annotation helpers are written
+against the modern jax surface — ``jax.shard_map`` with
+``check_vma=False``, ``jax.sharding.get_abstract_mesh`` /
+``use_mesh`` — while older jaxlib builds ship the same machinery as
+``jax.experimental.shard_map`` (``check_rep=False``) plus the private
+``jax._src.mesh`` abstract-mesh context and the classic ``with mesh:``
+resource env.  Every caller in this package goes through these three
+functions so the version choice is made in exactly one place.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable
+
+
+def shard_map(f: Callable, *, mesh: Any = None, in_specs: Any, out_specs: Any) -> Callable:
+    """Map ``f`` over ``mesh`` (or the ambient mesh when None) with
+    per-argument specs, replication checking disabled (the kernels do
+    their own psum/ppermute accounting)."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kwargs = {} if mesh is None else {"mesh": mesh}
+        return sm(f, in_specs=in_specs, out_specs=out_specs, check_vma=False, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    if mesh is None:
+        mesh = _ambient_concrete_or_abstract_mesh()
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def axis_size(axis_name) -> "Any":
+    """Static size of a named mapped axis inside shard_map/pmap code.
+    Older jax lacks ``jax.lax.axis_size``; ``psum(1)`` of a unit constant
+    folds to the same static value at trace time."""
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def abstract_mesh(axis_sizes, axis_names):
+    """AbstractMesh across both constructor generations (new:
+    ``(sizes, names)``; old: one tuple of ``(name, size)`` pairs)."""
+    import jax
+
+    try:
+        return jax.sharding.AbstractMesh(tuple(axis_sizes), tuple(axis_names))
+    except TypeError:
+        return jax.sharding.AbstractMesh(tuple(zip(axis_names, axis_sizes)))
+
+
+def get_abstract_mesh():
+    """The ambient (abstract) mesh, or an object whose ``empty`` is
+    truthy when none is set — works on both API generations."""
+    import jax
+
+    fn = getattr(jax.sharding, "get_abstract_mesh", None)
+    if fn is not None:
+        return fn()
+    from jax._src.mesh import get_abstract_mesh as legacy
+
+    return legacy()
+
+
+def bare_spec_constraints_ok() -> bool:
+    """Can ``with_sharding_constraint`` take a bare PartitionSpec right
+    now?  New jax resolves it against the ambient (abstract) mesh; old
+    jax needs the concrete resource-env mesh — under an abstract-only
+    ambient mesh (the eval_shape verification path) the constraint must
+    be skipped, which is shape-inert there."""
+    import jax
+
+    if getattr(jax.sharding, "get_abstract_mesh", None) is not None:
+        return True
+    from jax._src.mesh import thread_resources
+
+    return not getattr(thread_resources.env.physical_mesh, "empty", True)
+
+
+def _ambient_concrete_or_abstract_mesh():
+    """Legacy-jax mesh lookup for :func:`shard_map` calls that rely on
+    the ambient mesh: prefer the concrete resource-env mesh (set by
+    ``with mesh:``), fall back to the abstract one."""
+    from jax._src.mesh import thread_resources
+
+    physical = thread_resources.env.physical_mesh
+    if not getattr(physical, "empty", True):
+        return physical
+    am = get_abstract_mesh()
+    if not getattr(am, "empty", True):
+        return am
+    raise ValueError("shard_map called with no mesh and no ambient mesh set")
+
+
+@contextlib.contextmanager
+def ambient_mesh(mesh):
+    """Make ``mesh`` ambient so bare PartitionSpecs resolve inside traced
+    code.  New jax: ``use_mesh`` / ``use_abstract_mesh``.  Old jax:
+    enter ``with mesh:`` (resource env, resolves bare-spec sharding
+    constraints) *and* set the abstract mesh (so
+    :func:`get_abstract_mesh`-based annotation helpers see the axes)."""
+    import jax
+
+    if isinstance(mesh, jax.sharding.AbstractMesh):
+        ctx = getattr(jax.sharding, "use_abstract_mesh", None)
+        if ctx is not None:
+            with ctx(mesh):
+                yield
+            return
+        from jax._src.mesh import set_abstract_mesh
+
+        with set_abstract_mesh(mesh):
+            yield
+        return
+
+    use = getattr(jax.sharding, "use_mesh", None) or getattr(jax, "set_mesh", None)
+    if use is not None:
+        with use(mesh):
+            yield
+        return
+    from jax._src.mesh import set_abstract_mesh
+
+    with mesh, set_abstract_mesh(mesh.abstract_mesh):
+        yield

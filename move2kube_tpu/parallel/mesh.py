@@ -85,12 +85,22 @@ def infer_mesh_config(n_devices: int, *, zero_stage: int = 0,
 
 def make_mesh(config: MeshConfig | None = None, devices=None) -> "Mesh":
     """Build a 4-axis Mesh; axes of size 1 still exist (cheap, simplifies
-    PartitionSpecs — XLA drops trivial collectives)."""
+    PartitionSpecs — XLA drops trivial collectives).
+
+    Also accepts a ``topology.MeshPlan`` in place of a config: the plan
+    supplies both the logical extents and a device-order permutation so
+    each logical axis walks physically contiguous ICI neighbours (the
+    heaviest-traffic axis gets torus wraparound rings — see
+    ``parallel/topology.py``)."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
 
     devices = devices if devices is not None else jax.devices()
+    if config is not None and hasattr(config, "device_order"):
+        plan = config
+        devices = plan.device_order(devices)
+        config = plan.config
     config = config or MeshConfig(data=len(devices))
     if config.total() != len(devices):
         raise ValueError(

@@ -1,0 +1,165 @@
+"""Bucketed ring all-reduce + compute-overlapped gradient accumulation.
+
+The data-parallel gradient all-reduce is the one collective GSPMD keeps
+fully serialized behind the backward pass: ``psum`` of the whole
+gradient tree fires after the last microbatch's backward completes, so
+ICI sits idle during compute and compute sits idle during the reduce.
+With gradient accumulation there is slack to hide it: microbatch k's
+gradients can ride the ring while microbatch k+1's backward runs.
+
+Two pieces:
+
+* :func:`ring_all_reduce` — a bandwidth-optimal bucketed ring
+  all-reduce built from ``ppermute`` (reduce-scatter then all-gather,
+  2(n-1) single-neighbour hops).  All leaves are flattened into one
+  contiguous bucket per call so the ring moves a few large messages
+  instead of many small ones, and — because it is plain ``ppermute`` +
+  adds inside the caller's traced computation — XLA's latency-hiding
+  scheduler is free to interleave its hops with unrelated compute.
+
+* :func:`overlapped_accum_grads` — gradient accumulation over ``k``
+  stacked microbatches under ``shard_map`` where the scan carry holds
+  the *previous* microbatch's unreduced gradients: each step reduces
+  the pending bucket (no data dependency on the current backward) while
+  computing the current backward, exactly the overlap in the module
+  name.  Requires a pure data-parallel mesh (params replicated); model-
+  parallel meshes keep the GSPMD sequential-accumulation path in
+  ``models/train.py``.
+
+CPU-correct: numerics tests run on 8 forced host devices.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from move2kube_tpu.parallel.compat import shard_map
+
+
+def _flatten_bucket(tree):
+    """Concatenate all leaves into one fp32 bucket (+ metadata to undo)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [leaf.shape for leaf in leaves]
+    dtypes = [leaf.dtype for leaf in leaves]
+    bucket = jnp.concatenate([leaf.astype(jnp.float32).ravel() for leaf in leaves])
+    return bucket, (treedef, shapes, dtypes)
+
+
+def _unflatten_bucket(bucket, meta):
+    treedef, shapes, dtypes = meta
+    leaves, offset = [], 0
+    for shape, dtype in zip(shapes, dtypes):
+        size = 1
+        for d in shape:
+            size *= d
+        leaves.append(bucket[offset:offset + size].reshape(shape).astype(dtype))
+        offset += size
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def ring_all_reduce(tree, axis_name: str):
+    """Sum ``tree`` across ``axis_name`` with a bucketed ring.
+
+    Reduce-scatter: the bucket is split into n chunks; a travelling
+    partial sum moves one neighbour per hop, each device adding the
+    chunk the sum will need next, so after n-1 hops device r owns the
+    complete sum of chunk (r+1) mod n.  All-gather: the owned chunk
+    circulates n-1 more hops.  Every hop is a single-neighbour
+    ``ppermute`` — on a torus axis this is one wraparound ring link.
+    """
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return tree
+    bucket, meta = _flatten_bucket(tree)
+    size = bucket.shape[0]
+    pad = (-size) % n
+    if pad:
+        bucket = jnp.concatenate([bucket, jnp.zeros((pad,), bucket.dtype)])
+    chunks = bucket.reshape(n, -1)
+    r = lax.axis_index(axis_name)
+    ring = [(i, (i + 1) % n) for i in range(n)]
+
+    def chunk_at(idx):
+        return lax.dynamic_index_in_dim(chunks, jnp.mod(idx, n), axis=0, keepdims=False)
+
+    # reduce-scatter: after step s the travelling sum covers chunk r-1-s
+    # of s+2 devices; after n-1 steps device r holds sum of chunk (r+1)%n
+    total = chunk_at(r)
+    for s in range(n - 1):
+        total = lax.ppermute(total, axis_name, ring)
+        total = total + chunk_at(r - 1 - s)
+
+    # all-gather the owned chunks back around the ring
+    out = jnp.zeros_like(chunks)
+    out = lax.dynamic_update_index_in_dim(out, total, jnp.mod(r + 1, n), axis=0)
+    for s in range(n - 1):
+        total = lax.ppermute(total, axis_name, ring)
+        out = lax.dynamic_update_index_in_dim(out, total, jnp.mod(r - s, n), axis=0)
+
+    flat = out.reshape(-1)
+    if pad:
+        flat = flat[:size]
+    return _unflatten_bucket(flat, meta)
+
+
+def is_pure_data_parallel(mesh) -> bool:
+    """True when every device sits on the ``data`` axis (params are then
+    replicated, the precondition for the overlapped path)."""
+    try:
+        shape = dict(mesh.shape)
+    except Exception:
+        return False
+    data = shape.get("data", 1)
+    return data > 1 and all(v == 1 for k, v in shape.items() if k != "data")
+
+
+def overlapped_accum_grads(mesh, loss_fn, params, batches, *, axis_name: str = "data"):
+    """Mean loss + mean grads over ``k`` stacked microbatches with the
+    pending reduction overlapped against the next backward.
+
+    ``loss_fn(params, microbatch) -> scalar``; ``batches`` leaves are
+    ``[k, global_batch, ...]``.  Scan carry = (accumulated reduced
+    grads, previous microbatch's unreduced grads): each iteration issues
+    the ring reduce of the pending tree *and* the current backward with
+    no data dependency between them, then folds the reduced result into
+    the accumulator.  The final pending tree is reduced in the epilogue.
+    Returns grads and loss already averaged over microbatches and the
+    ``axis_name`` group (identical on all devices).
+    """
+    batch_spec = jax.tree_util.tree_map(lambda _: P(None, (axis_name, "fsdp")), batches)
+    param_spec = jax.tree_util.tree_map(lambda _: P(), params)
+
+    def run(p, mbs):
+        n = lax.psum(1, axis_name)
+        k = jax.tree_util.tree_leaves(mbs)[0].shape[0]
+
+        def fwd_bwd(mb):
+            return jax.value_and_grad(loss_fn)(p, mb)
+
+        loss0, g0 = fwd_bwd(jax.tree_util.tree_map(lambda x: x[0], mbs))
+
+        def body(carry, mb):
+            acc, pending = carry
+            reduced = ring_all_reduce(pending, axis_name)  # <- independent of fwd_bwd(mb)
+            loss, g = fwd_bwd(mb)
+            acc = jax.tree_util.tree_map(jnp.add, acc, reduced)
+            return (acc, g), loss
+
+        rest = jax.tree_util.tree_map(lambda x: x[1:], mbs)
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, g0)
+        (acc, last), losses = lax.scan(body, (zeros, g0), rest)
+        acc = jax.tree_util.tree_map(jnp.add, acc, ring_all_reduce(last, axis_name))
+        grads = jax.tree_util.tree_map(lambda g: (g / (k * n)).astype(g.dtype), acc)
+        loss = (loss0 + jnp.sum(losses)) / k
+        loss = lax.psum(loss, axis_name) / n
+        return grads, loss
+
+    mapped = shard_map(
+        run, mesh=mesh,
+        in_specs=(param_spec, batch_spec),
+        out_specs=(param_spec, P()),
+    )
+    return mapped(params, batches)

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from move2kube_tpu.parallel.compat import axis_size as _axis_size, shard_map
+
 
 def _block_attn(q, k, v, bias, scale):
     """One blockwise attention step -> (unnormalized out, row max, row sum)."""
@@ -50,7 +52,7 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = False,
         (shard i holds positions [i*S, (i+1)*S)).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    axis_size = jax.lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     seq_len = q.shape[1]
 
@@ -100,8 +102,8 @@ def ring_attention_sharded(mesh: Mesh, q, k, v, *, causal: bool = False):
     spec = P(("data", "fsdp"), "seq", "tensor", None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_vma=False,
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec,
     )
     def run(ql, kl, vl):
         return ring_attention(ql, kl, vl, axis_name="seq", causal=causal)

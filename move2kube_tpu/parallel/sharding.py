@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from move2kube_tpu.parallel.compat import bare_spec_constraints_ok, get_abstract_mesh
 from move2kube_tpu.utils.log import get_logger
 
 log = get_logger("parallel.sharding")
@@ -91,7 +92,7 @@ def maybe_shard(x, spec: P):
     not present in the mesh drop to None, and with no mesh at all the
     constraint is skipped — so model code can annotate unconditionally and
     still run unsharded on a single chip. Shared by llama.py / moe.py."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = get_abstract_mesh()
     if getattr(mesh, "empty", True):
         return x
     names = set(mesh.axis_names)
@@ -104,6 +105,8 @@ def maybe_shard(x, spec: P):
             pruned.append(kept if kept else None)
         else:
             pruned.append(entry if entry in names else None)
+    if not bare_spec_constraints_ok():
+        return x  # legacy jax + abstract-only mesh: shape-inert, skip
     return jax.lax.with_sharding_constraint(x, P(*pruned))
 
 

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from move2kube_tpu.parallel.compat import axis_size as _axis_size, shard_map
+
 
 def _full_attention(q, k, v, *, causal: bool, scale: float):
     """Plain attention on [b, s, h, d] (full sequence, local heads)."""
@@ -41,7 +43,7 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq",
         must be divisible by the ``axis_name`` mesh-axis size.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    axis_size = jax.lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     if q.shape[2] % axis_size:
         raise ValueError(
             f"heads ({q.shape[2]}) not divisible by |{axis_name}| ({axis_size}); "
@@ -67,8 +69,8 @@ def ulysses_attention_sharded(mesh: Mesh, q, k, v, *, causal: bool = False):
     spec = P(("data", "fsdp"), "seq", "tensor", None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_vma=False,
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec,
     )
     def run(ql, kl, vl):
         return ulysses_attention(ql, kl, vl, axis_name="seq", causal=causal)

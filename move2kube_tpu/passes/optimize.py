@@ -118,12 +118,62 @@ def port_merge_optimizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_training_optimizer(ir: IR) -> IR:
+    """Bake the training knobs into accelerated services' pod env.
+
+    Asks the SAME QA problems as the jax-xla emitter
+    (``m2kt.services.<name>.tpu.precision`` / ``.tpu.gradaccum``) — one
+    logical knob per service, answered once, cache-consistent: the
+    emitted trainer's baked-in default and the JobSet's explicit
+    ``M2KT_PRECISION`` / ``M2KT_GRAD_ACCUM`` env always agree. The env
+    entries win inside the trainer (os.environ.get over the template
+    default), so editing the YAML retunes a deployed run without a
+    rebuild. Existing entries of the same name are never overwritten."""
+    from move2kube_tpu.models.precision import PRECISION_OPTIONS
+
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None:
+            continue
+        name = common.make_dns_label(svc.name)
+        family = getattr(acc, "model_family", "") or "generic"
+        default_precision = ("bf16" if family in ("llama", "gpt", "gpt2",
+                                                  "bert") else "fp32")
+        precision = qa.fetch_select(
+            f"m2kt.services.{name}.tpu.precision",
+            f"Select the training precision policy for [{name}]",
+            ["bf16 compute + fp32 master weights; bf16-scaled adds loss "
+             "scaling; fp32 for conv nets / numerics debugging"],
+            default_precision, list(PRECISION_OPTIONS))
+        if precision not in PRECISION_OPTIONS:
+            precision = default_precision
+        raw = qa.fetch_input(
+            f"m2kt.services.{name}.tpu.gradaccum",
+            f"Enter gradient accumulation microbatches for [{name}]",
+            ["1 disables accumulation; k>1 folds k microbatches into one "
+             "optimizer update"],
+            "1")
+        try:
+            grad_accum = max(1, int(raw))
+        except (TypeError, ValueError):
+            grad_accum = 1
+        for container in svc.containers:
+            env = container.setdefault("env", [])
+            existing = {e.get("name") for e in env}
+            for env_name, value in (("M2KT_PRECISION", precision),
+                                    ("M2KT_GRAD_ACCUM", str(grad_accum))):
+                if env_name not in existing:
+                    env.append({"name": env_name, "value": value})
+    return ir
+
+
 OPTIMIZERS = [
     normalize_character_optimizer,
     ingress_optimizer,
     replica_optimizer,
     image_pull_policy_optimizer,
     port_merge_optimizer,
+    tpu_training_optimizer,
 ]
 
 

@@ -52,8 +52,31 @@ def storage_class_parameterizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_training_parameterizer(ir: IR) -> IR:
+    """Lift the training knobs the optimizer pass injected
+    (``M2KT_PRECISION`` / ``M2KT_GRAD_ACCUM``) into chart values, so a
+    Helm install retunes precision and accumulation per environment
+    (``--set tpuprecision=bf16-scaled``) without touching the manifests.
+    First accelerated service seeds the defaults (one global knob pair —
+    same shape as ``ingresshost``)."""
+    lifted = {"M2KT_PRECISION": "tpuprecision",
+              "M2KT_GRAD_ACCUM": "tpugradaccum"}
+    for svc in ir.services.values():
+        if getattr(svc, "accelerator", None) is None:
+            continue
+        for container in svc.containers:
+            for env in container.get("env", []) or []:
+                key = lifted.get(env.get("name"))
+                value = env.get("value")
+                if not key or value is None or "{{" in str(value):
+                    continue
+                ir.values.global_variables.setdefault(key, str(value))
+                env["value"] = f"{{{{ .Values.{key} }}}}"
+    return ir
+
+
 PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
-                  storage_class_parameterizer]
+                  storage_class_parameterizer, tpu_training_parameterizer]
 
 
 def parameterize(ir: IR) -> IR:

@@ -360,3 +360,40 @@ def test_prefetch_close_joins_pump_and_warns_if_stuck(caplog, monkeypatch):
         pre2.close()
     assert any("pump thread" in r.getMessage() for r in caplog.records)
     ev.set()  # release the daemon thread
+
+
+def test_accum_loader_stacks_and_skips():
+    """AccumLoader groups k microbatches into one [k, ...]-stacked batch
+    (the grad_accum train-step input) and counts skip() in optimizer
+    steps, not microbatches."""
+    class Counting:
+        def __init__(self):
+            self.i = 0
+            self.skipped = 0
+            self.closed = False
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.i += 1
+            return {"input_ids": jnp.full((4, 8), self.i)}
+
+        def skip(self, n):
+            self.skipped += n
+
+        def close(self):
+            self.closed = True
+
+    inner = Counting()
+    with m2kt_data.AccumLoader(inner, 2) as loader:
+        batch = next(loader)
+        assert batch["input_ids"].shape == (2, 4, 8)
+        assert int(batch["input_ids"][0, 0, 0]) == 1
+        assert int(batch["input_ids"][1, 0, 0]) == 2
+        loader.skip(3)
+        assert inner.skipped == 6
+    assert inner.closed
+
+    with pytest.raises(ValueError, match="accumulation factor"):
+        m2kt_data.AccumLoader(inner, 0)

@@ -10,9 +10,9 @@ memory plan against v5p HBM (95 GB).
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import AbstractMesh
 
 from move2kube_tpu.models.llama import Llama, LlamaConfig
+from move2kube_tpu.parallel.compat import abstract_mesh, ambient_mesh
 from move2kube_tpu.parallel.memory import HBM_BYTES, train_memory_plan
 
 SEQ = 8192
@@ -73,8 +73,8 @@ def test_8b_train_step_eval_shape_on_abstract_64chip_mesh():
 
     cfg = llama3_8b()
     model = Llama(cfg)
-    mesh = AbstractMesh((1, 64, 1, 1, 1, 1),
-                        ("data", "fsdp", "pipe", "tensor", "seq", "expert"))
+    mesh = abstract_mesh((1, 64, 1, 1, 1, 1),
+                         ("data", "fsdp", "pipe", "tensor", "seq", "expert"))
     ids = jax.ShapeDtypeStruct((64, SEQ), jnp.int32)  # batch 1 per chip
 
     def init_and_step(rng, batch_ids):
@@ -85,7 +85,7 @@ def test_8b_train_step_eval_shape_on_abstract_64chip_mesh():
         new_state, loss = step(state, {"input_ids": batch_ids})
         return new_state.step, loss
 
-    with jax.sharding.use_abstract_mesh(mesh):
+    with ambient_mesh(mesh):
         step_shape, loss_shape = jax.eval_shape(
             init_and_step, jax.random.PRNGKey(0), ids)
     assert loss_shape.shape == ()
@@ -105,8 +105,11 @@ def test_llama3_8b_sample_translates_to_v5p64(tmp_path):
     assert res.returncode == 0, res.stderr
     out = tmp_path / "out"
     train = (out / "containers" / "llama3-8b" / "train_tpu.py").read_text()
-    assert 'os.environ.get("M2KT_MESH_FSDP", "64")' in train  # ZeRO-3 -> fsdp=64
-    assert 'os.environ.get("M2KT_MESH_DATA", "1")' in train
+    # the trainer plans the mesh from the slice topology; ZeRO-3 flows in
+    # as zero_stage=3 (-> fsdp=64 on the 4x4x4 grid, test_topology.py)
+    assert 'default_topology="4x4x4"' in train
+    assert 'default_slice_type="tpu-v5p-slice"' in train
+    assert "zero_stage=3" in train
     objs = load_all_yamls(out / "llama3-8b")
     jobsets = [o for o in objs if o.get("kind") == "JobSet"]
     assert jobsets, "no JobSet emitted"

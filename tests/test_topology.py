@@ -1,0 +1,422 @@
+"""Topology-aware multichip stack (parallel/topology.py + overlap.py +
+models/precision.py): planner goldens for documented slice shapes, the
+physical device-order permutation, batch sharding over all data-like
+axes, overlapped gradient accumulation vs the sequential reference, and
+the precision policy / compile-cache fingerprint plumbing.
+
+Planner and precision tests are pure python; device tests run on the 8
+forced host devices conftest.py provides (skipped when unavailable)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from move2kube_tpu.models import precision as m2kt_precision
+from move2kube_tpu.models import train as m2kt_train
+from move2kube_tpu.models.compile_cache import (
+    setup_compilation_cache,
+    topology_fingerprint,
+)
+from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+from move2kube_tpu.parallel.overlap import (
+    is_pure_data_parallel,
+    ring_all_reduce,
+)
+from move2kube_tpu.parallel.compat import shard_map
+from move2kube_tpu.parallel.topology import (
+    parse_topology,
+    plan_parallelism,
+    resolve_mesh_plan,
+)
+
+needs_8 = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 (forced host) devices")
+
+
+# ---------------------------------------------------------------- parser
+
+def test_parse_topology():
+    assert parse_topology("2x4") == (2, 4)
+    assert parse_topology("4x4x4") == (4, 4, 4)
+    assert parse_topology("8") == (8,)
+
+
+@pytest.mark.parametrize("bad", ["", "0x4", "-2x4", "2xbanana", "x4"])
+def test_parse_topology_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_topology(bad)
+
+
+# --------------------------------------------------------- planner goldens
+
+def test_plan_2x4_data_parallel():
+    plan = plan_parallelism(8, topology="2x4")
+    assert plan.config.dims() == (8, 1, 1, 1, 1, 1)
+    # the single axis spans both dims, wraparound (size-4) dim first
+    assert plan.layout == {"data": (1, 0)}
+    assert plan.source == "planner"
+    assert sorted(plan.perm) == list(range(8))
+    assert plan.describe() == (
+        "mesh=8x1x1x1x1x1 topology=2x4 layout=[data@1+0] source=planner")
+
+
+def test_plan_2x4_zero3():
+    plan = plan_parallelism(8, topology="2x4", zero_stage=3)
+    assert plan.config.dims() == (1, 8, 1, 1, 1, 1)
+    assert plan.layout == {"fsdp": (1, 0)}
+    # fsdp (weight 10) straddling 2 dims: 10 * 2*2 hops
+    assert plan.ici_cost == 40.0
+
+
+def test_plan_2x4_tensor2():
+    plan = plan_parallelism(8, topology="2x4", tensor_parallel=2)
+    assert plan.config.dims() == (4, 1, 1, 2, 1, 1)
+    # tensor (heaviest) carves its factor out of the wraparound dim first
+    assert plan.layout["tensor"] == (1,)
+
+
+def test_plan_4x4x4_tensor4_zero3():
+    plan = plan_parallelism(64, topology="4x4x4", zero_stage=3,
+                            tensor_parallel=4)
+    assert plan.config.dims() == (1, 16, 1, 4, 1, 1)
+    # tensor occupies exactly one wraparound dim: ring all-reduce cost 1
+    (tdim,) = plan.layout["tensor"]
+    assert plan.topology.wraparound[tdim]
+    assert sorted(plan.perm) == list(range(64))
+
+
+def test_plan_single_chip():
+    plan = plan_parallelism(1)
+    assert plan.source == "single-chip"
+    assert plan.perm == (0,)
+    assert plan.config.total() == 1
+
+
+def test_plan_4x2_tensor2_permutation():
+    """tensor=2 on a (4,2) grid: the wraparound size-4 dim goes to data
+    (gcd(2,4)=2 still lands tensor there first, so check the realized
+    perm: logical neighbours on the heaviest axis are physically
+    adjacent in the row-major enumeration)."""
+    plan = plan_parallelism(8, topology="4x2", tensor_parallel=2)
+    assert plan.config.dims() == (4, 1, 1, 2, 1, 1)
+    assert plan.perm == (0, 2, 4, 6, 1, 3, 5, 7)
+
+
+def test_plan_memory_split_resplits_fsdp():
+    """30 GB of params on v5e (16 GB HBM): fp32 master state can't fit
+    replicated, so the planner re-splits the dp pool into fsdp=8."""
+    plan = plan_parallelism(8, topology="2x4",
+                            slice_type="tpu-v5-lite-podslice",
+                            param_bytes=int(30e9))
+    assert plan.config.fsdp == 8
+    assert plan.config.data == 1
+
+
+def test_plan_mismatched_topology_falls_back_to_chain():
+    for topo in ("2x2", "2xbanana"):
+        plan = plan_parallelism(8, topology=topo)
+        assert plan.source == "fallback-chain"
+        assert plan.topology.dims == (8,)
+        assert plan.config.total() == 8
+
+
+def test_resolve_env_topology_and_mesh_override():
+    plan = resolve_mesh_plan(8, env={"M2KT_TPU_TOPOLOGY": "2x4"})
+    assert plan.source == "planner"
+    assert plan.topology.dims == (2, 4)
+
+    plan = resolve_mesh_plan(
+        8, default_topology="2x4",
+        env={"M2KT_MESH_DATA": "2", "M2KT_MESH_TENSOR": "4"})
+    assert plan.source == "env-mesh"
+    assert plan.config.dims() == (2, 1, 1, 4, 1, 1)
+
+    # an override that doesn't match the device count is ignored
+    plan = resolve_mesh_plan(8, default_topology="2x4",
+                             env={"M2KT_MESH_DATA": "4"})
+    assert plan.source == "planner"
+    assert plan.config.dims() == (8, 1, 1, 1, 1, 1)
+
+
+def test_device_order_identity_on_length_mismatch():
+    plan = plan_parallelism(8, topology="2x4")
+    devs = list(range(4))  # wrong length: permutation must not apply
+    assert plan.device_order(devs) == devs
+
+
+# ------------------------------------------------------ mesh construction
+
+@needs_8
+def test_make_mesh_accepts_plan():
+    plan = plan_parallelism(8, topology="2x4", tensor_parallel=2)
+    mesh = make_mesh(plan)
+    assert dict(mesh.shape) == {"data": 4, "fsdp": 1, "pipe": 1,
+                                "tensor": 2, "seq": 1, "expert": 1}
+    # the mesh holds every local device exactly once, in plan order
+    got = [d.id for d in mesh.devices.ravel()]
+    want = [jax.devices()[i].id for i in plan.perm]
+    assert got == want
+
+
+# ---------------------------------------------------------- batch sharding
+
+def test_data_axes_covers_dp_and_fsdp():
+    from jax.sharding import AbstractMesh
+
+    amesh = AbstractMesh((("data", 4), ("fsdp", 2), ("pipe", 1),
+                          ("tensor", 1), ("seq", 1), ("expert", 1)))
+    assert m2kt_train.data_axes(amesh) == ("data", "fsdp")
+
+
+@needs_8
+@pytest.mark.parametrize("config", [
+    MeshConfig(data=4, fsdp=2),   # memory-model split
+    MeshConfig(fsdp=8),           # ZeRO: all devices on fsdp
+    MeshConfig(data=8),           # pure dp
+])
+def test_batch_sharding_spans_all_data_axes(config):
+    """Regression: sharding over only ``data`` on a dp x fsdp (or
+    fsdp-only) mesh replicates the batch across the other axis; the
+    batch must land one row per device on all three shapes."""
+    mesh = make_mesh(config)
+    s = m2kt_train.batch_sharding(mesh)
+    assert s.spec == P(("data", "fsdp"))
+    x = jax.device_put(jnp.arange(32.0).reshape(8, 4), s)
+    shard_shapes = {tuple(sh.data.shape) for sh in x.addressable_shards}
+    assert shard_shapes == {(1, 4)}
+
+
+# ----------------------------------------------------- ring all-reduce
+
+@needs_8
+@pytest.mark.parametrize("width", [3, 16])  # 3 exercises the pad path
+def test_ring_all_reduce_matches_sum(width):
+    mesh = make_mesh(MeshConfig(data=8))
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, width))
+
+    def f(block):
+        return ring_all_reduce({"a": block}, "data")["a"]
+
+    out = shard_map(f, mesh=mesh, in_specs=(P("data", None),),
+                    out_specs=P("data", None))(x)
+    want = jnp.broadcast_to(x.sum(axis=0), (8, width))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-5)
+
+
+# ------------------------------------- overlapped gradient accumulation
+
+def _llama_fixture():
+    import optax
+
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32)
+    model = Llama(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (16, 32)))
+    params = model.init(jax.random.PRNGKey(0), ids[:2])["params"]
+
+    def fresh_state(params_):
+        # donation deletes the input buffers: every state gets copies
+        return m2kt_train.TrainState.create(
+            apply_fn=model.apply,
+            params=jax.tree.map(lambda a: a.copy(), params_),
+            tx=optax.sgd(1e-2))
+
+    return params, ids, fresh_state
+
+
+def test_is_pure_data_parallel():
+    from jax.sharding import AbstractMesh
+
+    def amesh(**sizes):
+        base = {"data": 1, "fsdp": 1, "pipe": 1, "tensor": 1, "seq": 1,
+                "expert": 1}
+        base.update(sizes)
+        return AbstractMesh(tuple(base.items()))
+
+    assert is_pure_data_parallel(amesh(data=8))
+    assert not is_pure_data_parallel(amesh(data=4, tensor=2))
+    assert not is_pure_data_parallel(amesh(fsdp=8))
+    assert not is_pure_data_parallel(amesh())
+
+
+@needs_8
+def test_overlapped_accum_matches_plain_step():
+    """grad_accum=2 on a pure-dp mesh (the overlapped ring path) must
+    reproduce the single-step update on the flattened batch: lm_loss is
+    a batch mean, so averaging two half-batch gradients is exact."""
+    params, ids, fresh_state = _llama_fixture()
+    mesh = make_mesh(MeshConfig(data=8))
+    assert is_pure_data_parallel(mesh)
+
+    step_plain = m2kt_train.make_lm_train_step(mesh, remat=False)
+    step_accum = m2kt_train.make_lm_train_step(mesh, remat=False,
+                                               grad_accum=2)
+    s_plain, loss_plain = step_plain(fresh_state(params),
+                                     {"input_ids": ids})
+    s_accum, loss_accum = step_accum(fresh_state(params),
+                                     {"input_ids": ids.reshape(2, 8, 32)})
+    np.testing.assert_allclose(float(loss_plain), float(loss_accum),
+                               atol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_plain.params),
+                    jax.tree.leaves(s_accum.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@needs_8
+def test_sequential_accum_matches_plain_step_on_mp_mesh():
+    """grad_accum on a mesh with a model-parallel axis takes the GSPMD
+    sequential-scan fallback; same 1e-5 equivalence."""
+    params, ids, fresh_state = _llama_fixture()
+    mesh = make_mesh(MeshConfig(data=4, tensor=2))
+    assert not is_pure_data_parallel(mesh)
+
+    step_plain = m2kt_train.make_lm_train_step(mesh, remat=False)
+    step_accum = m2kt_train.make_lm_train_step(mesh, remat=False,
+                                               grad_accum=2)
+    s_plain, loss_plain = step_plain(fresh_state(params),
+                                     {"input_ids": ids})
+    s_accum, loss_accum = step_accum(fresh_state(params),
+                                     {"input_ids": ids.reshape(2, 8, 32)})
+    np.testing.assert_allclose(float(loss_plain), float(loss_accum),
+                               atol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_plain.params),
+                    jax.tree.leaves(s_accum.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@needs_8
+def test_classifier_accum_matches_plain_step():
+    import flax.linen as nn
+    import optax
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(10)(nn.relu(nn.Dense(32)(x)))
+
+    model = Tiny()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+    y = jnp.asarray(np.random.default_rng(1).integers(0, 10, (16,)))
+    params = model.init(jax.random.PRNGKey(1), x[:2])["params"]
+    mesh = make_mesh(MeshConfig(data=8))
+
+    def fresh_state(p):
+        return m2kt_train.TrainState.create(
+            apply_fn=model.apply,
+            params=jax.tree.map(lambda a: a.copy(), p),
+            tx=optax.sgd(1e-2))
+
+    step_plain = m2kt_train.make_classifier_train_step(mesh)
+    step_accum = m2kt_train.make_classifier_train_step(mesh, grad_accum=2)
+    s1, l1 = step_plain(fresh_state(params), {"input": x, "label": y})
+    s2, l2 = step_accum(fresh_state(params),
+                        {"input": x.reshape(2, 8, 8),
+                         "label": y.reshape(2, 8)})
+    np.testing.assert_allclose(float(l1), float(l2), atol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+# -------------------------------------------------------- precision policy
+
+def test_precision_policies():
+    bf16 = m2kt_precision.policy("bf16")
+    assert bf16.compute_dtype == "bfloat16"
+    assert bf16.param_dtype == "float32"
+    assert bf16.loss_scale == 0.0
+    assert m2kt_precision.policy("bf16-scaled").loss_scale == 1024.0
+    assert m2kt_precision.policy("fp32").jnp_compute_dtype == jnp.float32
+    with pytest.raises(ValueError):
+        m2kt_precision.policy("fp16")
+
+
+def test_precision_from_env():
+    assert m2kt_precision.from_env(env={}).name == "bf16"
+    assert m2kt_precision.from_env(
+        env={"M2KT_PRECISION": "fp32"}).name == "fp32"
+    # env typos fall back to the default instead of killing the job
+    assert m2kt_precision.from_env(
+        default="fp32", env={"M2KT_PRECISION": "banana"}).name == "fp32"
+    pol = m2kt_precision.from_env(
+        env={"M2KT_PRECISION": "bf16-scaled", "M2KT_LOSS_SCALE": "256"})
+    assert pol.loss_scale == 256.0
+
+
+def test_precision_cast_and_scale():
+    bf16 = m2kt_precision.policy("bf16")
+    tree = {"w": jnp.ones((2, 2), jnp.float32), "step": jnp.int32(3)}
+    cast = bf16.cast_params(tree)
+    assert cast["w"].dtype == jnp.bfloat16
+    assert cast["step"].dtype == jnp.int32  # non-float passes through
+    # fp32 policy is the identity
+    fp32 = m2kt_precision.policy("fp32")
+    assert fp32.cast_params(tree)["w"].dtype == jnp.float32
+
+    scaled = m2kt_precision.policy("bf16-scaled")
+    loss = jnp.float32(2.0)
+    assert float(scaled.unscale(scaled.scale_loss(loss))) == 2.0
+    assert float(bf16.scale_loss(loss)) == 2.0
+
+
+def test_precision_wrap_optimizer_and_model_config():
+    import optax
+
+    from move2kube_tpu.models.llama import llama_tiny
+
+    tx = optax.sgd(1e-2)
+    assert m2kt_precision.policy("bf16").wrap_optimizer(tx) is tx
+    wrapped = m2kt_precision.policy("bf16-scaled").wrap_optimizer(tx)
+    assert wrapped is not tx and hasattr(wrapped, "update")
+
+    cfg = m2kt_precision.policy("bf16").apply_to_model_config(llama_tiny())
+    assert cfg.dtype == jnp.bfloat16
+    assert m2kt_precision.policy("bf16").apply_to_model_config("x") == "x"
+
+
+@needs_8
+def test_lm_step_with_scaled_precision_is_finite():
+    params, ids, fresh_state = _llama_fixture()
+    mesh = make_mesh(MeshConfig(data=8))
+    step = m2kt_train.make_lm_train_step(
+        mesh, remat=False, grad_accum=2,
+        precision=m2kt_precision.policy("bf16-scaled"))
+    _, loss = step(fresh_state(params), {"input_ids": ids.reshape(2, 8, 32)})
+    assert np.isfinite(float(loss))
+
+
+# ------------------------------------------------ compile-cache fingerprint
+
+def test_topology_fingerprint_empty_for_no_mesh():
+    from jax.sharding import AbstractMesh
+
+    assert topology_fingerprint(None) == ""
+    amesh = AbstractMesh((("data", 8), ("fsdp", 1), ("pipe", 1),
+                          ("tensor", 1), ("seq", 1), ("expert", 1)))
+    assert topology_fingerprint(amesh) == ""
+
+
+@needs_8
+def test_topology_fingerprint_distinguishes_mesh_shapes(tmp_path,
+                                                        monkeypatch):
+    m_dp = make_mesh(MeshConfig(data=8))
+    m_split = make_mesh(MeshConfig(data=4, fsdp=2))
+    fp_dp, fp_split = topology_fingerprint(m_dp), topology_fingerprint(m_split)
+    assert fp_dp and fp_split and fp_dp != fp_split
+    assert "n8" in fp_dp and "8x1x1x1x1x1" in fp_dp
+
+    monkeypatch.setenv("M2KT_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("M2KT_COMPILE_CACHE", raising=False)
+    path = setup_compilation_cache(mesh=m_dp)
+    assert path == str(tmp_path / fp_dp)
+    # restore the default dir so later tests don't write under tmp_path
+    monkeypatch.delenv("M2KT_COMPILE_CACHE_DIR")
+    setup_compilation_cache()
