@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -73,6 +73,14 @@ perf-smoke:
 multichip-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_topology.py -q
 	$(CPU_ENV) $(PY) bench.py --model scaling
+
+# serving hot path in isolation (CPU-mode): paged KV cache vs dense
+# equivalence, continuous-batching engine invariants, serving emission
+# (Knative TPU resources + concurrency), then the bench serving phase
+# (decode tok/s + p50/p95 step latency, compile-count bound)
+serve-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_serving.py -q
+	$(CPU_ENV) $(PY) bench.py --model serving
 
 # resilience subsystem in isolation (all CPU-mode, deterministic faults):
 # kill-at-step-N -> resume-from-N under the supervisor, corrupt-checkpoint

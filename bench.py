@@ -67,7 +67,7 @@ WARM_FAST_S = float(os.environ.get("M2KT_BENCH_WARM_FAST_S", "3.0"))
 MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
 PHASES = ("resnet", "bert", "pallas", "llama", "translate", "goodput",
-          "scaling")
+          "scaling", "serving")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
@@ -78,6 +78,7 @@ PHASE_METRICS = {
     "translate": ("gpu2tpu_translate_throughput", "services/s"),
     "goodput": ("train_goodput_fraction_faulted", "fraction"),
     "scaling": ("multichip_scaling_efficiency_host8", "fraction"),
+    "serving": ("decode_throughput_tokens_s", "tok/s"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
@@ -804,6 +805,105 @@ def run_scaling_probe() -> int:
     return 0
 
 
+def bench_serving(n: int) -> dict:
+    """Continuous-batching decode throughput on forced host devices: a
+    16-request mixed-length stream through the paged-KV ServingEngine
+    (serving/engine.py) on the tiny llama. Reports decode tokens/s plus
+    p50/p95 per-token step latency and the compiled-executable count (the
+    engine's shape discipline bounds it by num_buckets + 2). CPU host
+    numbers are only comparable across rounds of this repo — the phase
+    guards that the prefill-bucketing + slot-recycling machinery holds its
+    compile bound and throughput doesn't collapse. Own subprocess for the
+    same reason as the scaling phase: the probe must own jax's platform
+    env before import, independent of this child's backend."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--serving-probe"],
+        env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"serving probe rc={res.returncode}: {res.stderr[-300:]}")
+    probe = json.loads(res.stdout.strip().splitlines()[-1])
+    dt = time.perf_counter() - t0
+    print(f"[bench] serving {probe['decode_throughput_tokens_s']:.1f} tok/s "
+          f"(p50 {probe['decode_p50_latency_ms']:.2f}ms, "
+          f"p95 {probe['decode_p95_latency_ms']:.2f}ms, "
+          f"{probe['total_executables']} executables for "
+          f"{probe['num_buckets']} buckets) in {dt:.1f}s", file=sys.stderr)
+    metric, unit = PHASE_METRICS["serving"]
+    # no published baseline: host-CPU decode throughput of a toy model is
+    # not a literature number — only cross-round comparable
+    return {"phase": "serving", "metric": metric,
+            "value": probe["decode_throughput_tokens_s"], "unit": unit,
+            "vs_baseline": 0.0, "baseline": "none_published",
+            "decode_p50_latency_ms": probe["decode_p50_latency_ms"],
+            "decode_p95_latency_ms": probe["decode_p95_latency_ms"],
+            "decode_tokens": probe["decode_tokens"],
+            "requests": probe["requests"],
+            "num_buckets": probe["num_buckets"],
+            "total_executables": probe["total_executables"],
+            "compile_bound_ok": probe["compile_bound_ok"],
+            "wall_s": round(dt, 2)}
+
+
+def run_serving_probe() -> int:
+    """In-process half of the serving phase (spawned by bench_serving with
+    jax forced onto host devices). Drives the continuous-batching engine
+    over a mixed-length 16-request stream and prints one JSON line."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.serving.engine import (
+        EngineConfig,
+        Request,
+        ServingEngine,
+    )
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32)
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    engine = ServingEngine(model, variables, EngineConfig(
+        max_batch=4, max_seq=64, block_size=8, buckets=(8, 16, 32),
+        max_new_tokens=8))
+    # mixed prompt lengths spanning all three buckets; enough requests
+    # that slots recycle mid-flight (16 requests through 4 slots)
+    lengths = [3, 7, 12, 20, 30, 5, 16, 25, 9, 31, 4, 14, 22, 6, 28, 11]
+    rng = np.random.default_rng(0)
+    requests = [
+        Request(rid=f"r{i}",
+                prompt=rng.integers(1, cfg.vocab_size, size=n).tolist())
+        for i, n in enumerate(lengths)]
+    completions = engine.run(requests)
+    assert len(completions) == len(requests), (
+        f"{len(completions)}/{len(requests)} requests completed")
+    stats = engine.stats()
+    report = engine.compile_report()
+    total = report.get("total_executables", -1)
+    print(json.dumps({
+        **{k: round(v, 3) if isinstance(v, float) else v
+           for k, v in stats.items()},
+        "requests": len(requests),
+        "num_buckets": report["num_buckets"],
+        "total_executables": total,
+        "compile_bound_ok": bool(
+            0 <= total <= report["num_buckets"] + 2),
+    }), flush=True)
+    return 0
+
+
 def _setup_compile_cache() -> None:
     """Persistent XLA compile cache for this child: a re-spawned child
     (retry, OOM batch-halving) deserializes the previous child's
@@ -849,7 +949,7 @@ def run_child(phases: list[str]) -> int:
     fns = {"resnet": bench_resnet, "bert": bench_bert,
            "pallas": bench_pallas, "llama": bench_llama,
            "translate": bench_translate, "goodput": bench_goodput,
-           "scaling": bench_scaling}
+           "scaling": bench_scaling, "serving": bench_serving}
     ok = True
     for phase in phases:
         try:
@@ -1097,7 +1197,9 @@ def run_opportunistic() -> int:
     oom: dict = {}
     deadline = time.perf_counter() + BUDGET_S
     for _ in range(3):
-        missing = [p for p in TPU_PHASES if p not in results
+        # serving rides along: it runs on forced host devices, so an
+        # opportunistic capture window is also a chance to refresh it
+        missing = [p for p in TPU_PHASES + ("serving",) if p not in results
                    and len(fails.get(p, ())) < MAX_PHASE_FAILS]
         remaining = deadline - time.perf_counter()
         if not missing or remaining < 30:
@@ -1152,9 +1254,14 @@ def main() -> int:
     parser.add_argument("--scaling-probe", action="store_true",
                         help="internal: 8-host-device scaling measurement "
                              "(spawned by the scaling phase)")
+    parser.add_argument("--serving-probe", action="store_true",
+                        help="internal: continuous-batching decode "
+                             "measurement (spawned by the serving phase)")
     args = parser.parse_args()
     if args.scaling_probe:
         return run_scaling_probe()
+    if args.serving_probe:
+        return run_serving_probe()
     if args.child:
         return run_child(args.child.split(","))
     if args.opportunistic:

@@ -201,6 +201,11 @@ class DeploymentAPIResource(APIResource):
             if DAEMON_SET in supported:
                 return self._create_daemonset(svc, labels)
             log.warning("%s: cluster lacks DaemonSet; emitting Deployment", svc.name)
+        if svc.accelerator is not None:
+            # TPU serving service in k8s output mode (knative output emits
+            # a knative Service instead): the long-running Deployment needs
+            # the same chip requests + node selectors as the JobSet path
+            _tpu_resources(svc, DEPLOYMENT)
         if DEPLOYMENT in supported or not supported:
             return self._create_deployment(svc, labels)
         if DEPLOYMENT_CONFIG in supported:

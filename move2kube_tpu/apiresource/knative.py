@@ -11,6 +11,8 @@ the declared container port).
 
 from __future__ import annotations
 
+import json
+
 from move2kube_tpu.apiresource.base import (
     APIResource,
     group_of,
@@ -24,6 +26,54 @@ log = get_logger("apiresource.knative")
 
 KNATIVE_GROUP = "serving.knative.dev"
 DEFAULT_PORT = 8080
+
+# revision-template pod fields the v1beta1 schema accepts; anything else
+# (nodeSelector, tolerations, runtimeClassName... — the TPU placement
+# fields) is stashed into _STASH_ANNOTATION on down-conversion and
+# restored on the way back up instead of being silently dropped
+_V1BETA1_TEMPLATE_SPEC_FIELDS = {
+    "containers", "volumes", "serviceAccountName", "containerConcurrency",
+    "timeoutSeconds", "imagePullSecrets", "enableServiceLinks",
+}
+_STASH_ANNOTATION = "serving.knative.dev/v1-fields"
+
+
+def _serving_concurrency(svc) -> int:
+    """In-flight request cap for the revision: the decode engine admits at
+    most M2KT_SERVE_MAX_BATCH sequences, so routing more concurrent
+    requests than that to one pod only queues them behind the batch —
+    autoscale instead. The env value is injected by the serving optimizer
+    pass (same QA knob as the emitted server); default matches the
+    engine's default max_batch."""
+    for c in svc.containers:
+        for e in c.get("env", []) or []:
+            if e.get("name") == "M2KT_SERVE_MAX_BATCH":
+                try:
+                    return max(1, int(e.get("value", "")))
+                except (TypeError, ValueError):
+                    break
+    return 8
+
+
+def _tpu_pod_resources(svc, pod_spec: dict) -> None:
+    """google.com/tpu chip requests + GKE TPU node selectors on a knative
+    revision pod spec (same sizing as the JobSet path — single owner:
+    deployment._chips_per_host). nodeSelector on a revision template needs
+    the cluster's `kubernetes.podspec-nodeselector` feature flag, which
+    GKE TPU-serving setups enable."""
+    from move2kube_tpu.apiresource.deployment import _chips_per_host
+
+    acc = svc.accelerator
+    chips = _chips_per_host(acc.tpu_topology, acc.num_hosts)
+    for c in pod_spec.get("containers", []):
+        res = c.setdefault("resources", {})
+        res.setdefault("limits", {})["google.com/tpu"] = chips
+        res.setdefault("requests", {})["google.com/tpu"] = chips
+    selector = pod_spec.setdefault("nodeSelector", {})
+    selector.setdefault("cloud.google.com/gke-tpu-accelerator",
+                        acc.tpu_accelerator or "tpu-v5-lite-podslice")
+    selector.setdefault("cloud.google.com/gke-tpu-topology",
+                        acc.tpu_topology or "1x1")
 
 
 class KnativeServiceAPIResource(APIResource):
@@ -66,11 +116,26 @@ class KnativeServiceAPIResource(APIResource):
             # knative revisions are restarted by the autoscaler; parity:
             # knativeservice.go:46 pins RestartPolicy Always
             pod_spec["restartPolicy"] = "Always"
+            # knative revision schema has no subdomain (that's the JobSet
+            # pod-DNS mechanism); drop it rather than fail validation
+            pod_spec.pop("subdomain", None)
             labels = {"app": svc.name, **svc.labels}
             obj = make_obj("Service", f"{KNATIVE_GROUP}/v1", svc.name, labels)
             if svc.annotations:
                 obj["metadata"]["annotations"] = dict(svc.annotations)
-            obj["spec"] = {"template": {"spec": pod_spec}}
+            template: dict = {"spec": pod_spec}
+            if svc.accelerator is not None:
+                # TPU serving service: chip requests + placement on the
+                # revision, and concurrency matched to the decode engine's
+                # max batch so the autoscaler scales on batch saturation
+                _tpu_pod_resources(svc, pod_spec)
+                concurrency = _serving_concurrency(svc)
+                pod_spec["containerConcurrency"] = concurrency
+                template["metadata"] = {"annotations": {
+                    "autoscaling.knative.dev/metric": "concurrency",
+                    "autoscaling.knative.dev/target": str(concurrency),
+                }}
+            obj["spec"] = {"template": template}
             objs.append(obj)
         return objs
 
@@ -91,6 +156,19 @@ class KnativeServiceAPIResource(APIResource):
         name = obj_name(obj)
         tmpl = (obj.get("spec", {}).get("template", {}) or {})
         pod_spec = dict(tmpl.get("spec", {}) or {})
+        # version-converted objects keep v1-only pod fields (nodeSelector,
+        # TPU placement) in the stash annotation — a plain Deployment
+        # supports them all, so restore before lowering
+        tmpl_annotations = dict((tmpl.get("metadata") or {})
+                                .get("annotations") or {})
+        stash = tmpl_annotations.pop(_STASH_ANNOTATION, "")
+        if stash:
+            try:
+                pod_spec.update(json.loads(stash))
+            except (ValueError, TypeError):
+                log.warning("unreadable %s annotation on %s; stashed pod "
+                            "fields lost in lowering", _STASH_ANNOTATION, name)
+        pod_spec.pop("containerConcurrency", None)  # revision-only field
         containers = pod_spec.get("containers") or []
         port = next(
             (int(p["containerPort"]) for c in containers
@@ -98,10 +176,20 @@ class KnativeServiceAPIResource(APIResource):
             DEFAULT_PORT)  # first declared port across ALL containers wins
         labels = {"app": name}
         deployment = make_obj("Deployment", "apps/v1", name, labels)
+        obj_annotations = dict((obj.get("metadata") or {})
+                               .get("annotations") or {})
+        if obj_annotations:
+            deployment["metadata"]["annotations"] = obj_annotations
+        pod_meta: dict = {"labels": labels}
+        if tmpl_annotations:
+            # autoscaling.knative.dev annotations have no Deployment
+            # semantics but carry the operator's intent (e.g. the decode
+            # concurrency target an HPA should be configured around)
+            pod_meta["annotations"] = tmpl_annotations
         deployment["spec"] = {
             "replicas": 1,
             "selector": {"matchLabels": labels},
-            "template": {"metadata": {"labels": labels}, "spec": pod_spec},
+            "template": {"metadata": pod_meta, "spec": pod_spec},
         }
         service = make_obj("Service", "v1", name, labels)
         service["spec"] = {
@@ -122,5 +210,47 @@ class KnativeServiceAPIResource(APIResource):
             if group_of(v) == KNATIVE_GROUP
         ]
         if knative_versions:
-            obj["apiVersion"] = knative_versions[0]
+            _convert_knative_version(obj, knative_versions[0])
         return [obj]
+
+
+def _convert_knative_version(obj: dict, to_version: str) -> None:
+    """Swap a knative Service between ``serving.knative.dev/v1`` and
+    ``/v1beta1`` without dropping information. v1beta1's revision template
+    rejects the pod-placement fields v1 accepts, so down-conversion moves
+    them into the ``_STASH_ANNOTATION`` JSON blob (annotations survive any
+    version) and up-conversion restores them. Round-trip identity:
+    v1 -> v1beta1 -> v1 reproduces the original spec."""
+    from_version = obj.get("apiVersion", "")
+    if obj.get("kind") != "Service" or to_version == from_version:
+        obj["apiVersion"] = to_version
+        return
+    tmpl = (obj.get("spec") or {}).get("template")
+    if not isinstance(tmpl, dict):
+        obj["apiVersion"] = to_version
+        return
+    spec = tmpl.get("spec")
+    if isinstance(spec, dict):
+        if to_version.endswith("/v1beta1"):
+            extra = {k: spec.pop(k) for k in sorted(spec)
+                     if k not in _V1BETA1_TEMPLATE_SPEC_FIELDS}
+            if extra:
+                ann = (tmpl.setdefault("metadata", {})
+                       .setdefault("annotations", {}))
+                ann[_STASH_ANNOTATION] = json.dumps(extra, sort_keys=True)
+                log.info("%s: stashed %d v1-only pod fields for v1beta1",
+                         obj_name(obj), len(extra))
+        else:
+            ann = (tmpl.get("metadata") or {}).get("annotations") or {}
+            stash = ann.pop(_STASH_ANNOTATION, "")
+            if stash:
+                try:
+                    restored = json.loads(stash)
+                except (ValueError, TypeError):
+                    log.warning("%s: unreadable %s annotation; stashed pod "
+                                "fields dropped", obj_name(obj),
+                                _STASH_ANNOTATION)
+                    restored = {}
+                for key, value in restored.items():
+                    spec.setdefault(key, value)
+    obj["apiVersion"] = to_version

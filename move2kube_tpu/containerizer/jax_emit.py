@@ -5,6 +5,9 @@ Builds the Container for a detected GPU training service:
 - ``train_tpu.py``: a complete JAX training program for the detected model
   family, rendered from ``assets/jax/train_tpu.py`` with the TPU mesh that
   maps the workload's GPU parallelism (DDP->data, ZeRO->fsdp, TP->tensor);
+  detected inference servers emit ``serve_tpu.py`` instead — the
+  continuous-batching decode server over the vendored serving engine
+  (paged KV cache, bucketed prefill);
 - the **vendored model zoo**: ``move2kube_tpu/{models,parallel,ops}`` source
   files are copied verbatim into the image, so the emitted program uses the
   exact code this repo tests (single source of truth, no pip dependency on
@@ -35,7 +38,8 @@ _ASSETS = os.path.join(_PKG_ROOT, "assets", "jax")
 # the extension (transient gcc install, `|| true`); when that fails
 # gather_rows degrades to the numpy fallback. "resilience" is the
 # preemption/supervisor/goodput stack the image's entrypoint runs under.
-VENDORED_SUBPACKAGES = ("models", "parallel", "ops", "native", "resilience")
+VENDORED_SUBPACKAGES = ("models", "parallel", "ops", "native", "resilience",
+                        "serving")
 
 REQUIREMENTS = """jax[tpu]>=0.4.35
 flax
@@ -206,6 +210,38 @@ def _ask_training_knobs(name: str, family: str) -> tuple[str, int]:
     return precision, grad_accum
 
 
+def _ask_serving_knobs(name: str) -> dict:
+    """Serving capacity knobs (max in-flight batch, context length, KV
+    page size) as QA problems. IDs are shared with
+    ``passes/optimize.py``'s tpu_serving_optimizer — asked once here,
+    cached answers reused for the Knative env injection."""
+    from move2kube_tpu import qa
+
+    knobs = {}
+    for key, qid, desc, default in (
+        ("max_batch", "serve.maxbatch",
+         "Enter the max concurrent decode batch for [{name}]", "8"),
+        ("max_seq", "serve.maxseq",
+         "Enter the max context length (prompt + generation) for [{name}]",
+         "2048"),
+        ("kv_block", "serve.kvblock",
+         "Enter the paged KV cache block size (tokens/page) for [{name}]",
+         "16"),
+    ):
+        raw = qa.fetch_input(
+            f"m2kt.services.{name}.{qid}", desc.format(name=name),
+            ["bounds compiled shapes and HBM footprint of the serving "
+             "engine's paged KV cache"],
+            default)
+        try:
+            knobs[key] = max(1, int(raw))
+        except (TypeError, ValueError):
+            log.warning("invalid %s answer %r for %s; using %s",
+                        qid, raw, name, default)
+            knobs[key] = int(default)
+    return knobs
+
+
 def emit_container(service: PlanService, plan=None) -> Container:
     acc = service.accelerator or AcceleratorInfo()
     family = (service.containerization_target_options[0]
@@ -218,6 +254,21 @@ def emit_container(service: PlanService, plan=None) -> Container:
     # ask for the slice BEFORE sizing the mesh: an override rescales
     # acc.gpu_count so the emitted mesh covers the chosen topology
     _ask_tpu_slice(name, acc, plan)
+
+    # inference services emit the decode server instead of a trainer;
+    # only the decoder-LM families have a serving engine (the paged KV
+    # cache is a decoder structure). Anything else falls back to the
+    # training path — and clears the serving flag so the apiresources
+    # classify the service to match what the image actually runs.
+    serving = bool(acc.serving)
+    if serving and family not in ("llama", "gpt", "gpt2"):
+        log.warning(
+            "%s is an inference server but family %r has no serving "
+            "engine (decoder LMs only); emitting the training path",
+            name, family)
+        serving = False
+        acc.serving = False
+        acc.serving_port = 0
 
     # MoE only exists in the decoder-LM family; elsewhere detected expert
     # settings would shape a mesh the trainer can't use
@@ -258,7 +309,10 @@ def emit_container(service: PlanService, plan=None) -> Container:
         "expert_parallel": acc.parallelism.get("ep", 1) if moe_experts else 1,
     }
     mesh = infer_mesh_config(max(1, acc.gpu_count), **degrees)
-    precision, grad_accum = _ask_training_knobs(name, family)
+    if serving:
+        precision, grad_accum = "bf16", 1  # decode server: no train knobs
+    else:
+        precision, grad_accum = _ask_training_knobs(name, family)
 
     image_name = service.image or f"{name}:latest"
     # HF GPT-2 fine-tunes (family gpt) emit the true GPT-2 architecture
@@ -286,42 +340,65 @@ def emit_container(service: PlanService, plan=None) -> Container:
 
         _record_source_dir(container, plan, src_dirs[0])
 
-    with open(os.path.join(_ASSETS, "train_tpu.py"), encoding="utf-8") as f:
-        train_template = f.read()
     entry_rel = acc.entrypoint
     if entry_rel and os.path.isabs(entry_rel):
         src_dirs = service.source_artifacts.get(PlanService.SOURCE_DIR_ARTIFACT, [])
         if src_dirs:
             rel = common.relpath_under(entry_rel, src_dirs[0])
             entry_rel = rel if rel is not None else os.path.basename(entry_rel)
-    container.add_file(
-        "train_tpu.py",
-        common.render_template(train_template, {
-            "source_entrypoint": entry_rel or "(unknown)",
-            "frameworks": ",".join(acc.frameworks) or "unknown",
-            "backend": acc.distributed_backend,
-            "gpu_count": acc.gpu_count,
-            "family": emit_family,
-            "tpu_accelerator": acc.tpu_accelerator or "tpu-v5-lite-podslice",
-            "tpu_topology": acc.tpu_topology or "1x1",
-            "num_hosts": acc.num_hosts,
-            "mesh": mesh,
-            "zero_stage": degrees["zero_stage"],
-            "tensor_parallel": degrees["tensor_parallel"],
-            "seq_parallel": degrees["seq_parallel"],
-            "pipeline_parallel": degrees["pipeline_parallel"],
-            "expert_parallel": degrees["expert_parallel"],
-            "precision": precision,
-            "grad_accum": grad_accum,
-            "moe_experts": moe_experts,
-            # in-image default; pods that mount a durable volume point
-            # M2KT_COMPILE_CACHE_DIR at it to survive restarts
-            "compile_cache_dir": "/app/.jax-cache",
-            "steps": 100,
-            "lr": (3e-4 if family in ("llama", "gpt", "gpt2")
-                   else 1e-4 if family == "unet" else 1e-3),
-        }),
-    )
+    serve_port = acc.serving_port or 8080
+    if serving:
+        acc.serving_port = serve_port
+        serve_knobs = _ask_serving_knobs(name)
+        with open(os.path.join(_ASSETS, "serve_tpu.py"),
+                  encoding="utf-8") as f:
+            container.add_file(
+                "serve_tpu.py",
+                common.render_template(f.read(), {
+                    "source_entrypoint": entry_rel or "(unknown)",
+                    "family": emit_family,
+                    "tpu_accelerator": (acc.tpu_accelerator
+                                        or "tpu-v5-lite-podslice"),
+                    "tpu_topology": acc.tpu_topology or "1x1",
+                    "serve_port": serve_port,
+                    "serve_max_batch": serve_knobs["max_batch"],
+                    "serve_max_seq": serve_knobs["max_seq"],
+                    "serve_kv_block": serve_knobs["kv_block"],
+                    "compile_cache_dir": "/app/.jax-cache",
+                }))
+    else:
+        with open(os.path.join(_ASSETS, "train_tpu.py"),
+                  encoding="utf-8") as f:
+            train_template = f.read()
+        container.add_file(
+            "train_tpu.py",
+            common.render_template(train_template, {
+                "source_entrypoint": entry_rel or "(unknown)",
+                "frameworks": ",".join(acc.frameworks) or "unknown",
+                "backend": acc.distributed_backend,
+                "gpu_count": acc.gpu_count,
+                "family": emit_family,
+                "tpu_accelerator": (acc.tpu_accelerator
+                                    or "tpu-v5-lite-podslice"),
+                "tpu_topology": acc.tpu_topology or "1x1",
+                "num_hosts": acc.num_hosts,
+                "mesh": mesh,
+                "zero_stage": degrees["zero_stage"],
+                "tensor_parallel": degrees["tensor_parallel"],
+                "seq_parallel": degrees["seq_parallel"],
+                "pipeline_parallel": degrees["pipeline_parallel"],
+                "expert_parallel": degrees["expert_parallel"],
+                "precision": precision,
+                "grad_accum": grad_accum,
+                "moe_experts": moe_experts,
+                # in-image default; pods that mount a durable volume point
+                # M2KT_COMPILE_CACHE_DIR at it to survive restarts
+                "compile_cache_dir": "/app/.jax-cache",
+                "steps": 100,
+                "lr": (3e-4 if family in ("llama", "gpt", "gpt2")
+                       else 1e-4 if family == "unet" else 1e-3),
+            }),
+        )
     with open(os.path.join(_ASSETS, "port_weights.py"), encoding="utf-8") as f:
         container.add_file(
             "port_weights.py",
@@ -329,7 +406,11 @@ def emit_container(service: PlanService, plan=None) -> Container:
         )
     _vendor_package(container)
     with open(os.path.join(_ASSETS, "Dockerfile"), encoding="utf-8") as f:
-        container.add_file("Dockerfile", f.read())
+        container.add_file(
+            "Dockerfile",
+            common.render_template(f.read(), {
+                "serve": serving, "serve_port": serve_port,
+            }))
     container.add_file("requirements.txt", REQUIREMENTS)
     container.add_file(
         f"{name}-docker-build.sh",
@@ -340,6 +421,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
             "context": ".",
         }),
     )
-    log.info("jax-xla: %s -> family=%s mesh=%s on %s/%s",
-             name, family, mesh.dims(), acc.tpu_accelerator, acc.tpu_topology)
+    log.info("jax-xla: %s -> family=%s %s mesh=%s on %s/%s",
+             name, family, "serve" if serving else "train", mesh.dims(),
+             acc.tpu_accelerator, acc.tpu_topology)
     return container

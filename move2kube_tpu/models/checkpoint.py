@@ -157,6 +157,36 @@ class CheckpointManager:
         self._mngr.close()
 
 
+def restore_variables(ckpt_dir: str, variables: dict) -> dict:
+    """Restore model weights into an inference ``variables`` pytree (the
+    serving entrypoint has no TrainState — just the model's init output).
+
+    Accepts the same checkpoint shapes the trainer writes: a full
+    TrainState (its ``params`` leaf is grafted) or a params-only dict from
+    ``port_weights.py``. Same corrupt-latest fallback as
+    ``restore_or_init``; with no restorable step the fresh variables come
+    back unchanged (loudly)."""
+    import orbax.checkpoint as ocp
+
+    mngr = _manager(ckpt_dir)
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                            {"params": variables["params"]})
+    steps = sorted(mngr.all_steps(), reverse=True)
+    for step in steps:
+        try:
+            restored = mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        except Exception as e:  # noqa: BLE001 - orbax raises many types
+            log.warning("checkpoint step %d unreadable (%s: %s)", step,
+                        type(e).__name__, e)
+            continue
+        log.info("serving weights restored from checkpoint step %d", step)
+        return {**variables, "params": restored["params"]}
+    if steps:
+        log.error("no retained checkpoint under %r is restorable; serving "
+                  "randomly initialized weights", ckpt_dir)
+    return variables
+
+
 def from_env(default_every: int = 100) -> CheckpointManager | None:
     """Build a manager from the env the TPU apiresources inject
     (M2KT_CKPT_DIR / M2KT_CKPT_EVERY); None when checkpointing is off."""

@@ -65,7 +65,7 @@ class GPT2Block(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None, return_kv=False):
         cfg = self.cfg
         b, s, d = x.shape
         head_dim = d // cfg.num_heads
@@ -80,7 +80,26 @@ class GPT2Block(nn.Module):
         q = q.reshape(b, s, cfg.num_heads, head_dim)
         k = k.reshape(b, s, cfg.num_heads, head_dim)
         v = v.reshape(b, s, cfg.num_heads, head_dim)
-        if cfg.attn_impl in ("ring", "ulysses"):
+        new_kv = (k, v) if return_kv else None
+        if cache is not None:
+            # single-token decode against the paged KV cache: write this
+            # token's K/V into its page, attend over the block table
+            # (models/llama.py LlamaBlock carries the same path; GPT-2 is
+            # MHA, so the kernel's GQA batching degenerates to rep=1)
+            from move2kube_tpu.ops.attention import paged_decode_attention
+
+            k_pages, v_pages = cache["k"], cache["v"]
+            block_size = k_pages.shape[1]
+            pos = cache["positions"]
+            blk = cache["block_tables"][jnp.arange(b), pos // block_size]
+            off = pos % block_size
+            k_pages = k_pages.at[blk, off].set(k[:, 0])
+            v_pages = v_pages.at[blk, off].set(v[:, 0])
+            o = paged_decode_attention(
+                q[:, 0], k_pages, v_pages, cache["block_tables"],
+                cache["seq_lens"]).reshape(b, 1, d)
+            new_kv = (k_pages, v_pages)
+        elif cfg.attn_impl in ("ring", "ulysses"):
             # shared dispatcher with the Llama stack (ring/ulysses run
             # under shard_map on the mesh's seq axis, degrading to flash
             # when that axis is trivial)
@@ -99,6 +118,8 @@ class GPT2Block(nn.Module):
             h = _maybe_shard(h, P(("data", "fsdp"), None, "tensor"))
         h = nn.gelu(h, approximate=True)  # HF gelu_new
         h = nn.Dense(d, dtype=cfg.dtype, name="mlp_out")(h)
+        if new_kv is not None:
+            return x + h, new_kv
         return x + h
 
 
@@ -106,19 +127,55 @@ class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, positions=None, cache=None,
+                 return_kv=False):
+        """Same three modes as models/llama.py ``Llama.__call__``:
+        full forward (default), prefill (``return_kv=True`` also returns
+        per-layer K/V), and paged single-token decode (``cache=`` with
+        ``input_ids``/``positions`` shaped ``[b]``)."""
         cfg = self.cfg
-        b, s = input_ids.shape
         wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                        name="wte")
         wpe = nn.Embed(cfg.n_positions, cfg.d_model, dtype=cfg.dtype,
                        name="wpe")
-        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        if cache is not None:
+            x = wte(input_ids[:, None]) + wpe(positions[:, None])
+            new_k, new_v = [], []
+            for i in range(cfg.num_layers):
+                layer_cache = {
+                    "k": cache["k"][i], "v": cache["v"][i],
+                    "block_tables": cache["block_tables"],
+                    "seq_lens": cache["seq_lens"],
+                    "positions": positions,
+                }
+                x, (kp, vp) = GPT2Block(cfg, name=f"h_{i}")(
+                    x, cache=layer_cache)
+                new_k.append(kp)
+                new_v.append(vp)
+            x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                             name="ln_f")(x)
+            logits = (x.astype(jnp.float32)
+                      @ wte.embedding.astype(jnp.float32).T)
+            out_cache = dict(cache)
+            out_cache["k"] = type(cache["k"])(new_k)
+            out_cache["v"] = type(cache["v"])(new_v)
+            return logits[:, 0], out_cache
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
         x = wte(input_ids) + wpe(positions)
+        kvs = []
         for i in range(cfg.num_layers):
-            x = GPT2Block(cfg, name=f"h_{i}")(x)
+            out = GPT2Block(cfg, name=f"h_{i}")(x, return_kv=return_kv)
+            if return_kv:
+                x, kv = out
+                kvs.append(kv)
+            else:
+                x = out
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
                          name="ln_f")(x)
         # LM head tied to the token embedding (HF GPT2LMHeadModel ties)
         logits = x.astype(jnp.float32) @ wte.embedding.astype(jnp.float32).T
+        if return_kv:
+            return logits, kvs
         return logits

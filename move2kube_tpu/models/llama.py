@@ -138,7 +138,7 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, mask):
+    def __call__(self, x, positions, mask, cache=None, return_kv=False):
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.num_heads
         q_size = cfg.num_heads * head_dim
@@ -157,11 +157,32 @@ class LlamaBlock(nn.Module):
         v = v.reshape(b, s, cfg.num_kv_heads, head_dim)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        # GQA: repeat KV heads up to the query head count
-        rep = cfg.num_heads // cfg.num_kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-        o = _attention(q, k, v, mask, cfg.attn_impl).reshape(b, s, q_size)
+        new_kv = (k, v) if return_kv else None
+        if cache is not None:
+            # single-token decode against the paged KV cache (serving):
+            # write this token's K/V into its page, then attend over the
+            # pages named by the block table. No GQA repeat here — the
+            # paged kernel batches query heads per KV head itself.
+            from move2kube_tpu.ops.attention import paged_decode_attention
+
+            k_pages, v_pages = cache["k"], cache["v"]
+            block_size = k_pages.shape[1]
+            pos = positions[:, 0]
+            slot = jnp.arange(b)
+            blk = cache["block_tables"][slot, pos // block_size]
+            off = pos % block_size
+            k_pages = k_pages.at[blk, off].set(k[:, 0])
+            v_pages = v_pages.at[blk, off].set(v[:, 0])
+            o = paged_decode_attention(
+                q[:, 0], k_pages, v_pages, cache["block_tables"],
+                cache["seq_lens"]).reshape(b, 1, q_size)
+            new_kv = (k_pages, v_pages)
+        else:
+            # GQA: repeat KV heads up to the query head count
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            o = _attention(q, k, v, mask, cfg.attn_impl).reshape(b, s, q_size)
         # row-split output projection: XLA inserts the tensor-axis psum here
         o = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype, name="attn_out")(o)
         x = x + o
@@ -175,6 +196,8 @@ class LlamaBlock(nn.Module):
                             name="moe")(h)
             # surfaced to the trainer via mutable=["losses"] (train.py)
             self.sow("losses", "moe_aux", aux)
+            if new_kv is not None:
+                return x + h, new_kv
             return x + h
         # fused gate+up, column-split
         gate_up = nn.Dense(2 * cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
@@ -185,6 +208,8 @@ class LlamaBlock(nn.Module):
         h = nn.silu(gate) * up
         # row-split down projection (tensor-axis psum)
         h = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype, name="down")(h)
+        if new_kv is not None:
+            return x + h, new_kv
         return x + h
 
 
@@ -192,18 +217,65 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, positions=None, cache=None,
+                 return_kv=False):
+        """Three modes, one parameter tree:
+
+        - training / full forward (default): ``(input_ids[b, s]) -> logits``
+        - prefill (``return_kv=True``): also returns the per-layer rotary-
+          embedded K/V ``[(k, v), ...]`` (``[b, s, kv_heads, head_dim]``,
+          pre-GQA-repeat) for the serving layer to scatter into its paged
+          cache
+        - decode (``cache=``): ``input_ids`` is ``[b]`` — ONE new token per
+          slot at ``positions`` ``[b]``; ``cache`` is the paged-KV pytree
+          (serving/kvcache.py) whose ``k``/``v`` are per-layer page lists.
+          Returns ``(logits[b, vocab], updated_cache)``.
+        """
         cfg = self.cfg
+        if cache is not None:
+            b = input_ids.shape[0]
+            x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                         name="embed")(input_ids[:, None])
+            pos2d = positions[:, None]
+            new_k, new_v = [], []
+            for i in range(cfg.num_layers):
+                layer_cache = {
+                    "k": cache["k"][i], "v": cache["v"][i],
+                    "block_tables": cache["block_tables"],
+                    "seq_lens": cache["seq_lens"],
+                }
+                x, (kp, vp) = LlamaBlock(cfg, name=f"layer_{i}")(
+                    x, pos2d, None, cache=layer_cache)
+                new_k.append(kp)
+                new_v.append(vp)
+            x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+            logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                              dtype=jnp.float32,
+                              name="lm_head")(x.astype(jnp.float32))
+            out_cache = dict(cache)
+            out_cache["k"] = type(cache["k"])(new_k)
+            out_cache["v"] = type(cache["v"])(new_v)
+            return logits[:, 0], out_cache
         b, s = input_ids.shape
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      name="embed")(input_ids)
-        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
         causal = jnp.where(
             jnp.arange(s)[:, None] >= jnp.arange(s)[None, :], 0.0, -1e30
         ).astype(jnp.float32)[None, None]
+        kvs = []
         for i in range(cfg.num_layers):
-            x = LlamaBlock(cfg, name=f"layer_{i}")(x, positions, causal)
+            out = LlamaBlock(cfg, name=f"layer_{i}")(
+                x, positions, causal, return_kv=return_kv)
+            if return_kv:
+                x, kv = out
+                kvs.append(kv)
+            else:
+                x = out
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x.astype(jnp.float32))
+        if return_kv:
+            return logits, kvs
         return logits

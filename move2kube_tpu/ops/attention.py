@@ -579,3 +579,169 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None
                 "pallas flash attention failed (%s: %s); falling back to "
                 "jnp reference attention", type(e).__name__, e)
     return _reference_attention(q, k, v, causal, scale)
+
+
+# --------------------------------------------------------------------------
+# Paged decode attention (serving hot path)
+#
+# Single-token decode over a paged KV cache (serving/kvcache.py): K/V live
+# in fixed-size pages, a per-sequence block table says which pages hold its
+# context, and every step attends one new query token per sequence against
+# that context. The Pallas kernel streams pages straight out of the cache
+# via scalar-prefetched block-table indices (pallas_guide.md
+# "PrefetchScalarGridSpec": index maps may read prefetched scalars, so no
+# [batch, max_seq] gather ever materializes); off TPU the jnp fallback
+# gathers pages with XLA and masks by sequence length — identical math.
+# Inference-only: no vjp, no residuals.
+# --------------------------------------------------------------------------
+
+
+def _paged_decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
+                            scale: float):
+    b, h, d = q.shape
+    _, block_size, kvh, _ = k_pages.shape
+    mb = block_tables.shape[1]
+    # gather each sequence's pages into a contiguous context
+    k = k_pages[block_tables].reshape(b, mb * block_size, kvh, d)
+    v = v_pages[block_tables].reshape(b, mb * block_size, kvh, d)
+    rep = h // kvh
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    valid = (jnp.arange(mb * block_size)[None, None, :]
+             < seq_lens[:, None, None])
+    s = jnp.where(valid, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", p.astype(v.dtype), v)
+
+
+def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, block_size: int,
+                         rep: int, scale: float):
+    """One (sequence, page) grid cell: the page's K/V tile was staged into
+    VMEM by the scalar-prefetched index map, so the body is pure online
+    softmax. Scratch (acc/m/l) persists across the sequential page axis;
+    pages at or past the sequence length are skipped (their table entries
+    point at page 0, which the allocator reserves)."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    seq_len = sl_ref[i]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * block_size < seq_len)
+    def _page():
+        h, d = q_ref.shape
+        kvh = h // rep
+        q = q_ref[:].astype(jnp.float32) * scale          # [h, d]
+        k = k_ref[:].astype(jnp.float32)                  # [bs, kvh, d]
+        v = v_ref[:].astype(jnp.float32)
+        # GQA: each kv head serves `rep` query heads — batch the dot over
+        # the kv-head axis instead of materializing repeated K/V
+        qh = q.reshape(kvh, rep, d)
+        kT = k.transpose(1, 0, 2)                         # [kvh, bs, d]
+        s = jax.lax.dot_general(
+            qh, kT, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # [kvh, rep, bs]
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2)
+        s = jnp.where(pos < seq_len, s, _NEG_INF).reshape(h, block_size)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)                            # [h, bs]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        vh = v.transpose(1, 0, 2)                         # [kvh, bs, d]
+        pv = jax.lax.dot_general(
+            p.reshape(kvh, rep, block_size), vh,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # [kvh, rep, d]
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(h, d)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[:] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_decode_tpu(q, k_pages, v_pages, block_tables, seq_lens,
+                      scale: float, interpret: bool | None = None):
+    """Pallas paged-decode: grid (batch, pages-per-sequence); the K/V page
+    for cell (i, j) is selected by ``block_tables[i, j]`` inside the
+    BlockSpec index map (scalar prefetch), so only live pages are DMA'd."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _INTERPRET
+    b, h, d = q.shape
+    _, block_size, kvh, _ = k_pages.shape
+    mb = block_tables.shape[1]
+    rep = h // kvh
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((None, h, d), lambda i, j, bt, sl: (i, 0, 0)),
+            pl.BlockSpec((None, block_size, kvh, d),
+                         lambda i, j, bt, sl: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((None, block_size, kvh, d),
+                         lambda i, j, bt, sl: (bt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, h, d), lambda i, j, bt, sl: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, _LANES), jnp.float32),
+            pltpu.VMEM((h, _LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_kernel, block_size=block_size,
+                          rep=rep, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(block_tables, seq_lens, q, k_pages, v_pages)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
+                           scale: float | None = None):
+    """Decode-step attention against a paged KV cache. GQA-aware.
+
+    - ``q``: [batch, heads, head_dim] — ONE new query token per slot
+    - ``k_pages``/``v_pages``: [num_pages, block_size, kv_heads, head_dim]
+    - ``block_tables``: [batch, max_pages_per_seq] int32 page indices
+      (unused entries must point at page 0, reserved by the allocator)
+    - ``seq_lens``: [batch] int32 valid-token counts, INCLUDING the token
+      being decoded (its K/V must already be written to the cache)
+
+    TPU with a lane-aligned head_dim takes the Pallas kernel; anything
+    else (CPU tests, odd shapes) the jnp gather fallback.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    d = q.shape[-1]
+    block_size = k_pages.shape[1]
+    if (jax.default_backend() == "tpu" and d % 128 == 0
+            and block_size % 8 == 0):
+        try:
+            return _paged_decode_tpu(q, k_pages, v_pages, block_tables,
+                                     seq_lens, scale)
+        except Exception as e:  # noqa: BLE001 - fall back rather than fail
+            logging.getLogger(__name__).warning(
+                "pallas paged decode failed (%s: %s); falling back to jnp "
+                "reference", type(e).__name__, e)
+    return _paged_decode_reference(q, k_pages, v_pages, block_tables,
+                                   seq_lens, scale)

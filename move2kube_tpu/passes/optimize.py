@@ -133,8 +133,8 @@ def tpu_training_optimizer(ir: IR) -> IR:
 
     for svc in ir.services.values():
         acc = getattr(svc, "accelerator", None)
-        if acc is None:
-            continue
+        if acc is None or getattr(acc, "serving", False):
+            continue  # serving services get the serving knobs instead
         name = common.make_dns_label(svc.name)
         family = getattr(acc, "model_family", "") or "generic"
         default_precision = ("bf16" if family in ("llama", "gpt", "gpt2",
@@ -167,6 +167,48 @@ def tpu_training_optimizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_serving_optimizer(ir: IR) -> IR:
+    """Bake the serving capacity knobs into accelerated serving services'
+    pod env. Same QA ids as the jax-xla emitter's ``_ask_serving_knobs``
+    (``m2kt.services.<name>.serve.maxbatch`` / ``.maxseq`` / ``.kvblock``)
+    — answered once, cached, so the emitted server's baked-in defaults and
+    the YAML's explicit env always agree. The Knative apiresource reads
+    ``M2KT_SERVE_MAX_BATCH`` back to set the revision's
+    containerConcurrency. Existing env entries are never overwritten."""
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None or not getattr(acc, "serving", False):
+            continue
+        name = common.make_dns_label(svc.name)
+        knobs = {}
+        for env_name, qid, desc, default in (
+            ("M2KT_SERVE_MAX_BATCH", "serve.maxbatch",
+             "Enter the max concurrent decode batch for [{name}]", "8"),
+            ("M2KT_SERVE_MAX_SEQ", "serve.maxseq",
+             "Enter the max context length (prompt + generation) for "
+             "[{name}]", "2048"),
+            ("M2KT_KV_BLOCK_SIZE", "serve.kvblock",
+             "Enter the paged KV cache block size (tokens/page) for "
+             "[{name}]", "16"),
+        ):
+            raw = qa.fetch_input(
+                f"m2kt.services.{name}.{qid}", desc.format(name=name),
+                ["bounds compiled shapes and HBM footprint of the serving "
+                 "engine's paged KV cache"],
+                default)
+            try:
+                knobs[env_name] = str(max(1, int(raw)))
+            except (TypeError, ValueError):
+                knobs[env_name] = default
+        for container in svc.containers:
+            env = container.setdefault("env", [])
+            existing = {e.get("name") for e in env}
+            for env_name, value in knobs.items():
+                if env_name not in existing:
+                    env.append({"name": env_name, "value": value})
+    return ir
+
+
 OPTIMIZERS = [
     normalize_character_optimizer,
     ingress_optimizer,
@@ -174,6 +216,7 @@ OPTIMIZERS = [
     image_pull_policy_optimizer,
     port_merge_optimizer,
     tpu_training_optimizer,
+    tpu_serving_optimizer,
 ]
 
 

@@ -75,8 +75,34 @@ def tpu_training_parameterizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_serving_parameterizer(ir: IR) -> IR:
+    """Lift the serving capacity knobs the serving optimizer injected
+    (``M2KT_SERVE_MAX_BATCH`` / ``M2KT_SERVE_MAX_SEQ`` /
+    ``M2KT_KV_BLOCK_SIZE``) into chart values, so a Helm install resizes
+    the decode batch, context length, and KV page size per environment
+    (``--set tpuservemaxbatch=16``) without touching the manifests. Same
+    first-service-seeds-defaults shape as the training parameterizer."""
+    lifted = {"M2KT_SERVE_MAX_BATCH": "tpuservemaxbatch",
+              "M2KT_SERVE_MAX_SEQ": "tpuservemaxseq",
+              "M2KT_KV_BLOCK_SIZE": "tpukvblocksize"}
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None or not getattr(acc, "serving", False):
+            continue
+        for container in svc.containers:
+            for env in container.get("env", []) or []:
+                key = lifted.get(env.get("name"))
+                value = env.get("value")
+                if not key or value is None or "{{" in str(value):
+                    continue
+                ir.values.global_variables.setdefault(key, str(value))
+                env["value"] = f"{{{{ .Values.{key} }}}}"
+    return ir
+
+
 PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
-                  storage_class_parameterizer, tpu_training_parameterizer]
+                  storage_class_parameterizer, tpu_training_parameterizer,
+                  tpu_serving_parameterizer]
 
 
 def parameterize(ir: IR) -> IR:

@@ -1,0 +1,30 @@
+"""TPU serving hot path: paged KV cache + continuous batching.
+
+The training side of this repo moves detected GPU workloads onto TPU
+JobSets; this package is the *serving* half of the story (reference
+Move2Kube emits Knative Service YAML as a first-class target). It makes
+the translated decoder LMs fast to serve:
+
+- :mod:`move2kube_tpu.serving.kvcache` — fixed-size-page KV cache with a
+  per-sequence block table, donated across decode steps so it stays
+  device-resident;
+- :mod:`move2kube_tpu.serving.engine` — continuous batching: admit and
+  finish sequences mid-flight, interleave prefill with decode, bucket
+  prompt lengths so the compiled-executable count stays bounded.
+
+Vendored into emitted serving images alongside ``models``/``ops`` —
+keep it free of imports on the QA/YAML half of the repo.
+"""
+
+from move2kube_tpu.serving.engine import (  # noqa: F401
+    EngineConfig,
+    Request,
+    ServingEngine,
+)
+from move2kube_tpu.serving.kvcache import (  # noqa: F401
+    KVCacheConfig,
+    PageAllocator,
+    init_cache,
+    pages_for,
+    spec_for_model,
+)

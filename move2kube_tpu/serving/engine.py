@@ -1,0 +1,394 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+Static-batch serving wastes the accelerator twice: short sequences pad
+to the longest, and a finished sequence's slot idles until the whole
+batch drains. This engine runs vLLM-style continuous batching instead —
+sequences are admitted into free decode slots mid-flight (one prefill
+interleaved per decode step, so running sequences never stall behind an
+admission burst) and release their slot and pages the step they finish.
+
+Compiled-shape discipline: everything the device executes comes from TWO
+jit functions — a bucketed prefill (prompts pad to the smallest
+configured bucket that fits, so at most ``len(buckets)`` executables)
+and a fixed-shape decode step (one executable). A mixed-length request
+stream therefore compiles at most ``num_buckets + 1`` distinct
+executables; the serve smoke asserts ``<= num_buckets + 2`` through the
+persistent compile cache (models/compile_cache.py) to leave headroom for
+one backend-initiated recompile.
+
+Env knobs (docs/USAGE.md):
+
+- ``M2KT_SERVE_MAX_BATCH``  concurrent decode slots   (default 8)
+- ``M2KT_SERVE_MAX_SEQ``    max context per sequence  (default 256)
+- ``M2KT_KV_BLOCK_SIZE``    tokens per KV-cache page  (default 16)
+- ``M2KT_SERVE_BUCKETS``    prefill buckets, comma-sep (default: powers
+  of two from 32 up to max_seq)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from move2kube_tpu.serving import kvcache
+from move2kube_tpu.serving.kvcache import (
+    NULL_PAGE,
+    PageAllocator,
+    init_cache,
+    pages_for,
+    scatter_prefill,
+    spec_for_model,
+)
+
+
+def _default_buckets(max_seq: int) -> tuple[int, ...]:
+    buckets, b = [], 32
+    while b < max_seq:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_seq)
+    return tuple(buckets)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    max_batch: int = 8
+    max_seq: int = 256
+    block_size: int = 16
+    buckets: tuple[int, ...] = ()
+    max_new_tokens: int = 32   # per-request default
+    eos_id: int | None = None
+
+    def resolved_buckets(self) -> tuple[int, ...]:
+        buckets = self.buckets or _default_buckets(self.max_seq)
+        buckets = tuple(sorted(set(min(b, self.max_seq) for b in buckets)))
+        if buckets[-1] < self.max_seq:
+            buckets = buckets + (self.max_seq,)
+        return buckets
+
+    @classmethod
+    def from_env(cls, **overrides) -> "EngineConfig":
+        def _int(name, default):
+            try:
+                return int(os.environ.get(name, "") or default)
+            except ValueError:
+                return default
+
+        buckets: tuple[int, ...] = ()
+        raw = os.environ.get("M2KT_SERVE_BUCKETS", "")
+        if raw:
+            try:
+                buckets = tuple(int(x) for x in raw.split(",") if x.strip())
+            except ValueError:
+                buckets = ()
+        cfg = dict(
+            max_batch=_int("M2KT_SERVE_MAX_BATCH", cls.max_batch),
+            max_seq=_int("M2KT_SERVE_MAX_SEQ", cls.max_seq),
+            block_size=_int("M2KT_KV_BLOCK_SIZE", cls.block_size),
+            buckets=buckets,
+        )
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+@dataclasses.dataclass
+class Request:
+    rid: str
+    prompt: list[int]
+    max_new_tokens: int | None = None
+
+
+@dataclasses.dataclass
+class Completion:
+    rid: str
+    prompt_len: int
+    tokens: list[int]
+    finish_reason: str  # "eos" | "length"
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request
+    pages: list[int]
+    tokens: list[int]
+    last_token: int
+    max_new: int
+
+
+class ServingEngine:
+    """Greedy-decoding continuous-batching engine for the repo's decoder
+    LMs (models/llama.py, models/gpt2.py — anything whose ``__call__``
+    carries the prefill/decode modes).
+
+    ``variables`` is the model's full init output (``{"params": ...}``);
+    only the KV cache is donated, parameters stay shared across steps.
+    """
+
+    def __init__(self, model, variables, config: EngineConfig | None = None):
+        self.model = model
+        self.variables = variables
+        self.config = config or EngineConfig.from_env()
+        self.buckets = self.config.resolved_buckets()
+        self.cache_cfg = spec_for_model(
+            model.cfg, block_size=self.config.block_size,
+            max_batch=self.config.max_batch, max_seq=self.config.max_seq)
+        self._cache = init_cache(self.cache_cfg)
+        self._allocator = PageAllocator(self.cache_cfg.num_pages)
+        self._slots: list[_Slot | None] = [None] * self.config.max_batch
+        self._pending: deque[Request] = deque()
+        self._prefill = self._make_prefill()
+        self._decode = self._make_decode()
+        # decode stats for the bench phase (tokens/s, p50/p95 per token)
+        self._decode_time = 0.0
+        self._decode_tokens = 0
+        self._step_latencies: list[float] = []
+        self._prefill_count = 0
+        self._snapshot_persistent_cache()
+
+    # ------------------------------------------------------------------
+    # jitted device steps (the ONLY code that runs on the accelerator)
+    # ------------------------------------------------------------------
+
+    def _make_prefill(self):
+        model, block_size = self.model, self.cache_cfg.block_size
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def prefill(variables, cache, ids, bt_row, slot, prompt_len):
+            logits, kvs = model.apply(variables, ids, return_kv=True)
+            cache = scatter_prefill(cache, kvs, slot, bt_row, prompt_len,
+                                    block_size)
+            first = jnp.argmax(logits[0, prompt_len - 1]).astype(jnp.int32)
+            return first, logits[0], cache
+
+        return prefill
+
+    def _make_decode(self):
+        model = self.model
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def decode(variables, cache, tokens, active):
+            # sanitize freed/idle slots: their stale tables must not write
+            # into pages the allocator may have handed to someone else —
+            # redirect them to the reserved null page
+            bt = jnp.where(active[:, None], cache["block_tables"], NULL_PAGE)
+            pos = jnp.where(active, cache["seq_lens"], 0)
+            model_cache = {"k": cache["k"], "v": cache["v"],
+                           "block_tables": bt, "seq_lens": pos + 1}
+            logits, model_cache = model.apply(
+                variables, tokens, positions=pos, cache=model_cache)
+            new_cache = {
+                "k": model_cache["k"], "v": model_cache["v"],
+                "block_tables": cache["block_tables"],
+                "seq_lens": cache["seq_lens"] + active.astype(jnp.int32),
+            }
+            next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return logits, next_tokens, new_cache
+
+        return decode
+
+    # ------------------------------------------------------------------
+    # host-side continuous batching
+    # ------------------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        plen = len(req.prompt)
+        max_new = req.max_new_tokens or self.config.max_new_tokens
+        if plen < 1:
+            raise ValueError(f"{req.rid}: empty prompt")
+        if plen > self.buckets[-1]:
+            raise ValueError(
+                f"{req.rid}: prompt length {plen} exceeds the largest "
+                f"prefill bucket {self.buckets[-1]}")
+        if plen + max_new > self.cache_cfg.max_seq:
+            raise ValueError(
+                f"{req.rid}: prompt + max_new_tokens = {plen + max_new} "
+                f"exceeds max_seq {self.cache_cfg.max_seq}")
+        self._pending.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or any(
+            s is not None for s in self._slots)
+
+    def step(self) -> list[Completion]:
+        """One engine iteration: admit at most one pending request
+        (bucketed prefill), then run one decode step for every active
+        slot. Returns the sequences that finished this iteration."""
+        finished = self._admit_one()
+        active_mask = np.array([s is not None for s in self._slots])
+        if not active_mask.any():
+            return finished
+        tokens = np.array(
+            [s.last_token if s else 0 for s in self._slots], np.int32)
+        t0 = time.perf_counter()
+        _, next_tokens, cache = self._decode(
+            self.variables, self._cache, tokens, active_mask)
+        next_tokens = np.asarray(next_tokens)  # blocks until ready
+        dt = time.perf_counter() - t0
+        self._cache = cache
+        produced = int(active_mask.sum())
+        self._decode_time += dt
+        self._decode_tokens += produced
+        self._step_latencies.append(dt)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            tok = int(next_tokens[i])
+            slot.tokens.append(tok)
+            slot.last_token = tok
+            done = self._finish_reason(slot, tok)
+            if done:
+                finished.append(self._release(i, done))
+        return finished
+
+    def run(self, requests) -> list[Completion]:
+        for req in requests:
+            self.submit(req)
+        completions: list[Completion] = []
+        stall = 0
+        while self.has_work():
+            got = self.step()
+            completions.extend(got)
+            if not got and not any(s is not None for s in self._slots):
+                stall += 1
+                if stall > self.config.max_batch + 1:
+                    raise RuntimeError(
+                        "engine stalled: pending requests cannot be "
+                        "admitted (page pool too small?)")
+            else:
+                stall = 0
+        return completions
+
+    def _finish_reason(self, slot: _Slot, tok: int) -> str | None:
+        if self.config.eos_id is not None and tok == self.config.eos_id:
+            return "eos"
+        if len(slot.tokens) >= slot.max_new:
+            return "length"
+        return None
+
+    def _release(self, slot_idx: int, reason: str) -> Completion:
+        slot = self._slots[slot_idx]
+        self._allocator.free(slot.pages)
+        self._slots[slot_idx] = None
+        return Completion(rid=slot.req.rid, prompt_len=len(slot.req.prompt),
+                          tokens=list(slot.tokens), finish_reason=reason)
+
+    def _bucket_for(self, plen: int) -> int:
+        for b in self.buckets:
+            if plen <= b:
+                return b
+        raise ValueError(f"no bucket fits prompt length {plen}")
+
+    def _admit_one(self) -> list[Completion]:
+        if not self._pending:
+            return []
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            return []
+        req = self._pending[0]
+        plen = len(req.prompt)
+        max_new = req.max_new_tokens or self.config.max_new_tokens
+        pages = self._allocator.alloc(
+            pages_for(plen + max_new, self.cache_cfg.block_size))
+        if pages is None:
+            return []  # wait for running sequences to free pages
+        self._pending.popleft()
+        slot_idx = free[0]
+        bucket = self._bucket_for(plen)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :plen] = req.prompt
+        bt_row = np.full((self.cache_cfg.max_pages_per_seq,), NULL_PAGE,
+                         np.int32)
+        bt_row[:len(pages)] = pages
+        first, _, cache = self._prefill(
+            self.variables, self._cache, ids, bt_row,
+            np.int32(slot_idx), np.int32(plen))
+        self._cache = cache
+        self._prefill_count += 1
+        tok = int(first)
+        slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
+                     max_new=max_new)
+        self._slots[slot_idx] = slot
+        done = self._finish_reason(slot, tok)
+        if done:
+            return [self._release(slot_idx, done)]
+        return []
+
+    # ------------------------------------------------------------------
+    # verification + stats
+    # ------------------------------------------------------------------
+
+    def verify_cache_donated(self) -> int:
+        """Compile the decode step and assert the KV pages really alias
+        into the outputs (device-resident across steps). Returns the
+        alias count."""
+        tokens = np.zeros((self.config.max_batch,), np.int32)
+        active = np.zeros((self.config.max_batch,), bool)
+        return kvcache.assert_cache_donated(
+            self._decode, self.variables, self._cache, tokens, active,
+            num_layers=self.cache_cfg.num_layers)
+
+    def _snapshot_persistent_cache(self) -> None:
+        self._cache_dir = None
+        self._cache_dir_before: set[str] = set()
+        try:
+            path = jax.config.jax_compilation_cache_dir
+        except AttributeError:
+            return
+        if path and os.path.isdir(path):
+            self._cache_dir = path
+            self._cache_dir_before = set(os.listdir(path))
+
+    def persistent_cache_new_entries(self) -> int | None:
+        """Executables added to the persistent compile cache since this
+        engine was built (None when no cache dir is configured). The
+        serve smoke bounds this by num_buckets + 2."""
+        if not self._cache_dir or not os.path.isdir(self._cache_dir):
+            return None
+        return len(set(os.listdir(self._cache_dir))
+                   - self._cache_dir_before)
+
+    def compile_report(self) -> dict:
+        def cache_size(fn) -> int:
+            try:
+                return int(fn._cache_size())
+            except Exception:  # noqa: BLE001 - jax internals shifted
+                return -1
+
+        report = {
+            "num_buckets": len(self.buckets),
+            "prefill_executables": cache_size(self._prefill),
+            "decode_executables": cache_size(self._decode),
+            "persistent_cache_new_entries":
+                self.persistent_cache_new_entries(),
+        }
+        if (report["prefill_executables"] >= 0
+                and report["decode_executables"] >= 0):
+            report["total_executables"] = (report["prefill_executables"]
+                                           + report["decode_executables"])
+        return report
+
+    def stats(self) -> dict:
+        lat = sorted(self._step_latencies)
+
+        def pct(p: float) -> float:
+            if not lat:
+                return 0.0
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        return {
+            "decode_steps": len(self._step_latencies),
+            "decode_tokens": self._decode_tokens,
+            "prefills": self._prefill_count,
+            "decode_throughput_tokens_s": (
+                self._decode_tokens / self._decode_time
+                if self._decode_time else 0.0),
+            "decode_p50_latency_ms": pct(0.50) * 1e3,
+            "decode_p95_latency_ms": pct(0.95) * 1e3,
+        }

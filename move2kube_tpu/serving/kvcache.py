@@ -1,0 +1,166 @@
+"""Paged KV cache: fixed-size pages + per-sequence block tables.
+
+The decode hot path's memory problem is fragmentation: contiguous
+per-sequence KV buffers sized for the max context waste HBM on every
+short sequence and force compaction when sequences finish mid-flight.
+Pages fix both — the cache is a pool of ``[block_size]``-token pages per
+layer, a sequence owns whichever pages the host-side
+:class:`PageAllocator` hands it, and an int32 block table maps its
+logical positions onto them. Finishing a sequence returns its pages to
+the free list; nothing moves.
+
+Page 0 is **reserved** (the "null page"): unused block-table entries and
+padded prompt positions all point at it, so scatter/gather index math
+needs no bounds branches inside jit — garbage lands in, and masked reads
+come from, a page no live sequence owns.
+
+The cache pytree is donated across decode steps (``donate_argnums``), so
+K/V pages stay device-resident and are updated in place. Donation is a
+*request*, not a guarantee — :func:`assert_cache_donated` compiles the
+step and counts the executable's input-output aliases, the same
+verification the PR-1 trainer uses (models/train.assert_state_donated).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+NULL_PAGE = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    block_size: int = 16        # tokens per page
+    num_pages: int = 65         # pool size, INCLUDING the reserved page 0
+    max_batch: int = 8          # concurrent decode slots
+    max_pages_per_seq: int = 16  # block-table row length
+    dtype: Any = jnp.float32
+
+    @property
+    def max_seq(self) -> int:
+        return self.max_pages_per_seq * self.block_size
+
+
+def spec_for_model(model_cfg, *, block_size: int = 16, max_batch: int = 8,
+                   max_seq: int | None = None,
+                   num_pages: int | None = None) -> KVCacheConfig:
+    """Cache geometry for a model config (LlamaConfig or GPT2Config,
+    duck-typed: MHA models have no ``num_kv_heads``). ``num_pages``
+    defaults to one full-length context per slot plus the null page."""
+    num_kv_heads = getattr(model_cfg, "num_kv_heads", model_cfg.num_heads)
+    head_dim = model_cfg.d_model // model_cfg.num_heads
+    if max_seq is None:
+        max_seq = getattr(model_cfg, "max_len", None) or getattr(
+            model_cfg, "n_positions")
+    max_pages = -(-max_seq // block_size)
+    if num_pages is None:
+        num_pages = 1 + max_batch * max_pages
+    return KVCacheConfig(
+        num_layers=model_cfg.num_layers, num_kv_heads=num_kv_heads,
+        head_dim=head_dim, block_size=block_size, num_pages=num_pages,
+        max_batch=max_batch, max_pages_per_seq=max_pages,
+        dtype=model_cfg.dtype)
+
+
+def pages_for(n_tokens: int, block_size: int) -> int:
+    return -(-int(n_tokens) // int(block_size))
+
+
+def init_cache(cfg: KVCacheConfig) -> dict:
+    """Zeroed device cache pytree. ``k``/``v`` are per-layer *lists* of
+    page pools — 2·num_layers separate buffers, so every one of them gets
+    its own input-output alias when the decode step donates the pytree
+    (a single stacked array would leave aliasing of the per-layer
+    dynamic-update-slices to XLA's discretion)."""
+    shape = (cfg.num_pages, cfg.block_size, cfg.num_kv_heads, cfg.head_dim)
+    return {
+        "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.num_layers)],
+        "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.num_layers)],
+        "block_tables": jnp.zeros((cfg.max_batch, cfg.max_pages_per_seq),
+                                  jnp.int32),
+        "seq_lens": jnp.zeros((cfg.max_batch,), jnp.int32),
+    }
+
+
+def scatter_prefill(cache: dict, kvs, slot, bt_row, prompt_len,
+                    block_size: int) -> dict:
+    """Write a prefilled prompt's per-layer K/V into the paged cache
+    (jit-safe — runs inside the bucketed prefill step).
+
+    ``kvs``: the ``return_kv=True`` output of the model's full forward,
+    one ``(k, v)`` pair per layer shaped ``[1, bucket, kv_heads, hd]``.
+    ``bt_row``: this sequence's page table ``[max_pages_per_seq]`` (pads
+    with the null page). Positions past ``prompt_len`` (bucket padding)
+    are redirected to the null page. Also installs the row and the
+    sequence length into the cache's table.
+    """
+    bucket = kvs[0][0].shape[1]
+    pos = jnp.arange(bucket)
+    blk = jnp.where(pos < prompt_len, bt_row[pos // block_size], NULL_PAGE)
+    off = pos % block_size
+    new_k, new_v = [], []
+    for layer, (k, v) in enumerate(kvs):
+        new_k.append(cache["k"][layer].at[blk, off].set(k[0]))
+        new_v.append(cache["v"][layer].at[blk, off].set(v[0]))
+    out = dict(cache)
+    out["k"], out["v"] = new_k, new_v
+    out["block_tables"] = cache["block_tables"].at[slot].set(bt_row)
+    out["seq_lens"] = cache["seq_lens"].at[slot].set(prompt_len)
+    return out
+
+
+class PageAllocator:
+    """Host-side free list over the page pool. Page 0 never leaves the
+    reserve. Allocation is all-or-nothing: a request that cannot get
+    every page it needs gets none (the engine keeps it queued instead of
+    deadlocking half-admitted)."""
+
+    def __init__(self, num_pages: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self._free = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if p == NULL_PAGE:
+                raise ValueError("page 0 is reserved and never allocated")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+
+
+def assert_cache_donated(step_fn, *args, num_layers: int,
+                         min_aliased: int | None = None) -> int:
+    """Compile ``step_fn(*args)`` and assert the executable aliases at
+    least ``min_aliased`` input buffers into outputs (default: the
+    2·num_layers K/V page pools). Same executable-text check as
+    models/train.compiled_alias_count — donate_argnums alone proves
+    nothing."""
+    from move2kube_tpu.models.train import compiled_alias_count
+
+    if not hasattr(step_fn, "lower"):
+        raise TypeError("step_fn is not jit-compiled (no .lower); donation "
+                        "cannot be verified")
+    compiled = step_fn.lower(*args).compile()
+    n = compiled_alias_count(compiled.as_text())
+    floor = 2 * num_layers if min_aliased is None else min_aliased
+    if n < floor:
+        raise AssertionError(
+            f"compiled decode step aliases only {n} input buffers; expected "
+            f">= {floor} — the KV cache is being copied, not donated")
+    return n

@@ -42,10 +42,14 @@ def get_source_loaders() -> list[Translator]:
     return [
         ComposeTranslator(),
         CfManifestTranslator(),
+        # before Dockerfile2Kube: a GPU source tree's CUDA Dockerfile must
+        # not be reused verbatim (it pins the workload to GPU nodes) — the
+        # GPU2TPU option has to be the default for such dirs, and the
+        # Dockerfile option stays available as an alternative answer
+        Gpu2TpuTranslator(),
         DockerfileTranslator(),
         KubeTranslator(),
         KnativeTranslator(),
-        Gpu2TpuTranslator(),  # claims GPU training dirs before the fallback
         Any2KubeTranslator(),
     ]
 

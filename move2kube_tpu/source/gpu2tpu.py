@@ -129,9 +129,10 @@ class Gpu2TpuTranslator(Translator):
             report = gpu_detect.analyze_directory(absdir)
             if report is None:
                 continue
-            # claim the smallest directory containing the training code: if
+            scripts = report.training_scripts or report.serving_scripts
+            # claim the smallest directory containing the workload code: if
             # everything lives under one child, keep walking into it instead
-            script_home = common.find_common_directory(report.training_scripts)
+            script_home = common.find_common_directory(scripts)
             if script_home and os.path.abspath(script_home) != absdir:
                 if os.path.isfile(script_home):
                     script_home = os.path.dirname(script_home)
@@ -141,11 +142,11 @@ class Gpu2TpuTranslator(Translator):
             # independently valid GPU workload, descend so sibling
             # trainings become separate services instead of one merged one
             if not any(os.path.dirname(os.path.abspath(s)) == absdir
-                       for s in report.training_scripts):
+                       for s in scripts):
                 kids = {
                     os.path.join(absdir, os.path.relpath(
                         os.path.abspath(s), absdir).split(os.sep)[0])
-                    for s in report.training_scripts
+                    for s in scripts
                 }
                 if len(kids) > 1 and all(
                     gpu_detect.analyze_directory(k) is not None for k in kids
@@ -190,15 +191,27 @@ class Gpu2TpuTranslator(Translator):
                 container.accelerator = plan_svc.accelerator
             ir.add_container(container)
             svc = irtypes.service_from_plan(plan_svc)
-            svc.job = True  # run-to-completion training workload
-            # a compose file next to the training code states the author's
-            # restart intent; default Never when nothing is declared
-            src_dirs = plan_svc.source_artifacts.get(
-                PlanService.SOURCE_DIR_ARTIFACT, [])
-            declared = source_restart_policy(src_dirs[0]) if src_dirs else ""
-            svc.restart_policy = declared or "Never"
-            svc.accelerator = plan_svc.accelerator
+            acc = plan_svc.accelerator
+            serving = bool(acc is not None and acc.serving)
+            svc.accelerator = acc
             image = container.image_names[0] if container.image_names else svc.name + ":latest"
-            svc.containers.append({"name": svc.name, "image": image})
+            container_def = {"name": svc.name, "image": image}
+            if serving:
+                # inference server: long-running Knative Service, not a
+                # run-to-completion Job
+                svc.job = False
+                svc.restart_policy = "Always"
+                port = acc.serving_port or 8080
+                svc.add_port_forwarding(80, port)
+                container_def["ports"] = [{"containerPort": port}]
+            else:
+                svc.job = True  # run-to-completion training workload
+                # a compose file next to the training code states the
+                # author's restart intent; default Never when undeclared
+                src_dirs = plan_svc.source_artifacts.get(
+                    PlanService.SOURCE_DIR_ARTIFACT, [])
+                declared = source_restart_policy(src_dirs[0]) if src_dirs else ""
+                svc.restart_policy = declared or "Never"
+            svc.containers.append(container_def)
             ir.add_service(svc)
         return ir

@@ -66,6 +66,16 @@ _CUDA_TEXT = re.compile(
 _NCCL_TEXT = re.compile(r"['\"]nccl['\"]|init_process_group"
                         r"|DistributedDataParallel|torchrun|torch\.distributed")
 
+# Inference servers: an HTTP framework plus generate/forward-only usage
+# marks a script as serving rather than training.
+_SERVING_IMPORTS = {"flask", "fastapi", "uvicorn", "gunicorn", "starlette",
+                    "sanic", "aiohttp", "tritonclient", "ts"}
+_SERVING_TEXT = re.compile(
+    r"@app\.(?:route|get|post)|FastAPI\(|Flask\(|uvicorn\.run\("
+    r"|app\.run\(|\.generate\(|torchserve|triton"
+)
+_PORT_TEXT = re.compile(r"port\s*[=:]\s*(\d{2,5})")
+
 
 @dataclass
 class GpuReport:
@@ -87,7 +97,17 @@ class GpuReport:
     model_family: str = ""
     entrypoint: str = ""  # training script path
     training_scripts: list[str] = field(default_factory=list)
+    serving_frameworks: list[str] = field(default_factory=list)
+    serving_scripts: list[str] = field(default_factory=list)
+    serving_port: int = 0  # detected HTTP listen port (0 = unknown)
     evidence: list[str] = field(default_factory=list)  # human-readable findings
+
+    @property
+    def is_serving(self) -> bool:
+        """Inference server, not a run-to-completion trainer. A tree with
+        both training and serving scripts stays a trainer (fine-tune repos
+        ship demo servers; the training job is the migration target)."""
+        return bool(self.serving_scripts) and not self.training_scripts
 
 
 def _iter_py_files(directory: str, max_files: int = 500):
@@ -210,6 +230,19 @@ def _analyze_directory_uncached(directory: str) -> GpuReport | None:
         if is_trainingish and (uses_cuda or imports & {
                 "torch", "tensorflow", "deepspeed", "horovod"}):
             report.training_scripts.append(path)
+        serving_imports = imports & _SERVING_IMPORTS
+        is_servingish = bool(serving_imports) or bool(_SERVING_TEXT.search(text))
+        if is_servingish and not is_trainingish and (
+                uses_cuda or imports & {"torch", "tensorflow"}):
+            report.serving_scripts.append(path)
+            for root in sorted(serving_imports):
+                if root not in report.serving_frameworks:
+                    report.serving_frameworks.append(root)
+            report.evidence.append(f"{rel}: GPU inference server")
+            if not report.serving_port:
+                m = _PORT_TEXT.search(text)
+                if m and 1 <= int(m.group(1)) <= 65535:
+                    report.serving_port = int(m.group(1))
 
     # DeepSpeed config JSON (ZeRO stage, micro batch, parallel sizes)
     for cfg in common.get_files_by_ext(directory, [".json"]):
@@ -282,26 +315,43 @@ def _analyze_directory_uncached(directory: str) -> GpuReport | None:
                 report.evidence.append(
                     f"{os.path.relpath(sh, directory)}: {attr}={m.group(1)}")
 
-    # decide: is this a GPU training workload?
+    # serving port: Dockerfile EXPOSE beats an in-source port= literal
+    for name in ("Dockerfile", "dockerfile"):
+        dpath = os.path.join(directory, name)
+        if os.path.isfile(dpath):
+            try:
+                text = open(dpath, encoding="utf-8", errors="ignore").read()
+            except OSError:
+                continue
+            m = re.search(r"^\s*EXPOSE\s+(\d+)", text, re.M)
+            if m:
+                report.serving_port = int(m.group(1))
+                report.evidence.append(f"{name}: EXPOSE {m.group(1)}")
+            break
+
+    # decide: is this a GPU workload (training or serving)?
     gpu_frameworks = set(report.frameworks) & {
         "torch", "tensorflow", "deepspeed", "horovod", "cupy"}
     if not gpu_frameworks:
         return None
     if not (report.uses_cuda or report.distributed_backend or "deepspeed" in report.frameworks):
         return None
-    if not report.training_scripts:
+    if not report.training_scripts and not report.serving_scripts:
         return None
 
     report.model_family = max(family_votes, key=family_votes.get) if family_votes else "generic"
-    report.entrypoint = _pick_entrypoint(report.training_scripts)
+    report.entrypoint = _pick_entrypoint(
+        report.training_scripts or report.serving_scripts)
     return report
 
 
 def _pick_entrypoint(scripts: list[str]) -> str:
     def score(p: str) -> tuple:
         base = os.path.basename(p).lower()
+        named = ("train" in base or "serve" in base or "server" in base
+                 or base == "app.py")
         return (
-            0 if "train" in base else (1 if base in ("main.py", "run.py") else 2),
+            0 if named else (1 if base in ("main.py", "run.py") else 2),
             p.count(os.sep),
             p,
         )
@@ -429,6 +479,8 @@ def report_to_accelerator(report: GpuReport, gpu_count: int = 0) -> AcceleratorI
         tpu_topology=topology,
         num_hosts=hosts,
         num_slices=num_slices,
+        serving=report.is_serving,
+        serving_port=report.serving_port if report.is_serving else 0,
     )
 
 

@@ -87,6 +87,8 @@ class AcceleratorInfo:
     tpu_topology: str = ""  # e.g. 2x4 (per slice)
     num_hosts: int = 1  # hosts per slice
     num_slices: int = 1  # >1 = multi-slice (DCN-connected pod slices)
+    serving: bool = False  # inference server (HTTP) vs run-to-completion
+    serving_port: int = 0  # detected listen port of the serving workload
 
     _CAMEL = {
         "gpu_count": "gpuCount",
@@ -100,6 +102,8 @@ class AcceleratorInfo:
         "tpu_topology": "tpuTopology",
         "num_hosts": "numHosts",
         "num_slices": "numSlices",
+        "serving": "serving",
+        "serving_port": "servingPort",
     }
 
     def to_dict(self) -> dict:

@@ -1,0 +1,24 @@
+"""Llama GPU inference server (FastAPI; translation input)."""
+import torch
+from fastapi import FastAPI
+from transformers import AutoTokenizer, LlamaForCausalLM
+
+app = FastAPI()
+tokenizer = AutoTokenizer.from_pretrained("meta-llama/Llama-2-7b-hf")
+model = LlamaForCausalLM.from_pretrained(
+    "meta-llama/Llama-2-7b-hf", torch_dtype=torch.float16).cuda()
+model.eval()
+
+
+@app.post("/generate")
+def generate(body: dict):
+    ids = tokenizer(body["prompt"], return_tensors="pt").input_ids.cuda()
+    with torch.no_grad():
+        out = model.generate(ids, max_new_tokens=body.get("max_new_tokens", 64))
+    return {"text": tokenizer.decode(out[0], skip_special_tokens=True)}
+
+
+if __name__ == "__main__":
+    import uvicorn
+
+    uvicorn.run(app, host="0.0.0.0", port=8000)

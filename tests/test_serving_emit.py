@@ -1,0 +1,322 @@
+"""Serving emission: GPU inference-server detection, gpu2tpu
+classification, and the Knative/TPU serving output path.
+
+Covers the paged-KV serving stack's emission half (the engine itself is
+tests/test_serving.py): a detected GPU inference server becomes a
+long-running service (not a JobSet) carrying google.com/tpu resources,
+decode-concurrency autoscaling, and the serve_tpu.py container — plus
+the v1<->v1beta1 knative version round-trip the TPU placement fields
+ride through."""
+
+from __future__ import annotations
+
+import os
+
+import yaml
+
+from move2kube_tpu.apiresource.knative import (
+    _STASH_ANNOTATION,
+    KnativeServiceAPIResource,
+    _convert_knative_version,
+)
+from move2kube_tpu.engine import planner, translator
+from move2kube_tpu.passes.optimize import tpu_serving_optimizer
+from move2kube_tpu.passes.parameterize import tpu_serving_parameterizer
+from move2kube_tpu.qa import engine as qaengine
+from move2kube_tpu.source import gpu_detect
+from move2kube_tpu.types.collection import ClusterMetadataSpec
+from move2kube_tpu.types.ir import IR, Service
+from move2kube_tpu.types.plan import AcceleratorInfo, TargetArtifactType
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE_SAMPLE = os.path.join(REPO, "samples", "gpu-training", "llama-serve")
+
+
+# --- detection -------------------------------------------------------------
+
+
+def _write_server(d, port_literal=5000):
+    (d / "server.py").write_text(
+        "import flask\n"
+        "import torch\n"
+        "app = flask.Flask(__name__)\n"
+        "model = torch.load('m.pt').cuda()\n"
+        "@app.route('/predict', methods=['POST'])\n"
+        "def predict():\n"
+        "    return model(flask.request.json)\n"
+        f"app.run(host='0.0.0.0', port={port_literal})\n")
+
+
+def test_detect_serving_only_tree(tmp_path):
+    _write_server(tmp_path)
+    report = gpu_detect.analyze_directory(str(tmp_path))
+    assert report is not None
+    assert report.is_serving
+    assert report.serving_port == 5000  # in-source port= literal
+    assert "flask" in report.serving_frameworks
+    assert not report.training_scripts
+
+
+def test_dockerfile_expose_beats_port_literal(tmp_path):
+    _write_server(tmp_path, port_literal=5000)
+    (tmp_path / "Dockerfile").write_text(
+        "FROM python:3.11\nEXPOSE 9000\nCMD [\"python\", \"server.py\"]\n")
+    report = gpu_detect.analyze_directory(str(tmp_path))
+    assert report is not None and report.is_serving
+    assert report.serving_port == 9000
+
+
+def test_training_plus_serving_tree_is_trainer(tmp_path):
+    """A repo shipping both a trainer and a demo server migrates as a
+    trainer: the serving scripts alone don't flip the workload class."""
+    _write_server(tmp_path)
+    (tmp_path / "train.py").write_text(
+        "import torch\n"
+        "model = torch.nn.Linear(8, 8).cuda()\n"
+        "optimizer = torch.optim.SGD(model.parameters(), lr=0.1)\n"
+        "for step in range(10):\n"
+        "    loss = model(torch.randn(4, 8).cuda()).sum()\n"
+        "    loss.backward()\n"
+        "    optimizer.step()\n")
+    report = gpu_detect.analyze_directory(str(tmp_path))
+    assert report is not None
+    assert report.training_scripts
+    assert not report.is_serving
+
+
+def test_sample_detection():
+    report = gpu_detect.analyze_directory(SERVE_SAMPLE)
+    assert report is not None
+    assert report.is_serving
+    assert report.serving_port == 8000  # Dockerfile EXPOSE
+    assert report.model_family == "llama"
+    acc = gpu_detect.report_to_accelerator(report)
+    assert acc.serving and acc.serving_port == 8000
+
+
+# --- end-to-end emission ---------------------------------------------------
+
+
+def _translate(out, artifact_type):
+    qaengine.reset_engines()
+    qaengine.start_engine(qa_skip=True)
+    try:
+        plan = planner.create_plan(SERVE_SAMPLE, name="llamaserve")
+        opts = plan.services["llama-serve"]
+        # the GPU2TPU option must outrank reusing the CUDA Dockerfile
+        assert opts[0].container_build_type == "NewDockerfile" or \
+            opts[0].accelerator is not None
+        assert opts[0].accelerator.serving
+        plan.kubernetes.artifact_type = artifact_type
+        translator.translate(plan, str(out))
+    finally:
+        qaengine.reset_engines()
+
+
+def test_knative_emission_acceptance(tmp_path):
+    """The acceptance shape: a classified serving service emits a knative
+    Service whose revision carries google.com/tpu resources, a
+    concurrency annotation matched to the decode batch, and the
+    continuous-batching server container."""
+    out = tmp_path / "out"
+    _translate(out, TargetArtifactType.KNATIVE)
+
+    obj = yaml.safe_load(
+        (out / "llamaserve" / "llama-serve-service.yaml").read_text())
+    assert obj["kind"] == "Service"
+    assert obj["apiVersion"].startswith("serving.knative.dev/")
+    tmpl = obj["spec"]["template"]
+    pod = tmpl["spec"]
+    c = pod["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] >= 1
+    assert c["resources"]["requests"]["google.com/tpu"] >= 1
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["M2KT_SERVE_MAX_BATCH"] == "8"
+    assert env["M2KT_SERVE_MAX_SEQ"] == "2048"
+    assert env["M2KT_KV_BLOCK_SIZE"] == "16"
+    assert pod["containerConcurrency"] == 8
+    ann = tmpl["metadata"]["annotations"]
+    assert ann["autoscaling.knative.dev/metric"] == "concurrency"
+    assert ann["autoscaling.knative.dev/target"] == "8"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
+    assert c["ports"][0]["containerPort"] == 8000
+
+    cdir = out / "containers" / "llama-serve"
+    assert (cdir / "serve_tpu.py").exists()
+    assert not (cdir / "train_tpu.py").exists()
+    dockerfile = (cdir / "Dockerfile").read_text()
+    assert "EXPOSE 8000" in dockerfile
+    assert 'CMD ["python", "serve_tpu.py"]' in dockerfile
+    assert "supervisor" not in dockerfile  # no training supervisor wrap
+    assert (cdir / "move2kube_tpu" / "serving" / "engine.py").exists()
+    assert (cdir / "move2kube_tpu" / "serving" / "kvcache.py").exists()
+
+
+def test_k8s_emission_is_deployment_not_jobset(tmp_path):
+    """k8s output mode: the serving service stays a long-running
+    Deployment (with the same TPU sizing) — never a run-to-completion
+    JobSet."""
+    out = tmp_path / "out"
+    _translate(out, TargetArtifactType.YAMLS)
+
+    ydir = out / "llamaserve"
+    files = os.listdir(ydir)
+    assert not any("jobset" in f for f in files), files
+    dep_file = [f for f in files if "llama-serve-deployment" in f]
+    assert dep_file, files
+    dep = yaml.safe_load((ydir / dep_file[0]).read_text())
+    assert dep["kind"] == "Deployment"
+    pod = dep["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] >= 1
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+    assert any("llama-serve-service" in f for f in files), files
+
+
+# --- knative v1 <-> v1beta1 round-trip -------------------------------------
+
+
+def _v1_serving_obj():
+    return {
+        "apiVersion": "serving.knative.dev/v1",
+        "kind": "Service",
+        "metadata": {"name": "web"},
+        "spec": {"template": {"spec": {
+            "containers": [{"name": "web", "image": "r/web:latest"}],
+            "containerConcurrency": 8,
+            "restartPolicy": "Always",
+            "nodeSelector": {"cloud.google.com/gke-tpu-topology": "1x1"},
+            "tolerations": [{"key": "google.com/tpu", "operator": "Exists"}],
+        }}},
+    }
+
+
+def test_v1beta1_down_conversion_stashes_v1_fields():
+    obj = _v1_serving_obj()
+    _convert_knative_version(obj, "serving.knative.dev/v1beta1")
+    assert obj["apiVersion"] == "serving.knative.dev/v1beta1"
+    spec = obj["spec"]["template"]["spec"]
+    # v1-only pod fields left the spec...
+    assert "nodeSelector" not in spec
+    assert "tolerations" not in spec
+    assert "restartPolicy" not in spec
+    # ...whitelisted fields stayed...
+    assert spec["containerConcurrency"] == 8
+    assert spec["containers"]
+    # ...and everything moved lives in the stash annotation
+    ann = obj["spec"]["template"]["metadata"]["annotations"]
+    assert _STASH_ANNOTATION in ann
+
+
+def test_v1_round_trip_identity():
+    obj = _v1_serving_obj()
+    import copy
+
+    original = copy.deepcopy(obj)
+    _convert_knative_version(obj, "serving.knative.dev/v1beta1")
+    _convert_knative_version(obj, "serving.knative.dev/v1")
+    assert obj["apiVersion"] == "serving.knative.dev/v1"
+    assert obj["spec"]["template"]["spec"] == original["spec"]["template"]["spec"]
+    ann = (obj["spec"]["template"].get("metadata") or {}).get(
+        "annotations") or {}
+    assert _STASH_ANNOTATION not in ann
+
+
+def test_lowering_restores_stashed_fields():
+    """Lowering a v1beta1 object (stash in place) to Deployment restores
+    the TPU placement fields — a plain Deployment supports them all."""
+    obj = _v1_serving_obj()
+    obj["spec"]["template"].setdefault("metadata", {})["annotations"] = {
+        "autoscaling.knative.dev/target": "8"}
+    _convert_knative_version(obj, "serving.knative.dev/v1beta1")
+    api = KnativeServiceAPIResource(create=False)
+    lowered = api.convert_to_cluster_supported_kinds(obj, set(), [], IR(name="x"))
+    assert [o["kind"] for o in lowered] == ["Deployment", "Service"]
+    pod = lowered[0]["spec"]["template"]["spec"]
+    assert pod["nodeSelector"] == {"cloud.google.com/gke-tpu-topology": "1x1"}
+    assert pod["tolerations"]
+    assert "containerConcurrency" not in pod
+    pod_ann = lowered[0]["spec"]["template"]["metadata"]["annotations"]
+    assert pod_ann["autoscaling.knative.dev/target"] == "8"
+    assert _STASH_ANNOTATION not in pod_ann
+
+
+def test_write_time_conversion_applies_to_created_serving_service():
+    """A cluster advertising only v1beta1 gets a v1beta1 Service with the
+    TPU placement stashed, not dropped (goes through _fix_version)."""
+    ir = IR(name="p")
+    svc = Service(name="srv")
+    svc.accelerator = AcceleratorInfo(
+        gpu_count=1, tpu_accelerator="tpu-v5-lite-podslice",
+        tpu_topology="1x1", serving=True, serving_port=8000)
+    svc.containers.append({"name": "srv", "image": "r/srv:latest",
+                           "ports": [{"containerPort": 8000}]})
+    ir.add_service(svc)
+    ir.target_cluster_spec = ClusterMetadataSpec(api_kind_version_map={
+        "Service": ["serving.knative.dev/v1beta1", "v1"]})
+    from move2kube_tpu.apiresource.base import convert_objects
+
+    objs = convert_objects(ir, [KnativeServiceAPIResource(create=True)])
+    assert len(objs) == 1
+    obj = objs[0]
+    assert obj["apiVersion"] == "serving.knative.dev/v1beta1"
+    ann = obj["spec"]["template"]["metadata"]["annotations"]
+    assert _STASH_ANNOTATION in ann
+    assert "google.com/tpu" in ann[_STASH_ANNOTATION] or \
+        obj["spec"]["template"]["spec"]["containers"][0][
+            "resources"]["limits"]["google.com/tpu"] >= 1
+
+
+# --- serving passes --------------------------------------------------------
+
+
+def _serving_ir():
+    ir = IR(name="p")
+    svc = Service(name="srv")
+    svc.accelerator = AcceleratorInfo(gpu_count=1, serving=True,
+                                      serving_port=8000)
+    svc.containers.append({"name": "srv", "image": "r/srv:latest"})
+    ir.add_service(svc)
+    return ir
+
+
+def test_serving_optimizer_injects_knobs():
+    qaengine.reset_engines()
+    qaengine.start_engine(qa_skip=True)
+    try:
+        ir = tpu_serving_optimizer(_serving_ir())
+    finally:
+        qaengine.reset_engines()
+    env = {e["name"]: e["value"]
+           for e in ir.services["srv"].containers[0]["env"]}
+    assert env == {"M2KT_SERVE_MAX_BATCH": "8",
+                   "M2KT_SERVE_MAX_SEQ": "2048",
+                   "M2KT_KV_BLOCK_SIZE": "16"}
+
+
+def test_serving_parameterizer_lifts_knobs():
+    ir = _serving_ir()
+    ir.services["srv"].containers[0]["env"] = [
+        {"name": "M2KT_SERVE_MAX_BATCH", "value": "16"},
+        {"name": "M2KT_SERVE_MAX_SEQ", "value": "4096"},
+        {"name": "M2KT_KV_BLOCK_SIZE", "value": "32"},
+    ]
+    ir = tpu_serving_parameterizer(ir)
+    assert ir.values.global_variables["tpuservemaxbatch"] == "16"
+    assert ir.values.global_variables["tpuservemaxseq"] == "4096"
+    assert ir.values.global_variables["tpukvblocksize"] == "32"
+    env = {e["name"]: e["value"]
+           for e in ir.services["srv"].containers[0]["env"]}
+    assert env["M2KT_SERVE_MAX_BATCH"] == "{{ .Values.tpuservemaxbatch }}"
+
+
+def test_non_serving_service_untouched():
+    ir = _serving_ir()
+    ir.services["srv"].accelerator.serving = False
+    qaengine.reset_engines()
+    qaengine.start_engine(qa_skip=True)
+    try:
+        ir = tpu_serving_optimizer(ir)
+    finally:
+        qaengine.reset_engines()
+    assert "env" not in ir.services["srv"].containers[0]

@@ -3,6 +3,7 @@ use in Tekton git secrets (§2.8 TektonAPIResourceSet)."""
 
 from __future__ import annotations
 
+import pytest
 
 from move2kube_tpu.qa import engine as qaengine
 from move2kube_tpu.utils import gitinfo, knownhosts, sshkeys
@@ -228,6 +229,7 @@ def test_git_secret_data_placeholder_and_hosts(tmp_path, monkeypatch):
 
 
 def _make_encrypted_pem_key(passphrase: bytes) -> str:
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
 
@@ -243,6 +245,7 @@ def test_decrypt_openssh_branch(monkeypatch):
     """_decrypt's primary (load_ssh_private_key) branch: exercised via a
     stub since this image lacks the bcrypt module OpenSSH-format
     encryption needs (coverage for r4 weak #6)."""
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import serialization
 
     class FakeKey:
