@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -81,6 +81,14 @@ multichip-smoke:
 serve-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_serving.py -q
 	$(CPU_ENV) $(PY) bench.py --model serving
+
+# telemetry plane in isolation (CPU-mode): metrics registry/exposition
+# semantics, telemetry HTTP server, scrape-annotation emission, then the
+# bench obs phase (per-step recording overhead gated at <= 3% of step
+# time + a live well-formedness scrape of the exposition)
+obs-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_obs.py -q
+	$(CPU_ENV) $(PY) bench.py --model obs
 
 # resilience subsystem in isolation (all CPU-mode, deterministic faults):
 # kill-at-step-N -> resume-from-N under the supervisor, corrupt-checkpoint

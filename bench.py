@@ -67,7 +67,7 @@ WARM_FAST_S = float(os.environ.get("M2KT_BENCH_WARM_FAST_S", "3.0"))
 MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
 PHASES = ("resnet", "bert", "pallas", "llama", "translate", "goodput",
-          "scaling", "serving")
+          "scaling", "serving", "obs")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
@@ -79,6 +79,7 @@ PHASE_METRICS = {
     "goodput": ("train_goodput_fraction_faulted", "fraction"),
     "scaling": ("multichip_scaling_efficiency_host8", "fraction"),
     "serving": ("decode_throughput_tokens_s", "tok/s"),
+    "obs": ("telemetry_overhead_fraction", "fraction"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
@@ -904,6 +905,157 @@ def run_serving_probe() -> int:
     return 0
 
 
+OBS_OVERHEAD_MAX = float(os.environ.get("M2KT_BENCH_OBS_OVERHEAD_MAX",
+                                        "0.03"))
+
+
+def bench_obs(n: int) -> dict:
+    """Telemetry-plane guard on forced host devices: the tiny-LM train
+    step with per-step StepTelemetry recording vs bare, plus a real HTTP
+    scrape of the registry. The phase FAILS (not just reports) when
+    recording costs more than OBS_OVERHEAD_MAX of step time or the
+    exposition isn't well-formed Prometheus text — observability that
+    taxes the hot path or emits unscrapable output is a regression. Own
+    subprocess for the same reason as the scaling phase: the probe must
+    own jax's platform env before import."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--obs-probe"],
+        env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"obs probe rc={res.returncode}: {res.stderr[-300:]}")
+    probe = json.loads(res.stdout.strip().splitlines()[-1])
+    dt = time.perf_counter() - t0
+    overhead = probe["telemetry_overhead_fraction"]
+    if not probe["exposition_ok"]:
+        raise RuntimeError(
+            f"malformed Prometheus exposition: bad_lines="
+            f"{probe.get('bad_lines')} content_type="
+            f"{probe.get('scrape_content_type')}")
+    if overhead > OBS_OVERHEAD_MAX:
+        raise RuntimeError(
+            f"telemetry overhead {overhead:.1%} exceeds the "
+            f"{OBS_OVERHEAD_MAX:.0%} budget "
+            f"(base {probe['baseline_step_ms']:.2f}ms vs instrumented "
+            f"{probe['instrumented_step_ms']:.2f}ms per step)")
+    print(f"[bench] obs overhead {overhead:.2%} "
+          f"({probe['baseline_step_ms']:.2f}ms -> "
+          f"{probe['instrumented_step_ms']:.2f}ms/step), "
+          f"{probe['exposition_samples']} samples scraped in {dt:.1f}s",
+          file=sys.stderr)
+    metric, unit = PHASE_METRICS["obs"]
+    # no published baseline: the phase is an overhead budget guard
+    return {"phase": "obs", "metric": metric, "value": overhead,
+            "unit": unit, "vs_baseline": 0.0, "baseline": "none_published",
+            "overhead_budget": OBS_OVERHEAD_MAX,
+            "baseline_step_ms": probe["baseline_step_ms"],
+            "instrumented_step_ms": probe["instrumented_step_ms"],
+            "steps_per_run": probe["steps"],
+            "exposition_ok": probe["exposition_ok"],
+            "exposition_samples": probe["exposition_samples"],
+            "scrape_content_type": probe["scrape_content_type"],
+            "wall_s": round(dt, 2)}
+
+
+def run_obs_probe() -> int:
+    """In-process half of the obs phase (spawned by bench_obs with jax
+    forced onto host devices). Times the tiny-LM step bare vs with
+    per-step StepTelemetry recording (min of 3 runs each — host noise
+    must not fail the budget), scrapes a live TelemetryServer, and
+    prints one JSON line."""
+    import dataclasses
+    import re
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from move2kube_tpu.models import train as m2kt_train
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.obs.metrics import Registry
+    from move2kube_tpu.obs.server import TelemetryServer
+    from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32)
+    model = Llama(cfg)
+    mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    batch, seq, steps = 4, 64, 20
+    ids = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
+                             cfg.vocab_size)
+    state = m2kt_train.create_sharded_state(
+        jax.random.PRNGKey(1), model, {"input_ids": ids},
+        m2kt_train.instrument_optimizer(optax.adamw(3e-4)), mesh)
+    step = m2kt_train.make_lm_train_step(mesh, remat=False)
+    state, loss = step(state, {"input_ids": ids})  # compile
+    jax.block_until_ready(loss)
+
+    def run(telem):
+        nonlocal state
+        t0 = time.perf_counter()
+        for i in range(1, steps + 1):
+            ts = time.perf_counter()
+            state, loss = step(state, {"input_ids": ids})
+            loss = jax.block_until_ready(loss)
+            if telem is not None:
+                # worst case: every step records loss AND grad norm (the
+                # emitted trainer only reads those back every 10th step)
+                telem.record_step(i, time.perf_counter() - ts,
+                                  loss=float(loss), state=state)
+        return time.perf_counter() - t0
+
+    reg = Registry()
+    telem = m2kt_train.StepTelemetry(registry=reg, items_per_step=batch * seq)
+    # INTERLEAVED min-of-4: back-to-back blocks would attribute a
+    # machine-load drift entirely to whichever variant ran second (round
+    # 10: a sequential measurement failed the budget at "4.5%" that a
+    # rerun measured as 0%)
+    base = instrumented = float("inf")
+    for _ in range(4):
+        base = min(base, run(None))
+        instrumented = min(instrumented, run(telem))
+    overhead = max(0.0, instrumented / base - 1.0)
+
+    srv = TelemetryServer(port=0, registry=reg)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as resp:
+            ctype = resp.headers.get("Content-Type", "")
+            text = resp.read().decode("utf-8")
+    finally:
+        srv.close()
+    # well-formed v0.0.4 text: every sample line is `name{labels} value`
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? [^ ]+$')
+    lines = [ln for ln in text.splitlines() if ln and not ln.startswith("#")]
+    bad = [ln for ln in lines if not sample_re.match(ln)]
+    exposition_ok = bool(
+        not bad and lines and "# HELP" in text and "# TYPE" in text
+        and "m2kt_train_step_seconds_bucket" in text
+        and 'le="+Inf"' in text and "version=0.0.4" in ctype)
+    print(json.dumps({
+        "telemetry_overhead_fraction": round(overhead, 4),
+        "baseline_step_ms": round(base / steps * 1e3, 3),
+        "instrumented_step_ms": round(instrumented / steps * 1e3, 3),
+        "steps": steps,
+        "exposition_ok": exposition_ok,
+        "exposition_samples": len(lines),
+        "bad_lines": bad[:3],
+        "scrape_content_type": ctype,
+    }), flush=True)
+    return 0
+
+
 def _setup_compile_cache() -> None:
     """Persistent XLA compile cache for this child: a re-spawned child
     (retry, OOM batch-halving) deserializes the previous child's
@@ -949,7 +1101,8 @@ def run_child(phases: list[str]) -> int:
     fns = {"resnet": bench_resnet, "bert": bench_bert,
            "pallas": bench_pallas, "llama": bench_llama,
            "translate": bench_translate, "goodput": bench_goodput,
-           "scaling": bench_scaling, "serving": bench_serving}
+           "scaling": bench_scaling, "serving": bench_serving,
+           "obs": bench_obs}
     ok = True
     for phase in phases:
         try:
@@ -1197,9 +1350,10 @@ def run_opportunistic() -> int:
     oom: dict = {}
     deadline = time.perf_counter() + BUDGET_S
     for _ in range(3):
-        # serving rides along: it runs on forced host devices, so an
-        # opportunistic capture window is also a chance to refresh it
-        missing = [p for p in TPU_PHASES + ("serving",) if p not in results
+        # serving and obs ride along: they run on forced host devices, so
+        # an opportunistic capture window is also a chance to refresh them
+        missing = [p for p in TPU_PHASES + ("serving", "obs")
+                   if p not in results
                    and len(fails.get(p, ())) < MAX_PHASE_FAILS]
         remaining = deadline - time.perf_counter()
         if not missing or remaining < 30:
@@ -1257,11 +1411,16 @@ def main() -> int:
     parser.add_argument("--serving-probe", action="store_true",
                         help="internal: continuous-batching decode "
                              "measurement (spawned by the serving phase)")
+    parser.add_argument("--obs-probe", action="store_true",
+                        help="internal: telemetry overhead + exposition "
+                             "scrape measurement (spawned by the obs phase)")
     args = parser.parse_args()
     if args.scaling_probe:
         return run_scaling_probe()
     if args.serving_probe:
         return run_serving_probe()
+    if args.obs_probe:
+        return run_obs_probe()
     if args.child:
         return run_child(args.child.split(","))
     if args.opportunistic:

@@ -34,9 +34,42 @@ JOB_SET = "JobSet"
 
 SELECTOR_LABEL = "move2kube-tpu.io/service"
 
+METRICS_PATH = "/metrics"
+
+
+def metrics_port_value(svc: Service) -> str | None:
+    """The telemetry port the observability optimizer baked into the pod
+    env (``M2KT_METRICS_PORT``), as a string — in Helm output this is the
+    ``{{ .Values.tpumetricsport }}`` ref, which is exactly what the
+    scrape annotation should carry so chart overrides retune both
+    together. None / "0" means telemetry is off."""
+    for c in svc.containers:
+        for e in c.get("env", []) or []:
+            if e.get("name") == "M2KT_METRICS_PORT":
+                v = str(e.get("value", "")).strip()
+                return v if v and v != "0" else None
+    return None
+
+
+def scrape_annotations(svc: Service) -> dict:
+    """prometheus.io/* pod annotations for a telemetry-enabled service
+    (empty when the obs optimizer left the service uninstrumented)."""
+    port = metrics_port_value(svc)
+    if not port:
+        return {}
+    return {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": port,
+        "prometheus.io/path": METRICS_PATH,
+    }
+
 
 def pod_template(svc: Service, labels: dict) -> dict:
-    return {"metadata": {"labels": dict(labels)}, "spec": svc.pod_spec()}
+    meta: dict = {"labels": dict(labels)}
+    scrape = scrape_annotations(svc)
+    if scrape:
+        meta["annotations"] = scrape
+    return {"metadata": meta, "spec": svc.pod_spec()}
 
 
 def _tpu_resources(svc: Service, workload_kind: str = JOB_SET) -> None:
@@ -183,7 +216,45 @@ class DeploymentAPIResource(APIResource):
             if svc.only_ingress or not svc.containers:
                 continue
             objs.append(self._create_workload(svc, supported_kinds))
+            pm = self._maybe_podmonitor(svc, ir)
+            if pm:
+                objs.append(pm)
         return [o for o in objs if o]
+
+    def _maybe_podmonitor(self, svc: Service, ir: IR) -> dict | None:
+        """Optional prometheus-operator PodMonitor next to the workload,
+        behind a QA knob: annotation-based scraping covers vanilla
+        Prometheus, but operator-managed stacks only discover
+        monitoring.coreos.com selectors. The endpoint references the
+        named ``metrics`` container port the obs optimizer added."""
+        if svc.accelerator is None or not metrics_port_value(svc):
+            return None
+        from move2kube_tpu import qa
+        from move2kube_tpu.utils import common
+
+        name = common.make_dns_label(svc.name)
+        if not qa.fetch_bool(
+                f"m2kt.services.{name}.obs.podmonitor",
+                f"Emit a prometheus-operator PodMonitor for [{name}]?",
+                ["Needs the monitoring.coreos.com CRDs on the cluster; "
+                 "scrape annotations are emitted either way"],
+                False):
+            return None
+        cluster = ir.target_cluster_spec
+        if cluster.api_kind_version_map and not cluster.supports_kind(
+                "PodMonitor"):
+            log.warning(
+                "%s: PodMonitor requested but the target cluster does not "
+                "advertise monitoring.coreos.com; emitting anyway "
+                "(honored once the CRDs are installed)", svc.name)
+        obj = make_obj("PodMonitor", "monitoring.coreos.com/v1",
+                       f"{svc.name}-metrics", {SELECTOR_LABEL: svc.name})
+        obj["spec"] = {
+            "selector": {"matchLabels": {SELECTOR_LABEL: svc.name}},
+            "podMetricsEndpoints": [
+                {"port": "metrics", "path": METRICS_PATH}],
+        }
+        return obj
 
     def _create_workload(self, svc: Service, supported: set[str]) -> dict | None:
         labels = {SELECTOR_LABEL: svc.name, **svc.labels}

@@ -119,11 +119,21 @@ class KnativeServiceAPIResource(APIResource):
             # knative revision schema has no subdomain (that's the JobSet
             # pod-DNS mechanism); drop it rather than fail validation
             pod_spec.pop("subdomain", None)
+            # knative validates at most ONE containerPort (the traffic
+            # port); the named metrics port the obs optimizer added must
+            # not reach the revision — the scrape annotation carries the
+            # port number and Prometheus scrapes the pod IP directly
+            for c in pod_spec.get("containers", []) or []:
+                ports = c.get("ports") or []
+                kept = [p for p in ports if p.get("name") != "metrics"]
+                if len(kept) != len(ports):
+                    c["ports"] = kept
             labels = {"app": svc.name, **svc.labels}
             obj = make_obj("Service", f"{KNATIVE_GROUP}/v1", svc.name, labels)
             if svc.annotations:
                 obj["metadata"]["annotations"] = dict(svc.annotations)
             template: dict = {"spec": pod_spec}
+            tmpl_annotations: dict = {}
             if svc.accelerator is not None:
                 # TPU serving service: chip requests + placement on the
                 # revision, and concurrency matched to the decode engine's
@@ -131,10 +141,20 @@ class KnativeServiceAPIResource(APIResource):
                 _tpu_pod_resources(svc, pod_spec)
                 concurrency = _serving_concurrency(svc)
                 pod_spec["containerConcurrency"] = concurrency
-                template["metadata"] = {"annotations": {
+                tmpl_annotations.update({
                     "autoscaling.knative.dev/metric": "concurrency",
                     "autoscaling.knative.dev/target": str(concurrency),
-                }}
+                })
+            # telemetry-enabled revisions advertise the scrape target —
+            # Prometheus scrapes the pod IP directly, so the telemetry
+            # port needs no Knative routing (queue-proxy only fronts the
+            # serving port)
+            from move2kube_tpu.apiresource.deployment import (
+                scrape_annotations)
+
+            tmpl_annotations.update(scrape_annotations(svc))
+            if tmpl_annotations:
+                template["metadata"] = {"annotations": tmpl_annotations}
             obj["spec"] = {"template": template}
             objs.append(obj)
         return objs

@@ -17,7 +17,7 @@ from move2kube_tpu.engine.collector import collect
 from move2kube_tpu.engine.planner import create_plan, curate_plan
 from move2kube_tpu.engine.translator import translate
 from move2kube_tpu.types import plan as plantypes
-from move2kube_tpu.utils import common
+from move2kube_tpu.utils import common, trace
 from move2kube_tpu.utils.log import configure, get_logger
 
 log = get_logger("cli")
@@ -106,6 +106,10 @@ def plan_handler(args) -> int:
 def translate_handler(args) -> int:
     if args.ignore_env:
         common.IGNORE_ENVIRONMENT = True
+    # the span recorder is module-global: without a per-run reset a second
+    # in-process translate() (tests, REST drivers) reports the first run's
+    # spans and counters on top of its own
+    trace.reset()
     qa.reset_engines()
     interactive = (
         args.curate or bool(args.qa_port) or args.qa_disable_cli
@@ -141,8 +145,6 @@ def translate_handler(args) -> int:
     plan = curate_plan(plan)
     translate(plan, out_dir)
     if args.profile:
-        from move2kube_tpu.utils import trace
-
         path = trace.write_metrics(out_dir)
         print(f"run metrics written to {path}")
     print(f"artifacts written to {out_dir}")

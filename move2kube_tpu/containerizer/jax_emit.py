@@ -38,8 +38,10 @@ _ASSETS = os.path.join(_PKG_ROOT, "assets", "jax")
 # the extension (transient gcc install, `|| true`); when that fails
 # gather_rows degrades to the numpy fallback. "resilience" is the
 # preemption/supervisor/goodput stack the image's entrypoint runs under.
+# "obs" is the stdlib-only telemetry plane (Prometheus exposition +
+# /profile endpoint) both entrypoints serve on M2KT_METRICS_PORT.
 VENDORED_SUBPACKAGES = ("models", "parallel", "ops", "native", "resilience",
-                        "serving")
+                        "serving", "obs")
 
 REQUIREMENTS = """jax[tpu]>=0.4.35
 flax
@@ -242,6 +244,26 @@ def _ask_serving_knobs(name: str) -> dict:
     return knobs
 
 
+def _ask_obs_port(name: str) -> int:
+    """Telemetry (/metrics) port as a QA problem. Same ID as
+    ``passes/optimize.py``'s tpu_observability_optimizer — asked once,
+    cached: the template's baked-in default and the workload YAML's
+    ``M2KT_METRICS_PORT`` env always agree. 0 disables telemetry."""
+    from move2kube_tpu import qa
+
+    raw = qa.fetch_input(
+        f"m2kt.services.{name}.obs.port",
+        f"Enter the telemetry (/metrics) port for [{name}]",
+        ["Prometheus exposition + on-demand XLA profiling; 0 disables"],
+        "9090")
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        log.warning("invalid obs.port answer %r for %s; using 9090",
+                    raw, name)
+        return 9090
+
+
 def emit_container(service: PlanService, plan=None) -> Container:
     acc = service.accelerator or AcceleratorInfo()
     family = (service.containerization_target_options[0]
@@ -347,6 +369,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
             rel = common.relpath_under(entry_rel, src_dirs[0])
             entry_rel = rel if rel is not None else os.path.basename(entry_rel)
     serve_port = acc.serving_port or 8080
+    metrics_port = _ask_obs_port(name)
     if serving:
         acc.serving_port = serve_port
         serve_knobs = _ask_serving_knobs(name)
@@ -365,6 +388,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
                     "serve_max_seq": serve_knobs["max_seq"],
                     "serve_kv_block": serve_knobs["kv_block"],
                     "compile_cache_dir": "/app/.jax-cache",
+                    "metrics_port": metrics_port,
                 }))
     else:
         with open(os.path.join(_ASSETS, "train_tpu.py"),
@@ -394,6 +418,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
                 # in-image default; pods that mount a durable volume point
                 # M2KT_COMPILE_CACHE_DIR at it to survive restarts
                 "compile_cache_dir": "/app/.jax-cache",
+                "metrics_port": metrics_port,
                 "steps": 100,
                 "lr": (3e-4 if family in ("llama", "gpt", "gpt2")
                        else 1e-4 if family == "unet" else 1e-3),

@@ -133,7 +133,12 @@ def builtin_clusters() -> dict[str, ClusterMetadata]:
                                        extra=_IKS_OVERRIDES | _KNATIVE),
         "GCP-GKE-TPU": _profile(
             "GCP-GKE-TPU",
-            extra=_MODERN_OVERRIDES | {"JobSet": ["jobset.x-k8s.io/v1alpha2"]},
+            extra=_MODERN_OVERRIDES | {
+                "JobSet": ["jobset.x-k8s.io/v1alpha2"],
+                # managed-collection GKE ships the prometheus-operator
+                # CRDs; lets the optional PodMonitor emit un-dropped
+                "PodMonitor": ["monitoring.coreos.com/v1"],
+            },
             drop=["PodSecurityPolicy"],  # removed in k8s 1.25; JobSet needs 1.27
             storage_classes=["standard-rwo", "standard"],
             tpu_accelerators=[

@@ -1,0 +1,54 @@
+"""Runtime telemetry plane for emitted TPU workloads.
+
+Net-new vs the reference (SURVEY §5 "tracing/profiling: absent") and
+complementary to ``utils/trace.py``, which only covers the *offline*
+translate pipeline: once a translated workload lands on a slice, this
+package is what makes it observable — a dependency-free Prometheus
+registry (:mod:`metrics`), a stdlib HTTP server exposing ``/metrics`` /
+``/healthz`` / on-demand ``/profile`` XLA captures (:mod:`server`), and
+bridges folding translate-trace spans and goodput reports into the same
+registry (:mod:`bridge`).
+
+Stdlib-only on import (jax is loaded lazily, only for profiling and
+device-memory reads) so the whole package vendors into emitted images.
+"""
+
+from move2kube_tpu.obs.bridge import (
+    install_goodput_hook,
+    install_trace_hook,
+    mirror_goodput,
+    mirror_trace,
+)
+from move2kube_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+)
+from move2kube_tpu.obs.server import (
+    DEFAULT_METRICS_PORT,
+    METRICS_PORT_ENV,
+    PROFILE_DIR_ENV,
+    TelemetryServer,
+    metrics_port_from_env,
+    start_telemetry_server,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "default_registry",
+    "TelemetryServer",
+    "start_telemetry_server",
+    "metrics_port_from_env",
+    "DEFAULT_METRICS_PORT",
+    "METRICS_PORT_ENV",
+    "PROFILE_DIR_ENV",
+    "mirror_trace",
+    "mirror_goodput",
+    "install_trace_hook",
+    "install_goodput_hook",
+]

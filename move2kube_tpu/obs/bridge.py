@@ -1,0 +1,65 @@
+"""Bridges: fold goodput reports and translate-trace totals into an obs
+registry, so one scrape carries step metrics, goodput, and span totals.
+
+Both mirrors are idempotent gauge writes, so they compose with
+:meth:`Registry.add_collect_hook` — the registry refreshes them on every
+scrape instead of the workload polling on a timer.
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.obs.metrics import Registry, default_registry
+
+
+def mirror_trace(registry: Registry | None = None, recorder=None) -> None:
+    """Mirror ``utils.trace`` span totals + counters into gauges
+    (``m2kt_trace_span_seconds_total{span=...}``). No-op when the image
+    doesn't ship trace."""
+    reg = registry if registry is not None else default_registry()
+    try:
+        from move2kube_tpu.utils import trace
+    except Exception:  # noqa: BLE001 - slim vendored images
+        return
+    snap = (recorder or trace.get()).to_dict()
+    spans = reg.gauge(
+        "m2kt_trace_span_seconds_total",
+        "Cumulative wall seconds per pipeline span", labels=("span",))
+    for name, seconds in snap.get("spans", {}).items():
+        spans.labels(span=name).set(seconds)
+    counters = reg.gauge(
+        "m2kt_trace_counter", "utils.trace counters", labels=("name",))
+    for name, value in snap.get("counters", {}).items():
+        counters.labels(name=name).set(value)
+
+
+def mirror_goodput(report: dict, registry: Registry | None = None) -> None:
+    """Mirror a :func:`resilience.goodput` report into gauges: fraction,
+    per-category seconds, and step watermarks."""
+    reg = registry if registry is not None else default_registry()
+    frac = report.get("goodput_fraction")
+    if frac is not None:
+        reg.gauge("m2kt_goodput_fraction",
+                  "Fraction of wall-clock spent on productive steps"
+                  ).set(float(frac))
+    secs = reg.gauge("m2kt_goodput_seconds",
+                     "Wall seconds per goodput category",
+                     labels=("category",))
+    for cat, val in report.get("seconds", {}).items():
+        secs.labels(category=cat).set(float(val))
+    for key, name in (("steps_done", "m2kt_goodput_steps_done"),
+                      ("last_saved_step", "m2kt_goodput_last_saved_step")):
+        if key in report:
+            reg.gauge(name, f"Goodput watermark: {key}"
+                      ).set(float(report[key]))
+
+
+def install_trace_hook(registry: Registry | None = None) -> None:
+    """Refresh the trace mirror on every scrape."""
+    reg = registry if registry is not None else default_registry()
+    reg.add_collect_hook(lambda: mirror_trace(reg))
+
+
+def install_goodput_hook(tracker, registry: Registry | None = None) -> None:
+    """Refresh the goodput mirror from a live tracker on every scrape."""
+    reg = registry if registry is not None else default_registry()
+    reg.add_collect_hook(lambda: mirror_goodput(tracker.report(), reg))

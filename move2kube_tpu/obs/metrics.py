@@ -1,0 +1,336 @@
+"""Dependency-free, thread-safe metrics registry with Prometheus
+text-format v0.0.4 exposition.
+
+Why not prometheus_client: emitted images vendor this package next to the
+serving engine and must not grow a pip dependency (the container build is
+hermetic), and the subset a trainer/server needs — Counter, Gauge,
+Histogram, one exposition format — is small enough to own.
+
+Concurrency model: one re-entrant lock per registry guards the family
+table and every sample update. Updates are a dict write under the lock
+(~100ns); exposition walks a consistent snapshot. Collect hooks run
+*outside* the lock so they may themselves set gauges.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+# prometheus_client's default buckets: latency-shaped, seconds
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0)
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _escape_help(value: str) -> str:
+    return str(value).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(value: float) -> str:
+    """Prometheus sample formatting: integral floats render bare."""
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    f = float(value)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Family:
+    """One metric family: a name + help + label names + children keyed by
+    label-value tuples. A label-less family has a single child keyed ()."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: tuple[str, ...],
+                 lock: threading.RLock) -> None:
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        self._children: dict[tuple[str, ...], object] = {}
+
+    def labels(self, *values, **kwvalues):
+        if kwvalues:
+            if values:
+                raise ValueError("pass label values positionally or by "
+                                 "keyword, not both")
+            try:
+                values = tuple(str(kwvalues[n]) for n in self.labelnames)
+            except KeyError as e:
+                raise ValueError(f"missing label {e} for {self.name}") from e
+            if len(kwvalues) != len(self.labelnames):
+                raise ValueError(f"unexpected labels for {self.name}")
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes {len(self.labelnames)} label values, "
+                f"got {len(values)}")
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._children[values] = self._make_child()
+            return child
+
+    def _default_child(self):
+        if self.labelnames:
+            raise ValueError(f"{self.name} has labels "
+                             f"{self.labelnames}; use .labels(...)")
+        return self.labels()
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def _label_str(self, values: tuple[str, ...], extra: str = "") -> str:
+        parts = [f'{n}="{_escape_label(v)}"'
+                 for n, v in zip(self.labelnames, values)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def _render(self, out: list[str]) -> None:
+        out.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        for values in sorted(self._children):
+            self._render_child(out, values, self._children[values])
+
+    def _render_child(self, out, values, child) -> None:
+        raise NotImplementedError
+
+
+class _Value:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def _make_child(self):
+        return _CounterChild(self._lock)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+    def _render_child(self, out, values, child) -> None:
+        out.append(f"{self.name}{self._label_str(values)} "
+                   f"{_fmt(child.value)}")
+
+
+class _CounterChild:
+    def __init__(self, lock: threading.RLock) -> None:
+        self._lock = lock
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters can only go up")
+        with self._lock:
+            self.value += amount
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild(self._lock)
+
+    def set(self, value: float) -> None:
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default_child().inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+    def _render_child(self, out, values, child) -> None:
+        out.append(f"{self.name}{self._label_str(values)} "
+                   f"{_fmt(child.value)}")
+
+
+class _GaugeChild:
+    def __init__(self, lock: threading.RLock) -> None:
+        self._lock = lock
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames, lock,
+                 buckets=DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help, labelnames, lock)
+        edges = sorted(float(b) for b in buckets)
+        if not edges:
+            raise ValueError("histogram needs at least one bucket")
+        if edges[-1] != math.inf:
+            edges.append(math.inf)
+        self.buckets = tuple(edges)
+
+    def _make_child(self):
+        return HistogramChild(self.buckets, self._lock)
+
+    def observe(self, value: float) -> None:
+        self._default_child().observe(value)
+
+    def quantile(self, q: float) -> float:
+        return self._default_child().quantile(q)
+
+    @property
+    def count(self) -> int:
+        return self._default_child().count
+
+    @property
+    def sum(self) -> float:
+        return self._default_child().sum
+
+    def _render_child(self, out, values, child) -> None:
+        cumulative = 0
+        for edge, n in zip(self.buckets, child.bucket_counts):
+            cumulative += n
+            le = self._label_str(values, f'le="{_fmt(edge)}"')
+            out.append(f"{self.name}_bucket{le} {cumulative}")
+        out.append(f"{self.name}_sum{self._label_str(values)} "
+                   f"{_fmt(child.sum)}")
+        out.append(f"{self.name}_count{self._label_str(values)} "
+                   f"{child.count}")
+
+
+class HistogramChild:
+    """Fixed-bucket accumulator: O(buckets) memory no matter how many
+    observations — the bounded replacement for grow-forever latency
+    lists in long-running servers."""
+
+    def __init__(self, buckets: tuple[float, ...],
+                 lock: threading.RLock) -> None:
+        self.buckets = buckets
+        self._lock = lock
+        self.bucket_counts = [0] * len(buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.sum += value
+            self.count += 1
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    self.bucket_counts[i] += 1
+                    break
+
+    def quantile(self, q: float) -> float:
+        """Estimate a quantile by linear interpolation inside the bucket
+        the rank falls in (Prometheus ``histogram_quantile`` semantics).
+        Ranks landing in the +Inf bucket clamp to the last finite edge."""
+        with self._lock:
+            total = self.count
+            if total == 0:
+                return 0.0
+            rank = q * total
+            cumulative = 0
+            for i, edge in enumerate(self.buckets):
+                prev_cum = cumulative
+                cumulative += self.bucket_counts[i]
+                if cumulative >= rank and self.bucket_counts[i]:
+                    if edge == math.inf:
+                        finite = [e for e in self.buckets if e != math.inf]
+                        return finite[-1] if finite else 0.0
+                    lo = self.buckets[i - 1] if i else 0.0
+                    frac = (rank - prev_cum) / self.bucket_counts[i]
+                    return lo + (edge - lo) * min(1.0, max(0.0, frac))
+            return 0.0
+
+
+class Registry:
+    """Named metric families + get-or-create registration + exposition.
+
+    get-or-create (vs prometheus_client's register-once-or-raise) because
+    instruments live inside reusable classes (ServingEngine,
+    StepTelemetry) that tests construct many times per process."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._families: dict[str, _Family] = {}
+        self._collect_hooks: list = []
+
+    def _get_or_create(self, cls, name, help, labels, **kw) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if not isinstance(fam, cls):
+                    raise ValueError(
+                        f"{name} already registered as {fam.kind}")
+                return fam
+            fam = cls(name, help, tuple(labels), self._lock, **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: tuple[str, ...] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: tuple[str, ...] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: tuple[str, ...] = (),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels,
+                                   buckets=buckets)
+
+    def add_collect_hook(self, fn) -> None:
+        """Run ``fn()`` at every exposition, before rendering — the pull
+        model's answer to metrics whose source of truth lives elsewhere
+        (goodput tracker, trace recorder): refresh on scrape instead of
+        polling on a timer."""
+        with self._lock:
+            if fn not in self._collect_hooks:
+                self._collect_hooks.append(fn)
+
+    def render(self) -> str:
+        """Prometheus text-format v0.0.4 exposition of every family."""
+        for hook in list(self._collect_hooks):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - a bad hook must not 500 /metrics
+                pass
+        out: list[str] = []
+        with self._lock:
+            for name in sorted(self._families):
+                self._families[name]._render(out)
+        return "\n".join(out) + "\n" if out else ""
+
+
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    return _default

@@ -1,0 +1,150 @@
+"""Stdlib-HTTP telemetry server: /metrics, /healthz, /profile?seconds=N.
+
+One daemon thread per process (ThreadingHTTPServer: a slow profiler
+capture must not block a concurrent scrape). ``/profile`` drives
+``jax.profiler`` trace capture into ``M2KT_PROFILE_DIR`` on demand —
+the operator curls the pod, waits N seconds, and pulls the trace from
+the volume, no workload restart. jax is imported lazily so the server
+(and the whole obs package) stays importable in slim images.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from move2kube_tpu.obs.metrics import Registry, default_registry
+
+METRICS_PORT_ENV = "M2KT_METRICS_PORT"
+PROFILE_DIR_ENV = "M2KT_PROFILE_DIR"
+DEFAULT_METRICS_PORT = 9090
+DEFAULT_PROFILE_DIR = "/tmp/m2kt-profile"
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+MAX_PROFILE_SECONDS = 120.0
+
+
+def metrics_port_from_env(default: int = 0) -> int:
+    """Resolve the telemetry port: env wins, else the baked-in default;
+    0 (or garbage) means disabled."""
+    raw = os.environ.get(METRICS_PORT_ENV, "")
+    try:
+        return int(raw) if raw.strip() else int(default)
+    except (TypeError, ValueError):
+        return 0
+
+
+class TelemetryServer:
+    """Owns the HTTP listener + its serve thread. ``port=0`` binds an
+    OS-assigned port (tests); ``.port`` is the bound port either way."""
+
+    def __init__(self, port: int = 0, registry: Registry | None = None,
+                 profile_dir: str | None = None) -> None:
+        self.registry = registry if registry is not None else default_registry()
+        self.profile_dir = (profile_dir
+                            or os.environ.get(PROFILE_DIR_ENV, "")
+                            or DEFAULT_PROFILE_DIR)
+        self._profile_lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                server._route(self)
+
+            def log_message(self, fmt, *args) -> None:
+                pass  # scrapes every 15s would spam stderr
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="m2kt-telemetry",
+            daemon=True)
+
+    def start(self) -> "TelemetryServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- routing ----------------------------------------------------------
+
+    def _route(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        if parsed.path == "/metrics":
+            self._send(req, 200, self.registry.render(), CONTENT_TYPE)
+        elif parsed.path == "/healthz":
+            self._send(req, 200, "ok\n")
+        elif parsed.path == "/profile":
+            self._handle_profile(req, parse_qs(parsed.query))
+        else:
+            self._send(req, 404, "not found\n")
+
+    def _handle_profile(self, req, query: dict) -> None:
+        try:
+            seconds = float(query.get("seconds", ["1"])[0])
+        except (TypeError, ValueError):
+            self._send(req, 400, "seconds must be a number\n")
+            return
+        if not 0 < seconds <= MAX_PROFILE_SECONDS:
+            self._send(req, 400,
+                       f"seconds must be in (0, {MAX_PROFILE_SECONDS:g}]\n")
+            return
+        if not self._profile_lock.acquire(blocking=False):
+            self._send(req, 409, "a profile capture is already running\n")
+            return
+        try:
+            result = self._capture(seconds)
+        except Exception as e:  # noqa: BLE001 - surface, don't kill the server
+            self._send(req, 501, f"profiler unavailable: {e}\n")
+            return
+        finally:
+            self._profile_lock.release()
+        self._send(req, 200, json.dumps(result, sort_keys=True) + "\n",
+                   "application/json")
+
+    def _capture(self, seconds: float) -> dict:
+        import jax  # lazy: /metrics must work even where jax is absent
+
+        os.makedirs(self.profile_dir, exist_ok=True)
+        jax.profiler.start_trace(self.profile_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        return {"profile_dir": self.profile_dir, "seconds": seconds}
+
+    @staticmethod
+    def _send(req, code: int, body: str,
+              content_type: str = "text/plain; charset=utf-8") -> None:
+        payload = body.encode("utf-8")
+        req.send_response(code)
+        req.send_header("Content-Type", content_type)
+        req.send_header("Content-Length", str(len(payload)))
+        req.end_headers()
+        req.wfile.write(payload)
+
+
+def start_telemetry_server(port: int | None = None,
+                           registry: Registry | None = None,
+                           profile_dir: str | None = None
+                           ) -> TelemetryServer | None:
+    """Start the telemetry server. ``port=None`` resolves from
+    ``M2KT_METRICS_PORT`` and returns None when that says disabled (0 /
+    unset) — the shape the emitted templates use. An explicit ``port=0``
+    means "any free port" (tests)."""
+    if port is None:
+        port = metrics_port_from_env(0)
+        if port <= 0:
+            return None
+    try:
+        return TelemetryServer(port=port, registry=registry,
+                               profile_dir=profile_dir).start()
+    except OSError:
+        # never kill a training run over a busy metrics port
+        return None

@@ -209,6 +209,43 @@ def tpu_serving_optimizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_observability_optimizer(ir: IR) -> IR:
+    """Bake the telemetry port into accelerated services' pod env + a
+    named ``metrics`` container port.
+
+    Asks the SAME QA problem as the jax-xla emitter
+    (``m2kt.services.<name>.obs.port``) — cache-consistent with the
+    baked-in template default, env wins inside the workload. Port 0
+    disables telemetry entirely (no env, no port, and downstream no
+    scrape annotations). Runs AFTER port_merge on purpose: the metrics
+    port must not become a Service port forwarding. The named port is
+    what the optional PodMonitor's podMetricsEndpoints reference."""
+    for svc in ir.services.values():
+        if getattr(svc, "accelerator", None) is None:
+            continue
+        name = common.make_dns_label(svc.name)
+        raw = qa.fetch_input(
+            f"m2kt.services.{name}.obs.port",
+            f"Enter the telemetry (/metrics) port for [{name}]",
+            ["Prometheus exposition + on-demand XLA profiling; 0 disables"],
+            "9090")
+        try:
+            port = int(raw)
+        except (TypeError, ValueError):
+            port = 9090
+        if port <= 0:
+            continue
+        for container in svc.containers:
+            env = container.setdefault("env", [])
+            if "M2KT_METRICS_PORT" not in {e.get("name") for e in env}:
+                env.append({"name": "M2KT_METRICS_PORT",
+                            "value": str(port)})
+            ports = container.setdefault("ports", [])
+            if not any(p.get("name") == "metrics" for p in ports):
+                ports.append({"containerPort": port, "name": "metrics"})
+    return ir
+
+
 OPTIMIZERS = [
     normalize_character_optimizer,
     ingress_optimizer,
@@ -217,6 +254,7 @@ OPTIMIZERS = [
     port_merge_optimizer,
     tpu_training_optimizer,
     tpu_serving_optimizer,
+    tpu_observability_optimizer,
 ]
 
 

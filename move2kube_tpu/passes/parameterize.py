@@ -100,9 +100,32 @@ def tpu_serving_parameterizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_obs_parameterizer(ir: IR) -> IR:
+    """Lift the telemetry port the observability optimizer injected
+    (``M2KT_METRICS_PORT``) into chart values
+    (``--set tpumetricsport=9464``). The scrape annotation reads the SAME
+    env value at apiresource time, so in Helm output the annotation
+    becomes ``{{ .Values.tpumetricsport }}`` too — port and annotation
+    cannot drift."""
+    for svc in ir.services.values():
+        if getattr(svc, "accelerator", None) is None:
+            continue
+        for container in svc.containers:
+            for env in container.get("env", []) or []:
+                if env.get("name") != "M2KT_METRICS_PORT":
+                    continue
+                value = env.get("value")
+                if value is None or "{{" in str(value):
+                    continue
+                ir.values.global_variables.setdefault("tpumetricsport",
+                                                      str(value))
+                env["value"] = "{{ .Values.tpumetricsport }}"
+    return ir
+
+
 PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
                   storage_class_parameterizer, tpu_training_parameterizer,
-                  tpu_serving_parameterizer]
+                  tpu_serving_parameterizer, tpu_obs_parameterizer]
 
 
 def parameterize(ir: IR) -> IR:

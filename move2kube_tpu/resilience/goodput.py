@@ -153,6 +153,18 @@ def merge_attempts(attempts: list[dict]) -> dict:
     }
 
 
+def mirror_to_obs(report: dict, registry=None) -> None:
+    """Fold a report into an obs metrics registry (gauges: fraction,
+    per-category seconds, step watermarks) so a pod's ``/metrics`` scrape
+    carries goodput next to the step telemetry. No-op when the vendored
+    image doesn't ship obs."""
+    try:
+        from move2kube_tpu.obs.bridge import mirror_goodput
+    except Exception:  # noqa: BLE001 - slim vendored images
+        return
+    mirror_goodput(report, registry)
+
+
 def mirror_to_trace(report: dict, prefix: str = "goodput") -> None:
     """Fold a report into ``utils.trace`` counters (milliseconds) so the
     pod metrics file carries goodput next to the pipeline spans. No-op

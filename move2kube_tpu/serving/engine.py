@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from move2kube_tpu.obs.metrics import Registry
 from move2kube_tpu.serving import kvcache
 from move2kube_tpu.serving.kvcache import (
     NULL_PAGE,
@@ -46,6 +47,12 @@ from move2kube_tpu.serving.kvcache import (
     scatter_prefill,
     spec_for_model,
 )
+
+
+# decode steps run sub-ms on TPU and tens of ms on forced host devices;
+# span both so percentile interpolation has resolution at either end
+LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 
 
 def _default_buckets(max_seq: int) -> tuple[int, ...]:
@@ -131,7 +138,8 @@ class ServingEngine:
     only the KV cache is donated, parameters stay shared across steps.
     """
 
-    def __init__(self, model, variables, config: EngineConfig | None = None):
+    def __init__(self, model, variables, config: EngineConfig | None = None,
+                 registry: Registry | None = None):
         self.model = model
         self.variables = variables
         self.config = config or EngineConfig.from_env()
@@ -148,9 +156,58 @@ class ServingEngine:
         # decode stats for the bench phase (tokens/s, p50/p95 per token)
         self._decode_time = 0.0
         self._decode_tokens = 0
-        self._step_latencies: list[float] = []
         self._prefill_count = 0
+        self._submit_ts: dict[str, float] = {}
+        # a private registry by default: engine instruments must not
+        # cross-pollute between engines tests build in one process; the
+        # serve template passes obs.default_registry() so /metrics sees it
+        self.registry = registry if registry is not None else Registry()
+        self._init_metrics()
         self._snapshot_persistent_cache()
+
+    def _init_metrics(self) -> None:
+        reg = self.registry
+        # fixed-bucket histograms: bounded memory for long-running
+        # servers (stats() used to keep a grow-forever latency list)
+        self._lat_hist = reg.histogram(
+            "m2kt_serve_token_latency_seconds",
+            "Per-token decode step latency", buckets=LATENCY_BUCKETS)
+        self._ttft_hist = reg.histogram(
+            "m2kt_serve_ttft_seconds",
+            "Time from submit to first token (queue wait + prefill)",
+            buckets=LATENCY_BUCKETS)
+        self._queue_depth = reg.gauge(
+            "m2kt_serve_queue_depth", "Requests waiting for a decode slot")
+        self._active_slots = reg.gauge(
+            "m2kt_serve_active_slots", "Decode slots currently occupied")
+        self._slot_occupancy = reg.gauge(
+            "m2kt_serve_slot_occupancy",
+            "Fraction of decode slots occupied")
+        self._page_util = reg.gauge(
+            "m2kt_serve_page_pool_utilization",
+            "Fraction of KV-cache pages allocated")
+        self._admitted = reg.counter(
+            "m2kt_serve_admitted_total", "Requests admitted into a slot")
+        self._rejected = reg.counter(
+            "m2kt_serve_rejected_total",
+            "Requests rejected at submit (too long / empty)")
+        self._completed = reg.counter(
+            "m2kt_serve_completed_total", "Completed sequences by reason",
+            labels=("reason",))
+        self._decode_steps_total = reg.counter(
+            "m2kt_serve_decode_steps_total", "Decode steps executed")
+        self._tokens_total = reg.counter(
+            "m2kt_serve_decode_tokens_total", "Tokens generated")
+        self._total_pages = max(1, self.cache_cfg.num_pages - 1)  # page 0 reserved
+        self._update_occupancy()
+
+    def _update_occupancy(self) -> None:
+        active = sum(1 for s in self._slots if s is not None)
+        self._queue_depth.set(len(self._pending))
+        self._active_slots.set(active)
+        self._slot_occupancy.set(active / max(1, self.config.max_batch))
+        self._page_util.set(
+            1.0 - self._allocator.available / self._total_pages)
 
     # ------------------------------------------------------------------
     # jitted device steps (the ONLY code that runs on the accelerator)
@@ -200,17 +257,23 @@ class ServingEngine:
     def submit(self, req: Request) -> None:
         plen = len(req.prompt)
         max_new = req.max_new_tokens or self.config.max_new_tokens
-        if plen < 1:
-            raise ValueError(f"{req.rid}: empty prompt")
-        if plen > self.buckets[-1]:
-            raise ValueError(
-                f"{req.rid}: prompt length {plen} exceeds the largest "
-                f"prefill bucket {self.buckets[-1]}")
-        if plen + max_new > self.cache_cfg.max_seq:
-            raise ValueError(
-                f"{req.rid}: prompt + max_new_tokens = {plen + max_new} "
-                f"exceeds max_seq {self.cache_cfg.max_seq}")
+        try:
+            if plen < 1:
+                raise ValueError(f"{req.rid}: empty prompt")
+            if plen > self.buckets[-1]:
+                raise ValueError(
+                    f"{req.rid}: prompt length {plen} exceeds the largest "
+                    f"prefill bucket {self.buckets[-1]}")
+            if plen + max_new > self.cache_cfg.max_seq:
+                raise ValueError(
+                    f"{req.rid}: prompt + max_new_tokens = {plen + max_new} "
+                    f"exceeds max_seq {self.cache_cfg.max_seq}")
+        except ValueError:
+            self._rejected.inc()
+            raise
+        self._submit_ts[req.rid] = time.perf_counter()
         self._pending.append(req)
+        self._queue_depth.set(len(self._pending))
 
     def has_work(self) -> bool:
         return bool(self._pending) or any(
@@ -235,7 +298,9 @@ class ServingEngine:
         produced = int(active_mask.sum())
         self._decode_time += dt
         self._decode_tokens += produced
-        self._step_latencies.append(dt)
+        self._lat_hist.observe(dt)
+        self._decode_steps_total.inc()
+        self._tokens_total.inc(produced)
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -245,6 +310,7 @@ class ServingEngine:
             done = self._finish_reason(slot, tok)
             if done:
                 finished.append(self._release(i, done))
+        self._update_occupancy()
         return finished
 
     def run(self, requests) -> list[Completion]:
@@ -276,6 +342,8 @@ class ServingEngine:
         slot = self._slots[slot_idx]
         self._allocator.free(slot.pages)
         self._slots[slot_idx] = None
+        self._completed.labels(reason=reason).inc()
+        self._update_occupancy()
         return Completion(rid=slot.req.rid, prompt_len=len(slot.req.prompt),
                           tokens=list(slot.tokens), finish_reason=reason)
 
@@ -311,6 +379,10 @@ class ServingEngine:
             np.int32(slot_idx), np.int32(plen))
         self._cache = cache
         self._prefill_count += 1
+        self._admitted.inc()
+        submit_ts = self._submit_ts.pop(req.rid, None)
+        if submit_ts is not None:
+            self._ttft_hist.observe(time.perf_counter() - submit_ts)
         tok = int(first)
         slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
                      max_new=max_new)
@@ -375,20 +447,17 @@ class ServingEngine:
         return report
 
     def stats(self) -> dict:
-        lat = sorted(self._step_latencies)
-
-        def pct(p: float) -> float:
-            if not lat:
-                return 0.0
-            return lat[min(len(lat) - 1, int(p * len(lat)))]
-
+        # percentiles come from the fixed-bucket histogram (bucket-edge
+        # interpolation), NOT a per-step latency list: a server decoding
+        # for weeks must not grow host memory with every step. Keys are
+        # unchanged — /stats consumers and the bench phase still parse.
         return {
-            "decode_steps": len(self._step_latencies),
+            "decode_steps": int(self._lat_hist.count),
             "decode_tokens": self._decode_tokens,
             "prefills": self._prefill_count,
             "decode_throughput_tokens_s": (
                 self._decode_tokens / self._decode_time
                 if self._decode_time else 0.0),
-            "decode_p50_latency_ms": pct(0.50) * 1e3,
-            "decode_p95_latency_ms": pct(0.95) * 1e3,
+            "decode_p50_latency_ms": self._lat_hist.quantile(0.50) * 1e3,
+            "decode_p95_latency_ms": self._lat_hist.quantile(0.95) * 1e3,
         }

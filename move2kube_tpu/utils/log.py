@@ -7,9 +7,11 @@ stdlib logging.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
+import time
 
 _CONFIGURED = False
 
@@ -36,6 +38,34 @@ class _ColorFormatter(logging.Formatter):
         return msg
 
 
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per line (M2KT_LOG_JSON=1): what log pipelines
+    (Fluent Bit / Cloud Logging) expect from pods — no ANSI, no
+    multi-line records, structured level + logger fields."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": round(record.created or time.time(), 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            entry["exc"] = self.formatException(record.exc_info)
+        return json.dumps(entry, ensure_ascii=False)
+
+
+def _pick_formatter() -> logging.Formatter:
+    """JSON when M2KT_LOG_JSON asks for it; otherwise the leveled
+    formatter, colored only for an interactive stderr that hasn't set
+    NO_COLOR (https://no-color.org: any value, even empty, disables)."""
+    if os.environ.get("M2KT_LOG_JSON", "").strip().lower() in (
+            "1", "true", "yes", "on"):
+        return _JsonFormatter()
+    use_color = sys.stderr.isatty() and "NO_COLOR" not in os.environ
+    return _ColorFormatter(use_color)
+
+
 def configure(verbose: bool = False) -> None:
     """Configure the root m2kt logger. Idempotent; later calls adjust level."""
     global _CONFIGURED
@@ -43,8 +73,7 @@ def configure(verbose: bool = False) -> None:
     logger.setLevel(logging.DEBUG if verbose else logging.INFO)
     if not _CONFIGURED:
         handler = logging.StreamHandler(sys.stderr)
-        use_color = sys.stderr.isatty() and os.environ.get("NO_COLOR") is None
-        handler.setFormatter(_ColorFormatter(use_color))
+        handler.setFormatter(_pick_formatter())
         logger.addHandler(handler)
         logger.propagate = False
         _CONFIGURED = True

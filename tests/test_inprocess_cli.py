@@ -72,6 +72,32 @@ def test_cli_translate_gpu_training_samples(tmp_path, monkeypatch):
     assert "JobSet" in kinds
 
 
+def test_cli_translate_resets_trace_between_runs(tmp_path, monkeypatch):
+    """Each translate run starts a fresh trace recorder: counters and
+    span totals from an earlier in-process run (or a long-lived REST/API
+    host) must not leak into the next run's m2kt-metrics.json — and,
+    since the obs bridge mirrors the recorder into /metrics, must not
+    inflate a served m2kt_trace_counter either."""
+    from move2kube_tpu.utils import trace
+
+    monkeypatch.chdir(tmp_path)
+    src = os.path.join(SAMPLES, "python")
+    counts = []
+    for out in ("out1", "out2"):
+        _reset_qa()
+        try:
+            assert cli_main.main(["translate", "-s", src, "-o", out,
+                                  "--qa-skip", "--profile"]) == 0
+        finally:
+            _reset_qa()
+        counts.append(trace.get().to_dict()["counters"]["services"])
+    # the second run's recorder saw only its own services (no doubling)
+    assert counts[0] == counts[1] == 1
+    metrics = yaml.safe_load(
+        open(tmp_path / "out2" / "m2kt-metrics.json"))
+    assert metrics["counters"]["services"] == 1
+
+
 def test_cli_env_override_and_ignore_env(tmp_path, monkeypatch):
     """M2KT_* env overrides CLI defaults (viper parity): the project name
     comes from M2KT_NAME; --ignore-env additionally gates environment

@@ -1,0 +1,651 @@
+"""Runtime telemetry plane: metrics registry semantics, Prometheus
+text-format exposition, the stdlib telemetry HTTP server (/metrics,
+/healthz, /profile), training-step telemetry, the goodput/trace mirrors,
+log-formatter selection, and the scrape-annotation emission path
+(optimizer -> parameterizer -> k8s / Knative / Helm outputs)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import math
+import os
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+import yaml
+
+from move2kube_tpu.apiresource.base import convert_objects
+from move2kube_tpu.apiresource.deployment import (
+    DeploymentAPIResource,
+    metrics_port_value,
+    pod_template,
+    scrape_annotations,
+)
+from move2kube_tpu.apiresource.knative import KnativeServiceAPIResource
+from move2kube_tpu.engine import planner, translator
+from move2kube_tpu.models.train import (
+    StepTelemetry,
+    grad_norm_from_state,
+    instrument_optimizer,
+)
+from move2kube_tpu.obs import bridge
+from move2kube_tpu.obs.metrics import Registry
+from move2kube_tpu.obs.server import (
+    CONTENT_TYPE,
+    TelemetryServer,
+    metrics_port_from_env,
+    start_telemetry_server,
+)
+from move2kube_tpu.passes.optimize import tpu_observability_optimizer
+from move2kube_tpu.passes.parameterize import tpu_obs_parameterizer
+from move2kube_tpu.qa import engine as qaengine
+from move2kube_tpu.types.ir import IR, Service
+from move2kube_tpu.types.plan import AcceleratorInfo, TargetArtifactType
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE_SAMPLE = os.path.join(REPO, "samples", "gpu-training", "llama-serve")
+TRAIN_SAMPLE = os.path.join(REPO, "samples", "gpu-training", "resnet")
+
+
+# ----------------------------------------------------------------------
+# registry semantics
+# ----------------------------------------------------------------------
+
+
+def test_counter_and_gauge_basics():
+    reg = Registry()
+    c = reg.counter("m2kt_t_requests_total", "req")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    try:
+        c.inc(-1)
+        raise AssertionError("negative counter inc must raise")
+    except ValueError:
+        pass
+    g = reg.gauge("m2kt_t_depth", "depth")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3
+
+
+def test_registry_get_or_create_and_kind_conflict():
+    reg = Registry()
+    a = reg.counter("m2kt_t_x", "x")
+    assert reg.counter("m2kt_t_x") is a  # same family back, not a clash
+    try:
+        reg.gauge("m2kt_t_x")
+        raise AssertionError("kind conflict must raise")
+    except ValueError:
+        pass
+
+
+def test_histogram_cumulative_bucket_math():
+    reg = Registry()
+    h = reg.histogram("m2kt_t_lat", "lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.render()
+    # cumulative counts: each bucket includes everything below it
+    assert 'm2kt_t_lat_bucket{le="0.1"} 1' in text
+    assert 'm2kt_t_lat_bucket{le="1"} 2' in text
+    assert 'm2kt_t_lat_bucket{le="10"} 3' in text
+    assert 'm2kt_t_lat_bucket{le="+Inf"} 4' in text
+    assert "m2kt_t_lat_count 4" in text
+    assert "m2kt_t_lat_sum 55.55" in text
+    assert h.count == 4 and abs(h.sum - 55.55) < 1e-9
+
+
+def test_histogram_quantiles_interpolate_and_clamp():
+    reg = Registry()
+    h = reg.histogram("m2kt_t_q", "q", buckets=(1.0, 2.0))
+    for v in (0.5, 0.5, 1.5, 1.5):
+        h.observe(v)
+    assert h.quantile(0.5) == 1.0  # rank lands on the first bucket edge
+    assert abs(h.quantile(0.75) - 1.5) < 1e-9  # halfway into [1, 2]
+    assert h.quantile(1.0) == 2.0
+    # monotone in q
+    assert h.quantile(0.5) <= h.quantile(0.95) <= h.quantile(1.0)
+    h.observe(99.0)  # +Inf bucket: clamps to the last finite edge
+    assert h.quantile(1.0) == 2.0
+    empty = reg.histogram("m2kt_t_q_empty", "q", buckets=(1.0,))
+    assert empty.quantile(0.5) == 0.0
+
+
+def test_label_escaping_and_label_validation():
+    reg = Registry()
+    c = reg.counter("m2kt_t_lbl", "lbl", labels=("code",))
+    c.labels(code='a"b\\c\nd').inc()
+    text = reg.render()
+    assert 'm2kt_t_lbl{code="a\\"b\\\\c\\nd"} 1' in text
+    try:
+        c.inc()  # label-less shortcut is invalid on a labeled family
+        raise AssertionError("labeled family must require .labels()")
+    except ValueError:
+        pass
+    try:
+        c.labels(code="x", extra="y")
+        raise AssertionError("unexpected label must raise")
+    except ValueError:
+        pass
+
+
+def test_exposition_golden():
+    reg = Registry()
+    c = reg.counter("m2kt_t_requests_total", "Requests served")
+    c.inc()
+    c.inc(2)
+    reg.gauge("m2kt_t_temp", "Temperature").set(1.5)
+    h = reg.histogram("m2kt_t_seconds", "Latency", buckets=(0.5, 1.0))
+    h.observe(0.25)
+    h.observe(2.0)
+    assert reg.render() == (
+        "# HELP m2kt_t_requests_total Requests served\n"
+        "# TYPE m2kt_t_requests_total counter\n"
+        "m2kt_t_requests_total 3\n"
+        "# HELP m2kt_t_seconds Latency\n"
+        "# TYPE m2kt_t_seconds histogram\n"
+        'm2kt_t_seconds_bucket{le="0.5"} 1\n'
+        'm2kt_t_seconds_bucket{le="1"} 1\n'
+        'm2kt_t_seconds_bucket{le="+Inf"} 2\n'
+        "m2kt_t_seconds_sum 2.25\n"
+        "m2kt_t_seconds_count 2\n"
+        "# HELP m2kt_t_temp Temperature\n"
+        "# TYPE m2kt_t_temp gauge\n"
+        "m2kt_t_temp 1.5\n")
+
+
+def test_collect_hook_refreshes_on_render():
+    reg = Registry()
+    g = reg.gauge("m2kt_t_hooked", "hooked")
+    calls = []
+    reg.add_collect_hook(lambda: (calls.append(1), g.set(len(calls))))
+    reg.add_collect_hook(lambda: 1 / 0)  # a bad hook must not break render
+    assert "m2kt_t_hooked 1" in reg.render()
+    assert "m2kt_t_hooked 2" in reg.render()
+
+
+# ----------------------------------------------------------------------
+# telemetry HTTP server
+# ----------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode()
+
+
+def test_server_metrics_healthz_and_404():
+    reg = Registry()
+    reg.counter("m2kt_t_srv_total", "srv").inc(7)
+    srv = TelemetryServer(port=0, registry=reg).start()
+    try:
+        code, ctype, body = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        assert code == 200 and ctype == CONTENT_TYPE
+        assert "version=0.0.4" in ctype
+        assert "m2kt_t_srv_total 7" in body
+        code, _, body = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert code == 200 and body == "ok\n"
+        try:
+            _get(f"http://127.0.0.1:{srv.port}/nope")
+            raise AssertionError("unknown path must 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.close()
+
+
+def test_server_profile_endpoint(tmp_path):
+    """/profile drives jax.profiler on the forced host devices: a capture
+    writes a trace under profile_dir and replies with JSON."""
+    srv = TelemetryServer(port=0, registry=Registry(),
+                          profile_dir=str(tmp_path / "prof")).start()
+    try:
+        jnp.zeros((8,)).block_until_ready()  # something to trace
+        code, ctype, body = _get(
+            f"http://127.0.0.1:{srv.port}/profile?seconds=0.05")
+        assert code == 200 and ctype.startswith("application/json")
+        doc = json.loads(body)
+        assert doc["seconds"] == 0.05
+        assert doc["profile_dir"] == str(tmp_path / "prof")
+        assert os.path.isdir(doc["profile_dir"])
+        for bad in ("abc", "0", "-1", "1e9"):
+            try:
+                _get(f"http://127.0.0.1:{srv.port}/profile?seconds={bad}")
+                raise AssertionError(f"seconds={bad} must 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400, bad
+    finally:
+        srv.close()
+
+
+def test_start_telemetry_server_env_resolution(monkeypatch):
+    monkeypatch.delenv("M2KT_METRICS_PORT", raising=False)
+    assert metrics_port_from_env(0) == 0
+    assert start_telemetry_server() is None  # unset -> disabled
+    monkeypatch.setenv("M2KT_METRICS_PORT", "0")
+    assert start_telemetry_server() is None  # explicit 0 -> disabled
+    monkeypatch.setenv("M2KT_METRICS_PORT", "garbage")
+    assert metrics_port_from_env(9090) == 0  # garbage fails closed
+    srv = start_telemetry_server(port=0, registry=Registry())
+    try:
+        assert srv is not None and srv.port > 0  # explicit 0 = any free port
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------------------------
+# training-step telemetry
+# ----------------------------------------------------------------------
+
+
+def test_step_telemetry_records_values():
+    reg = Registry()
+    telem = StepTelemetry(registry=reg, items_per_step=100, unit="tokens")
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    tx = instrument_optimizer(optax.sgd(0.1))
+    opt_state = tx.init(params)
+    grads = {"w": jnp.full((3,), 2.0, jnp.float32)}
+    _, opt_state = tx.update(grads, opt_state, params)
+    state = types.SimpleNamespace(opt_state=opt_state)
+    norm = grad_norm_from_state(state)
+    assert norm is not None and abs(norm - math.sqrt(12.0)) < 1e-5
+
+    telem.record_step(5, 0.5, loss=1.25, state=state)
+    text = reg.render()
+    assert "m2kt_train_steps_total 1" in text
+    assert "m2kt_train_step 5" in text
+    assert "m2kt_train_loss 1.25" in text
+    assert "m2kt_train_tokens_per_second 200" in text
+    assert "m2kt_train_step_seconds_count 1" in text
+    assert 'm2kt_train_step_seconds_bucket{le="0.5"} 1' in text
+    assert "m2kt_train_grad_norm 3.464" in text
+
+    telem.record_compile(2.0)
+    telem.record_compile(1.0)
+    text = reg.render()
+    assert "m2kt_train_compile_events_total 2" in text
+    assert "m2kt_train_compile_seconds_total 3" in text
+
+
+def test_step_telemetry_device_memory_gauge():
+    reg = Registry()
+    telem = StepTelemetry(registry=reg, mem_every=1)
+    keep = jnp.ones((128,), jnp.float32)  # noqa: F841 - held live on purpose
+    keep.block_until_ready()
+    telem.record_step(1, 0.01)
+    fam = reg.gauge("m2kt_train_device_live_bytes")
+    assert fam.value >= 128 * 4
+
+
+def test_uninstrumented_optimizer_has_no_grad_norm():
+    params = {"w": jnp.ones((2,), jnp.float32)}
+    tx = optax.sgd(0.1)
+    state = types.SimpleNamespace(opt_state=tx.init(params))
+    assert grad_norm_from_state(state) is None
+
+
+def test_goodput_and_trace_mirrors():
+    reg = Registry()
+    bridge.mirror_goodput(
+        {"goodput_fraction": 0.8,
+         "seconds": {"productive": 10.0, "compile": 2.5},
+         "steps_done": 42, "last_saved_step": 40}, reg)
+    rec_snapshot = {"spans": {"translate.write": 1.5},
+                    "counters": {"services": 3}}
+    bridge.mirror_trace(
+        reg, recorder=types.SimpleNamespace(to_dict=lambda: rec_snapshot))
+    text = reg.render()
+    assert "m2kt_goodput_fraction 0.8" in text
+    assert 'm2kt_goodput_seconds{category="productive"} 10' in text
+    assert "m2kt_goodput_steps_done 42" in text
+    assert "m2kt_goodput_last_saved_step 40" in text
+    assert 'm2kt_trace_span_seconds_total{span="translate.write"} 1.5' in text
+    assert 'm2kt_trace_counter{name="services"} 3' in text
+
+
+def test_goodput_report_mirrors_into_registry():
+    from move2kube_tpu.resilience.goodput import GoodputTracker, mirror_to_obs
+
+    reg = Registry()
+    gp = GoodputTracker()
+    gp.add("productive", 8.0, steps=4)
+    gp.add("compile", 2.0)
+    mirror_to_obs(gp.report(), reg)
+    text = reg.render()
+    assert "m2kt_goodput_fraction 0.8" in text
+    assert 'm2kt_goodput_seconds{category="compile"} 2' in text
+    assert "m2kt_goodput_steps_done 4" in text
+
+
+# ----------------------------------------------------------------------
+# serving-engine instruments (cheap invariants; decode metrics are
+# exercised end-to-end by the bench obs/serving phases)
+# ----------------------------------------------------------------------
+
+
+def test_engine_publishes_admission_metrics():
+    from move2kube_tpu.models.gpt2 import GPT2, gpt2_tiny
+    from move2kube_tpu.serving.engine import EngineConfig, Request, \
+        ServingEngine
+
+    cfg = dataclasses.replace(gpt2_tiny(), dtype=jnp.float32)
+    model = GPT2(cfg)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    reg = Registry()
+    eng = ServingEngine(model, variables,
+                        EngineConfig(max_batch=2, max_seq=32, block_size=8),
+                        registry=reg)
+    with pytest.raises(ValueError):
+        eng.submit(Request(rid="bad", prompt=[], max_new_tokens=1))
+    eng.submit(Request(rid="ok", prompt=[1, 2, 3], max_new_tokens=1))
+    text = reg.render()
+    assert "m2kt_serve_rejected_total 1" in text
+    assert "m2kt_serve_queue_depth 1" in text
+    assert "m2kt_serve_page_pool_utilization 0" in text
+    stats = eng.stats()
+    assert {"decode_steps", "decode_tokens", "prefills",
+            "decode_throughput_tokens_s", "decode_p50_latency_ms",
+            "decode_p95_latency_ms"} <= set(stats)
+    assert stats["decode_p50_latency_ms"] <= stats["decode_p95_latency_ms"] \
+        or stats["decode_steps"] == 0
+
+
+# ----------------------------------------------------------------------
+# log formatter selection (NO_COLOR / M2KT_LOG_JSON)
+# ----------------------------------------------------------------------
+
+
+def test_log_json_formatter(monkeypatch):
+    from move2kube_tpu.utils import log as m2kt_log
+
+    monkeypatch.setenv("M2KT_LOG_JSON", "1")
+    fmt = m2kt_log._pick_formatter()
+    assert isinstance(fmt, m2kt_log._JsonFormatter)
+    rec = logging.LogRecord("m2kt.test", logging.WARNING, __file__, 1,
+                            "hello %s", ("world",), None)
+    doc = json.loads(fmt.format(rec))
+    assert doc["level"] == "warning"
+    assert doc["logger"] == "m2kt.test"
+    assert doc["msg"] == "hello world"
+    assert isinstance(doc["ts"], float)
+
+
+def test_log_color_disabled_by_no_color_and_non_tty(monkeypatch):
+    from move2kube_tpu.utils import log as m2kt_log
+
+    monkeypatch.delenv("M2KT_LOG_JSON", raising=False)
+    monkeypatch.setenv("NO_COLOR", "")  # any value, even empty, disables
+    fmt = m2kt_log._pick_formatter()
+    assert isinstance(fmt, m2kt_log._ColorFormatter) and not fmt.use_color
+    monkeypatch.delenv("NO_COLOR", raising=False)
+    # pytest captures stderr -> not a tty -> still no color codes
+    fmt = m2kt_log._pick_formatter()
+    rec = logging.LogRecord("m2kt", logging.INFO, __file__, 1, "x", (), None)
+    assert "\x1b[" not in fmt.format(rec)
+
+
+# ----------------------------------------------------------------------
+# scrape-annotation emission: IR passes + apiresources
+# ----------------------------------------------------------------------
+
+
+class _AnswerEngine(qaengine.Engine):
+    """Resolve specific QA ids with canned answers; everything else falls
+    through to the default engine installed after it."""
+
+    def __init__(self, answers: dict):
+        self.answers = answers
+
+    def fetch_answer(self, problem):
+        if problem.id in self.answers:
+            problem.set_answer(self.answers[problem.id])
+        return problem
+
+
+def _qa(answers: dict | None = None):
+    qaengine.reset_engines()
+    if answers:
+        qaengine.add_engine(_AnswerEngine(answers))
+    qaengine.start_engine(qa_skip=True)
+
+
+def _accel_service(name="trainer", serving=False):
+    svc = Service(name=name)
+    svc.accelerator = AcceleratorInfo(
+        gpu_count=4, tpu_accelerator="tpu-v5p-slice", tpu_topology="2x2x1",
+        serving=serving, serving_port=8000 if serving else 0)
+    svc.job = not serving
+    svc.containers.append({"name": name, "image": f"r/{name}:latest"})
+    ir = IR(name="p")
+    ir.add_service(svc)
+    return ir, svc
+
+
+def test_metrics_port_value_and_scrape_annotations():
+    _, svc = _accel_service()
+    assert metrics_port_value(svc) is None
+    assert scrape_annotations(svc) == {}
+    svc.containers[0]["env"] = [{"name": "M2KT_METRICS_PORT", "value": "0"}]
+    assert scrape_annotations(svc) == {}  # "0" means telemetry off
+    svc.containers[0]["env"] = [{"name": "M2KT_METRICS_PORT",
+                                 "value": "9090"}]
+    assert scrape_annotations(svc) == {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": "9090",
+        "prometheus.io/path": "/metrics",
+    }
+
+
+def test_obs_optimizer_injects_env_and_named_port():
+    ir, svc = _accel_service()
+    _qa()
+    try:
+        ir = tpu_observability_optimizer(ir)
+        ir = tpu_observability_optimizer(ir)  # idempotent
+    finally:
+        qaengine.reset_engines()
+    c = svc.containers[0]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["M2KT_METRICS_PORT"] == "9090"
+    metrics_ports = [p for p in c["ports"] if p.get("name") == "metrics"]
+    assert metrics_ports == [{"containerPort": 9090, "name": "metrics"}]
+
+
+def test_obs_optimizer_port_zero_disables():
+    ir, svc = _accel_service()
+    _qa({"m2kt.services.trainer.obs.port": "0"})
+    try:
+        ir = tpu_observability_optimizer(ir)
+    finally:
+        qaengine.reset_engines()
+    assert "env" not in svc.containers[0]
+    assert scrape_annotations(svc) == {}
+
+
+def test_obs_optimizer_skips_unaccelerated_services():
+    ir = IR(name="p")
+    svc = Service(name="web")
+    svc.containers.append({"name": "web", "image": "r/web:latest"})
+    ir.add_service(svc)
+    _qa()
+    try:
+        tpu_observability_optimizer(ir)
+    finally:
+        qaengine.reset_engines()
+    assert "env" not in svc.containers[0]
+
+
+def test_obs_parameterizer_lifts_metrics_port():
+    ir, svc = _accel_service()
+    svc.containers[0]["env"] = [{"name": "M2KT_METRICS_PORT",
+                                 "value": "9464"}]
+    ir = tpu_obs_parameterizer(ir)
+    assert ir.values.global_variables["tpumetricsport"] == "9464"
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_METRICS_PORT"] == "{{ .Values.tpumetricsport }}"
+    # the annotation helper reads the SAME value: port and annotation
+    # cannot drift in Helm output
+    ann = scrape_annotations(svc)
+    assert ann["prometheus.io/port"] == "{{ .Values.tpumetricsport }}"
+
+
+def test_pod_template_carries_scrape_annotations():
+    _, svc = _accel_service()
+    svc.containers[0]["env"] = [{"name": "M2KT_METRICS_PORT",
+                                 "value": "9090"}]
+    tmpl = pod_template(svc, {"app": "trainer"})
+    assert tmpl["metadata"]["annotations"]["prometheus.io/scrape"] == "true"
+    assert tmpl["metadata"]["annotations"]["prometheus.io/port"] == "9090"
+
+
+def test_jobset_pods_annotated_via_apiresource():
+    ir, svc = _accel_service()
+    _qa()
+    try:
+        ir = tpu_observability_optimizer(ir)
+        objs = convert_objects(ir, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+    jobsets = [o for o in objs if o.get("kind") == "JobSet"]
+    assert jobsets
+    pod_tmpl = jobsets[0]["spec"]["replicatedJobs"][0][
+        "template"]["spec"]["template"]
+    ann = pod_tmpl["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/port"] == "9090"
+    assert ann["prometheus.io/path"] == "/metrics"
+    # default knob: annotations only, no PodMonitor
+    assert not [o for o in objs if o.get("kind") == "PodMonitor"]
+
+
+def test_podmonitor_behind_qa_knob():
+    ir, _ = _accel_service()
+    _qa({"m2kt.services.trainer.obs.podmonitor": True})
+    try:
+        ir = tpu_observability_optimizer(ir)
+        objs = convert_objects(ir, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+    pms = [o for o in objs if o.get("kind") == "PodMonitor"]
+    assert len(pms) == 1
+    pm = pms[0]
+    assert pm["apiVersion"] == "monitoring.coreos.com/v1"
+    assert pm["metadata"]["name"] == "trainer-metrics"
+    assert pm["spec"]["selector"]["matchLabels"][
+        "move2kube-tpu.io/service"] == "trainer"
+    assert pm["spec"]["podMetricsEndpoints"] == [
+        {"port": "metrics", "path": "/metrics"}]
+
+
+def test_knative_revision_annotated_and_single_port():
+    ir, svc = _accel_service(name="srv", serving=True)
+    svc.containers[0]["ports"] = [{"containerPort": 8000}]
+    _qa()
+    try:
+        ir = tpu_observability_optimizer(ir)
+        objs = convert_objects(ir, [KnativeServiceAPIResource(create=True)])
+    finally:
+        qaengine.reset_engines()
+    assert len(objs) == 1
+    tmpl = objs[0]["spec"]["template"]
+    ann = tmpl["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/port"] == "9090"
+    # knative validates at most one containerPort: the named metrics port
+    # must not reach the revision (the annotation carries the number)
+    ports = tmpl["spec"]["containers"][0]["ports"]
+    assert ports == [{"containerPort": 8000}]
+    # ...and the optimizer's IR-level port list was not mutated
+    assert any(p.get("name") == "metrics"
+               for p in svc.containers[0]["ports"])
+
+
+# ----------------------------------------------------------------------
+# emitted-output acceptance: scrape wiring + vendored obs package
+# ----------------------------------------------------------------------
+
+
+def _translate(src, out, name, artifact_type):
+    _qa()
+    try:
+        plan = planner.create_plan(src, name=name)
+        plan.kubernetes.artifact_type = artifact_type
+        translator.translate(plan, str(out))
+    finally:
+        qaengine.reset_engines()
+
+
+def test_knative_emission_serves_scrape_wiring(tmp_path):
+    out = tmp_path / "out"
+    _translate(SERVE_SAMPLE, out, "llamaserve", TargetArtifactType.KNATIVE)
+    obj = yaml.safe_load(
+        (out / "llamaserve" / "llama-serve-service.yaml").read_text())
+    tmpl = obj["spec"]["template"]
+    ann = tmpl["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/port"] == "9090"
+    assert ann["prometheus.io/path"] == "/metrics"
+    c = tmpl["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["M2KT_METRICS_PORT"] == "9090"
+    assert len(c["ports"]) == 1  # knative: traffic port only
+
+    cdir = out / "containers" / "llama-serve"
+    # the obs package is vendored into the image and the entrypoint
+    # defaults to the same port the annotation advertises
+    assert (cdir / "move2kube_tpu" / "obs" / "metrics.py").exists()
+    assert (cdir / "move2kube_tpu" / "obs" / "server.py").exists()
+    serve_src = (cdir / "serve_tpu.py").read_text()
+    assert 'os.environ.get("M2KT_METRICS_PORT", "9090")' in serve_src
+    assert "start_telemetry_server" in serve_src
+
+
+def test_k8s_training_emission_serves_scrape_wiring(tmp_path):
+    out = tmp_path / "out"
+    _translate(TRAIN_SAMPLE, out, "obstrain", TargetArtifactType.YAMLS)
+    jobset = yaml.safe_load(
+        (out / "obstrain" / "resnet-jobset.yaml").read_text())
+    pod_tmpl = jobset["spec"]["replicatedJobs"][0][
+        "template"]["spec"]["template"]
+    ann = pod_tmpl["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/port"] == "9090"
+    c = pod_tmpl["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["M2KT_METRICS_PORT"] == "9090"
+    assert {"containerPort": 9090, "name": "metrics"} in c["ports"]
+
+    cdir = out / "containers" / "resnet"
+    assert (cdir / "move2kube_tpu" / "obs" / "metrics.py").exists()
+    train_src = (cdir / "train_tpu.py").read_text()
+    assert 'os.environ.get("M2KT_METRICS_PORT", "9090")' in train_src
+    assert "start_telemetry_server" in train_src
+    assert "StepTelemetry" in train_src
+    assert "instrument_optimizer" in train_src
+
+
+def test_helm_emission_parameterizes_scrape_port(tmp_path):
+    out = tmp_path / "out"
+    _translate(SERVE_SAMPLE, out, "llamaserve", TargetArtifactType.HELM)
+    chart = out / "llamaserve"
+    values = yaml.safe_load((chart / "values.yaml").read_text())
+    assert str(values["globalvariables"]["tpumetricsport"]) == "9090"
+    tmpl_dir = chart / "templates"
+    rendered = "".join((tmpl_dir / f).read_text()
+                       for f in os.listdir(tmpl_dir) if f.endswith(".yaml"))
+    assert "prometheus.io/scrape" in rendered
+    # annotation and env reference the SAME chart value: a --set
+    # tpumetricsport=9464 retunes both together
+    assert rendered.count("{{ .Values.tpumetricsport }}") >= 2
+    assert "prometheus.io/port" in rendered
