@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -95,6 +95,14 @@ obs-smoke:
 # fallback, JobSet failure-policy YAML, goodput accounting
 fault-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
+
+# elastic multislice drill in isolation (all CPU-mode, 8 forced host
+# devices as 2 simulated slices): DCN-aware planner goldens, slice-loss
+# at step N -> supervisor re-plans onto the survivor slice -> resume from
+# the last checkpoint with the global batch preserved and loss continuity
+# against a never-faulted run; plus the elastic JobSet/Helm emission
+elastic-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_elastic.py -q
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

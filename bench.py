@@ -685,6 +685,42 @@ def bench_goodput(n: int) -> dict:
           f"{len(summary['attempts'])} attempts "
           f"(lost {merged['seconds']['lost']:.1f}s) in {dt:.1f}s",
           file=sys.stderr)
+
+    # second drill: lose one of two forced-host slices mid-run with the
+    # elastic supervisor on — the goodput fraction of a run that pays a
+    # re-plan + restore instead of dying. Same tiny workload, so the two
+    # fractions are directly comparable.
+    ework = tempfile.mkdtemp(prefix="m2kt-goodput-elastic-")
+    eexit = os.path.join(ework, "exit.json")
+    eenv = dict(
+        env,
+        M2KT_CKPT_DIR=os.path.join(ework, "ckpt"),
+        M2KT_FAULT_KIND="slice_loss",
+        M2KT_FAULT_MARKER=os.path.join(ework, "fault-fired"),
+        M2KT_FORCE_DEVICES="8",
+        M2KT_NUM_SLICES="2",
+        M2KT_BATCH_PER_DEVICE="2",
+        M2KT_ELASTIC="1",
+        M2KT_EXIT_FILE=eexit,
+        M2KT_GOODPUT_FILE=os.path.join(ework, "goodput.json"),
+    )
+    t1 = time.perf_counter()
+    eres = subprocess.run(
+        [sys.executable, "-m", "move2kube_tpu.resilience.supervisor", "--",
+         sys.executable, "-m", "move2kube_tpu.resilience.minitrain"],
+        env=eenv, cwd=ework, capture_output=True, text=True, timeout=600)
+    edt = time.perf_counter() - t1
+    if eres.returncode != 0:
+        raise RuntimeError(
+            f"elastic minitrain rc={eres.returncode}: {eres.stderr[-300:]}")
+    with open(eexit, encoding="utf-8") as f:
+        esummary = json.load(f)
+    emerged = esummary["goodput"]
+    print(f"[bench] slice-loss goodput {emerged['goodput_fraction']:.2%} "
+          f"(replan {emerged['seconds']['replan']:.2f}s, "
+          f"{len(esummary['replan_events'])} re-plan(s)) in {edt:.1f}s",
+          file=sys.stderr)
+
     metric, unit = PHASE_METRICS["goodput"]
     # no published baseline for faulted-run goodput on this workload
     return {"phase": "goodput", "metric": metric,
@@ -693,7 +729,12 @@ def bench_goodput(n: int) -> dict:
             "attempts": len(summary["attempts"]),
             "lost_s": merged["seconds"]["lost"],
             "retry_s": merged["seconds"]["retry"],
-            "steps_done": merged["steps_done"], "wall_s": round(dt, 2)}
+            "steps_done": merged["steps_done"],
+            "train_goodput_fraction_slice_loss":
+                emerged["goodput_fraction"],
+            "replan_s": emerged["seconds"]["replan"],
+            "replan_events": len(esummary["replan_events"]),
+            "wall_s": round(dt + edt, 2)}
 
 
 def bench_scaling(n: int) -> dict:
@@ -727,7 +768,9 @@ def bench_scaling(n: int) -> dict:
     print(f"[bench] scaling efficiency {probe['efficiency']:.3f} "
           f"(1dev {probe['per_device_items_s_1']:.1f} vs 8dev "
           f"{probe['per_device_items_s_8']:.1f} items/s/dev, "
-          f"mesh {probe['mesh_2x4']}) in {dt:.1f}s", file=sys.stderr)
+          f"mesh {probe['mesh_2x4']}; 2-slice "
+          f"{probe['efficiency_2slice']:.3f} dcn_dp={probe['dcn_dp']}) "
+          f"in {dt:.1f}s", file=sys.stderr)
     metric, unit = PHASE_METRICS["scaling"]
     # no published baseline: the phase is a machinery guard, the fraction
     # is only comparable across rounds of this repo
@@ -735,8 +778,11 @@ def bench_scaling(n: int) -> dict:
             "value": probe["efficiency"], "unit": unit,
             "vs_baseline": 0.0, "baseline": "none_published",
             "mesh_2x4": probe["mesh_2x4"], "mesh_4x4x4": probe["mesh_4x4x4"],
+            "mesh_2slice": probe["mesh_2slice"], "dcn_dp": probe["dcn_dp"],
             "per_device_items_s_1": probe["per_device_items_s_1"],
             "per_device_items_s_8": probe["per_device_items_s_8"],
+            "per_device_items_s_2slice": probe["per_device_items_s_2slice"],
+            "efficiency_2slice": probe["efficiency_2slice"],
             "overlap_path": probe["overlap_path"], "wall_s": round(dt, 2)}
 
 
@@ -791,16 +837,30 @@ def run_scaling_probe() -> int:
         jax.block_until_ready(loss)
         return calls / (time.perf_counter() - t0)
 
+    # multislice variant: the same 8 host devices planned as 2 slices of
+    # 2x2 — DP crosses the (simulated) DCN boundary, the slice-major perm
+    # reorders the device list. On one CPU host both meshes hit the same
+    # cores, so the interesting guard is that the dcn_dp plan compiles and
+    # steps at parity with the flat plan, not a real DCN cost.
+    plan2s = plan_parallelism(8, topology="2x2", num_slices=2)
+    mesh2s = make_mesh(plan2s)
+
     steps_s_1 = run(mesh1, (b_per_dev, seq), 1)
     steps_s_8 = run(mesh8, (accum, 8 * b_per_dev, seq), accum)
+    steps_s_2s = run(mesh2s, (accum, 8 * b_per_dev, seq), accum)
     per_dev_1 = steps_s_1 * b_per_dev
     per_dev_8 = steps_s_8 * accum * 8 * b_per_dev / 8
+    per_dev_2s = steps_s_2s * accum * 8 * b_per_dev / 8
     print(json.dumps({
         "efficiency": round(per_dev_8 / per_dev_1, 4),
+        "efficiency_2slice": round(per_dev_2s / per_dev_1, 4),
         "per_device_items_s_1": round(per_dev_1, 2),
         "per_device_items_s_8": round(per_dev_8, 2),
+        "per_device_items_s_2slice": round(per_dev_2s, 2),
         "mesh_2x4": "x".join(str(d) for d in plan.config.dims()),
         "mesh_4x4x4": "x".join(str(d) for d in plan44.config.dims()),
+        "mesh_2slice": "x".join(str(d) for d in plan2s.config.dims()),
+        "dcn_dp": plan2s.dcn_dp,
         "overlap_path": bool(is_pure_data_parallel(mesh8)),
     }), flush=True)
     return 0

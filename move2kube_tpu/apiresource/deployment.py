@@ -19,6 +19,7 @@ import os
 
 from move2kube_tpu.apiresource.base import APIResource, make_obj, obj_kind
 from move2kube_tpu.resilience import preemption
+from move2kube_tpu.resilience.faults import SLICE_LOST_EXIT_CODE
 from move2kube_tpu.types.ir import IR, Service
 from move2kube_tpu.utils.log import get_logger
 
@@ -127,17 +128,29 @@ def _tpu_resources(svc: Service, workload_kind: str = JOB_SET) -> None:
         if num_slices > 1 and workload_kind == JOB_SET:
             # multi-slice: DP gradients ride DCN between slices (megascale);
             # each replicatedJob replica is one slice, its index published
-            # by the JobSet controller as the job-index annotation
+            # by the JobSet controller as the job-index annotation. The
+            # megascale coordinator resolves through the dedicated
+            # <name>-coord headless Service (selector pins slice-0 pod-0)
+            # rather than a per-pod DNS name: plain <svc>:<port> resolution
+            # works from any slice even before the subdomain records
+            # propagate, and survives Helm renaming the workload pods.
             slice_id_ref = {"fieldRef": {"fieldPath":
                 "metadata.annotations['jobset.sigs.k8s.io/job-index']"}}
-            for name, entry in (
+            entries = [
                 ("M2KT_NUM_SLICES", {"value": str(num_slices)}),
                 ("M2KT_SLICE_ID", {"valueFrom": slice_id_ref}),
                 ("MEGASCALE_NUM_SLICES", {"value": str(num_slices)}),
                 ("MEGASCALE_SLICE_ID", {"valueFrom": slice_id_ref}),
                 ("MEGASCALE_COORDINATOR_ADDRESS",
-                 {"value": f"{svc.name}-workers-0-0.{svc.name}:8080"}),
-            ):
+                 {"value": f"{svc.name}-coord:8080"}),
+            ]
+            elastic, min_slices = elastic_knobs(svc.name)
+            if elastic:
+                entries += [
+                    ("M2KT_ELASTIC", {"value": "1"}),
+                    ("M2KT_ELASTIC_MIN_SLICES", {"value": str(min_slices)}),
+                ]
+            for name, entry in entries:
                 if name not in existing:
                     env.append({"name": name, **entry})
     svc.node_selector.setdefault("cloud.google.com/gke-tpu-accelerator",
@@ -168,6 +181,56 @@ def _retry_budget(name: str, env_var: str, qa_suffix: str, desc: str,
         log.warning("non-integer answer %r for %s; keeping default %d",
                     answer, qa_suffix, default)
         return default
+
+
+def elastic_knobs(name: str) -> tuple[bool, int]:
+    """Resolve the elastic-restart knobs for a multislice service:
+    whether a slice loss re-plans onto the survivors (``M2KT_ELASTIC``)
+    and the surviving-slice floor (``M2KT_ELASTIC_MIN_SLICES``).
+
+    Env wins (CI / one-off overrides); otherwise each is a QA problem —
+    the SAME ids (``m2kt.services.<name>.elastic`` / ``.elastic.minslices``)
+    the jax-xla emitter and the elastic optimizer pass ask, so one cached
+    answer keeps the baked-in template default, the pod env, and the
+    chart value agreed. Default is elastic ON: on preemptible multislice
+    capacity, losing a slice is weather, and training degraded beats a
+    full JobSet reschedule."""
+    from move2kube_tpu import qa
+    from move2kube_tpu.utils import common
+
+    name = common.make_dns_label(name)
+    raw = os.environ.get("M2KT_ELASTIC", "")
+    if raw in ("0", "1"):
+        elastic = raw == "1"
+    else:
+        elastic = qa.fetch_bool(
+            f"m2kt.services.{name}.elastic",
+            f"Keep training on the surviving slices when [{name}] loses a "
+            f"TPU slice?",
+            ["The in-pod supervisor re-plans the DCN data axis for the "
+             "survivors and resumes from the last checkpoint; override "
+             "via M2KT_ELASTIC"],
+            True)
+    raw = os.environ.get("M2KT_ELASTIC_MIN_SLICES", "")
+    min_slices = 0
+    if raw:
+        try:
+            min_slices = max(1, int(raw))
+        except ValueError:
+            log.warning("bad M2KT_ELASTIC_MIN_SLICES=%r; ignoring", raw)
+    if not min_slices:
+        answer = qa.fetch_input(
+            f"m2kt.services.{name}.elastic.minslices",
+            f"Minimum surviving slice count for [{name}] before the loss "
+            f"is terminal",
+            ["below this floor the JobSet failure policy reschedules the "
+             "whole set; override via M2KT_ELASTIC_MIN_SLICES"],
+            "1")
+        try:
+            min_slices = max(1, int(answer))
+        except (TypeError, ValueError):
+            min_slices = 1
+    return elastic, min_slices
 
 
 def _resilience_pod_hooks(template: dict) -> None:
@@ -219,7 +282,40 @@ class DeploymentAPIResource(APIResource):
             pm = self._maybe_podmonitor(svc, ir)
             if pm:
                 objs.append(pm)
+            if JOB_SET in supported_kinds:
+                coord = self._coordinator_service(svc)
+                if coord:
+                    objs.append(coord)
         return [o for o in objs if o]
+
+    @staticmethod
+    def _coordinator_service(svc: Service) -> dict | None:
+        """Headless Service resolving ``MEGASCALE_COORDINATOR_ADDRESS``
+        (``<name>-coord``) for multislice JobSets. The selector pins
+        slice 0's pod 0 via the labels the JobSet controller stamps on
+        every pod (jobset-name + job-index) and the indexed Job's
+        completion-index label; publishNotReadyAddresses because the
+        megascale transport dials during bootstrap, long before any
+        readiness probe can pass."""
+        acc = svc.accelerator
+        if acc is None or not svc.job or max(1, acc.num_slices) < 2:
+            return None
+        obj = make_obj("Service", "v1", f"{svc.name}-coord",
+                       {SELECTOR_LABEL: svc.name})
+        obj["spec"] = {
+            "clusterIP": "None",
+            "publishNotReadyAddresses": True,
+            "selector": {
+                "jobset.sigs.k8s.io/jobset-name": svc.name,
+                "jobset.sigs.k8s.io/job-index": "0",
+                "batch.kubernetes.io/job-completion-index": "0",
+            },
+            "ports": [
+                {"name": "megascale", "port": 8080},
+                {"name": "coordinator", "port": 8476},
+            ],
+        }
+        return obj
 
     def _maybe_podmonitor(self, svc: Service, ir: IR) -> dict | None:
         """Optional prometheus-operator PodMonitor next to the workload,
@@ -362,12 +458,27 @@ class DeploymentAPIResource(APIResource):
         }
         if svc.restart_policy == "Never":
             # podFailurePolicy requires restartPolicy: Never
-            job_spec["podFailurePolicy"] = {"rules": [{
+            rules = [{
                 "action": "FailJob",
                 "onPodConditions": [
                     {"type": "DisruptionTarget", "status": "True"},
                 ],
-            }]}
+            }]
+            if max(1, acc.num_slices) > 1:
+                # terminal slice loss (supervisor exits 83: elastic off,
+                # or survivors under the floor) fails the job fast; the
+                # JobSet-level PodFailurePolicy rule then restarts the
+                # whole set without burning maxRestarts — same free-
+                # restart lane as preemption, because slice reclaim is
+                # capacity weather, not a code bug
+                rules.append({
+                    "action": "FailJob",
+                    "onExitCodes": {
+                        "operator": "In",
+                        "values": [SLICE_LOST_EXIT_CODE],
+                    },
+                })
+            job_spec["podFailurePolicy"] = {"rules": rules}
         obj["spec"] = {
             "failurePolicy": {
                 "maxRestarts": _retry_budget(

@@ -212,6 +212,21 @@ def _ask_training_knobs(name: str, family: str) -> tuple[str, int]:
     return precision, grad_accum
 
 
+def _ask_elastic_knobs(name: str, num_slices: int) -> tuple[bool, int]:
+    """Elastic slice-loss behavior as QA problems, for multislice
+    trainers only. Delegates to ``apiresource.deployment.elastic_knobs``
+    — the SAME ids (``m2kt.services.<name>.elastic`` /
+    ``.elastic.minslices``) the JobSet emitter and the elastic optimizer
+    pass ask, so the template's baked-in defaults and the pod env agree
+    through the QA cache. Single-slice services never ask: with no
+    survivor to re-plan onto, the knob is meaningless."""
+    if num_slices < 2:
+        return False, 1
+    from move2kube_tpu.apiresource.deployment import elastic_knobs
+
+    return elastic_knobs(name)
+
+
 def _ask_serving_knobs(name: str) -> dict:
     """Serving capacity knobs (max in-flight batch, context length, KV
     page size) as QA problems. IDs are shared with
@@ -370,6 +385,9 @@ def emit_container(service: PlanService, plan=None) -> Container:
             entry_rel = rel if rel is not None else os.path.basename(entry_rel)
     serve_port = acc.serving_port or 8080
     metrics_port = _ask_obs_port(name)
+    num_slices = max(1, acc.num_slices)
+    elastic, elastic_min_slices = (
+        (False, 1) if serving else _ask_elastic_knobs(name, num_slices))
     if serving:
         acc.serving_port = serve_port
         serve_knobs = _ask_serving_knobs(name)
@@ -406,6 +424,9 @@ def emit_container(service: PlanService, plan=None) -> Container:
                                     or "tpu-v5-lite-podslice"),
                 "tpu_topology": acc.tpu_topology or "1x1",
                 "num_hosts": acc.num_hosts,
+                "num_slices": num_slices,
+                "elastic": elastic,
+                "elastic_min_slices": elastic_min_slices,
                 "mesh": mesh,
                 "zero_stage": degrees["zero_stage"],
                 "tensor_parallel": degrees["tensor_parallel"],

@@ -151,6 +151,43 @@ class CheckpointManager:
         durably on disk before the process may die."""
         self._mngr.wait_until_finished()
 
+    def install_exit_flush(self) -> None:
+        """Guarantee in-flight async saves land on EVERY interpreter
+        exit path that runs teardown — including ``sys.exit`` from an
+        injected fault or a slice-loss death (exit code 83), which
+        bypasses the training loop's normal ``close()``. Without this
+        barrier an async save started one cadence before the death is
+        silently dropped and the supervisor's restarted attempt resumes
+        a cadence early. Best-effort by design: the process is dying, so
+        a failed flush must not mask the original exit code. (SIGKILL
+        still skips interpreter teardown — that loss is priced into the
+        goodput ledger's ``lost`` category, not recoverable from inside.)
+
+        Registered via ``threading._register_atexit``, NOT ``atexit``:
+        orbax commits checkpoints through concurrent.futures executors,
+        and CPython joins those executor threads in ``threading._shutdown``
+        — which runs *before* atexit callbacks. An atexit-time flush
+        finds the executors already shut down and the commit dies with
+        "cannot schedule new futures after shutdown". Threading-atexit
+        callbacks run LIFO before that teardown; this method is called
+        after orbax's import registered its own handler, so the flush
+        sees live executors."""
+        import threading
+
+        def _flush() -> None:
+            try:
+                self._mngr.wait_until_finished()
+            except Exception:  # noqa: BLE001 - dying process, best effort
+                pass
+
+        register = getattr(threading, "_register_atexit", None)
+        if register is None:  # pre-3.9 fallback: better late than never
+            import atexit
+
+            atexit.register(_flush)
+        else:
+            register(_flush)
+
     def close(self) -> None:
         """Block until in-flight async saves land, then release."""
         self._mngr.wait_until_finished()

@@ -33,11 +33,17 @@ import re
 _DEFAULT_DIR = os.path.join("~", ".cache", "m2kt-jax-cache")
 
 
-def topology_fingerprint(mesh) -> str:
+def topology_fingerprint(mesh, num_slices: int = 1) -> str:
     """Filesystem-safe cache-key component for a concrete mesh:
-    ``<device_kind>-n<ndev>-<dim x dim x ...>-<axisinitials>``. Empty
-    string for None or device-less (abstract) meshes — those callers get
-    the unpartitioned directory."""
+    ``<device_kind>-n<ndev>-<dim x dim x ...>-<axisinitials>[-s<K>]``.
+    Empty string for None or device-less (abstract) meshes — those
+    callers get the unpartitioned directory.
+
+    ``num_slices`` > 1 appends a ``-s<K>`` slice tag: the same logical
+    mesh laid over 2 DCN-connected slices and over one big ICI slice
+    lowers to different collectives (DCN transfers vs ICI rings), and an
+    elastic restart that shrinks the slice count must not deserialize
+    the pre-loss generation's executables."""
     if mesh is None:
         return ""
     try:
@@ -49,11 +55,14 @@ def topology_fingerprint(mesh) -> str:
     except Exception:  # noqa: BLE001 - AbstractMesh etc: no fingerprint
         return ""
     kind = re.sub(r"[^A-Za-z0-9_.-]+", "_", kind)
-    return f"{kind}-n{n}-{dims}-{axes}"
+    fp = f"{kind}-n{n}-{dims}-{axes}"
+    if num_slices > 1:
+        fp += f"-s{num_slices}"
+    return fp
 
 
 def setup_compilation_cache(default_dir: str | None = None,
-                            mesh=None) -> str | None:
+                            mesh=None, num_slices: int = 1) -> str | None:
     """Enable jax's persistent compilation cache; returns the directory
     in use, or None when disabled or unsupported.
 
@@ -69,7 +78,7 @@ def setup_compilation_cache(default_dir: str | None = None,
     path = (os.environ.get("M2KT_COMPILE_CACHE_DIR") or default_dir
             or _DEFAULT_DIR)
     path = os.path.abspath(os.path.expanduser(path))
-    fp = topology_fingerprint(mesh)
+    fp = topology_fingerprint(mesh, num_slices=num_slices)
     if fp:
         path = os.path.join(path, fp)
     try:

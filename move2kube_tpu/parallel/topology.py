@@ -19,6 +19,15 @@ desired parallelism degrees into a :class:`MeshPlan`:
   contiguous physical dims, with the heaviest-traffic axis placed on
   wraparound (torus) dims first.
 
+Multislice (``M2KT_NUM_SLICES`` > 1): the topology string describes ONE
+ICI slice; slices are connected by DCN. Only data parallelism tolerates
+DCN latency (the invariant gpu_detect.py documents), so the planner
+plans each slice independently — memory-model dp×fsdp re-split, layout,
+permutation all per-slice — and multiplies the data extent by a
+``dcn_dp`` outer factor, one data-axis block per slice. ``data`` is the
+outermost mesh axis, so in the row-major device enumeration each slice's
+devices stay contiguous and every non-data collective rides ICI.
+
 Traffic ranking follows per-step collective volume: tensor parallelism
 all-reduces activations every layer (heaviest), sequence/context and
 expert parallelism exchange activation-sized blocks per layer, fsdp
@@ -113,6 +122,10 @@ class MeshPlan:
     perm: tuple[int, ...] = ()
     layout: dict[str, tuple[int, ...]] = field(default_factory=dict)
     source: str = "planner"
+    # DCN data-parallel factor: number of ICI slices the data axis spans
+    # (config.data == dcn_dp x per-slice data). topology/layout/ici_cost
+    # describe ONE slice; perm covers all slices (slice-major blocks).
+    dcn_dp: int = 1
 
     @property
     def ici_cost(self) -> float:
@@ -148,7 +161,9 @@ class MeshPlan:
         lay = ",".join(
             f"{a}@{'+'.join(str(d) for d in ds)}" for a, ds in sorted(self.layout.items())
         )
-        return f"mesh={dims} topology={topo} layout=[{lay}] source={self.source}"
+        slices = f" dcn_dp={self.dcn_dp}" if self.dcn_dp > 1 else ""
+        return (f"mesh={dims} topology={topo} layout=[{lay}]{slices} "
+                f"source={self.source}")
 
 
 def _memory_min_fsdp(
@@ -255,6 +270,7 @@ def plan_parallelism(
     param_bytes: int | None = None,
     optimizer_slots: int = 2,
     headroom: float = 0.9,
+    num_slices: int = 1,
 ) -> MeshPlan:
     """Full plan: logical extents + physical placement.
 
@@ -265,10 +281,21 @@ def plan_parallelism(
     state in ``headroom`` x HBM — the memory model deciding the axis
     split rather than the user.  Placement then maps each axis onto the
     parsed ICI grid (see :func:`_assign_layout`).
+
+    ``num_slices`` > 1 plans ONE slice of ``n_devices // num_slices``
+    devices (``topology`` describes a single slice) and multiplies the
+    data extent by the resulting ``dcn_dp`` — DP gradients ride DCN
+    between slices, every other collective stays on intra-slice ICI. A
+    device count that doesn't divide into the slices falls back to a
+    single-slice plan rather than producing a ragged mesh.
     """
     n_devices = max(1, n_devices)
+    num_slices = max(1, num_slices)
+    if num_slices > 1 and n_devices % num_slices:
+        num_slices = 1
+    per_slice = n_devices // num_slices
     config = infer_mesh_config(
-        n_devices,
+        per_slice,
         zero_stage=zero_stage,
         tensor_parallel=tensor_parallel,
         seq_parallel=seq_parallel,
@@ -283,16 +310,19 @@ def plan_parallelism(
             dims = parse_topology(topology)
         except ValueError:
             dims = ()
-        if dims and int(np.prod(dims)) == n_devices:
+        if dims and int(np.prod(dims)) == per_slice:
             topo = Topology(dims=dims, slice_type=slice_type)
         else:
             source = "fallback-chain"
     if topo is None:
         # no/mismatched topology: model the slice as a 1-D chain so the
         # permutation is identity and only the memory split applies
-        topo = Topology(dims=(n_devices,), slice_type=slice_type)
+        topo = Topology(dims=(per_slice,), slice_type=slice_type)
 
     if param_bytes and zero_stage < 2 and config.data > 1:
+        # per-slice re-split: each slice holds a full replica pool of
+        # config.data x config.fsdp chips; DCN neighbours can't shard
+        # parameters (per-layer all-gathers would ride DCN every step)
         resident = config.data * config.fsdp
         fsdp = _memory_min_fsdp(
             resident, config.tensor, param_bytes, topo.hbm_bytes(), headroom,
@@ -308,11 +338,25 @@ def plan_parallelism(
         return MeshPlan(config=config, topology=topo, perm=(0,), layout={},
                         source="single-chip")
 
-    perm, layout = _build_perm(topo, config)
-    if not layout:
-        source = "fallback-chain" if source == "planner" else source
+    if per_slice == 1:
+        perm, layout = tuple(range(per_slice)), {}
+    else:
+        perm, layout = _build_perm(topo, config)
+        if not layout:
+            source = "fallback-chain" if source == "planner" else source
+    if num_slices > 1:
+        # slice-major blocks: data is the outermost mesh axis, so block s
+        # of the data axis == slice s's contiguous device range and every
+        # non-data axis stays within one slice (ICI)
+        perm = tuple(s * per_slice + p
+                     for s in range(num_slices) for p in perm)
+        config = MeshConfig(
+            data=config.data * num_slices, fsdp=config.fsdp,
+            pipe=config.pipe, tensor=config.tensor, seq=config.seq,
+            expert=config.expert,
+        )
     return MeshPlan(config=config, topology=topo, perm=perm, layout=layout,
-                    source=source)
+                    source=source, dcn_dp=num_slices)
 
 
 def _env_mesh_config(env) -> MeshConfig | None:
@@ -338,14 +382,25 @@ def resolve_mesh_plan(
     pipeline_parallel: int = 1,
     expert_parallel: int = 1,
     param_bytes: int | None = None,
+    num_slices: int | None = None,
     env=None,
 ) -> MeshPlan:
     """What the emitted trainer calls at startup: resolve the mesh from
     ``M2KT_TPU_TOPOLOGY`` / ``M2KT_TPU_ACCELERATOR`` (injected by the
     deployment emitter from the JobSet's topology annotation), with
     ``M2KT_MESH_*`` as an explicit override and the emitter's QA-derived
-    parallelism degrees as planner inputs."""
+    parallelism degrees as planner inputs.
+
+    ``num_slices=None`` reads ``M2KT_NUM_SLICES`` (the JobSet's
+    replicated-slice count, shrunk by the elastic supervisor after a
+    slice loss) so a restarted attempt re-plans for the surviving world
+    without any caller changes."""
     env = os.environ if env is None else env
+    if num_slices is None:
+        try:
+            num_slices = int(env.get("M2KT_NUM_SLICES", "1") or 1)
+        except ValueError:
+            num_slices = 1
     explicit = _env_mesh_config(env)
     if explicit is not None and explicit.total() == n_devices:
         return MeshPlan(config=explicit, topology=None,
@@ -360,4 +415,5 @@ def resolve_mesh_plan(
         pipeline_parallel=pipeline_parallel,
         expert_parallel=expert_parallel,
         param_bytes=param_bytes,
+        num_slices=num_slices,
     )

@@ -209,6 +209,40 @@ def tpu_serving_optimizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_elastic_optimizer(ir: IR) -> IR:
+    """Bake the elastic-restart knobs into multislice training services'
+    pod env (``M2KT_ELASTIC`` / ``M2KT_ELASTIC_MIN_SLICES``).
+
+    Delegates to ``apiresource.deployment.elastic_knobs`` — the SAME QA
+    ids (``m2kt.services.<name>.elastic`` / ``.elastic.minslices``) the
+    JobSet emitter asks, answered once and cached, so the pod env and the
+    failure-policy wiring can't disagree. Single-slice services are
+    skipped: with no surviving slice to re-plan onto, elastic mode is
+    meaningless and the knob would only confuse the operator."""
+    from move2kube_tpu.apiresource.deployment import elastic_knobs
+
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if (acc is None or getattr(acc, "serving", False)
+                or not getattr(svc, "job", False)
+                or max(1, getattr(acc, "num_slices", 1)) < 2):
+            continue
+        name = common.make_dns_label(svc.name)
+        elastic, min_slices = elastic_knobs(name)
+        if not elastic:
+            continue
+        for container in svc.containers:
+            env = container.setdefault("env", [])
+            existing = {e.get("name") for e in env}
+            for env_name, value in (
+                ("M2KT_ELASTIC", "1"),
+                ("M2KT_ELASTIC_MIN_SLICES", str(min_slices)),
+            ):
+                if env_name not in existing:
+                    env.append({"name": env_name, "value": value})
+    return ir
+
+
 def tpu_observability_optimizer(ir: IR) -> IR:
     """Bake the telemetry port into accelerated services' pod env + a
     named ``metrics`` container port.
@@ -254,6 +288,7 @@ OPTIMIZERS = [
     port_merge_optimizer,
     tpu_training_optimizer,
     tpu_serving_optimizer,
+    tpu_elastic_optimizer,
     tpu_observability_optimizer,
 ]
 

@@ -100,6 +100,33 @@ def tpu_serving_parameterizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_elastic_parameterizer(ir: IR) -> IR:
+    """Lift the elastic-restart knobs the elastic optimizer / JobSet
+    emitter injected (``M2KT_ELASTIC`` / ``M2KT_ELASTIC_MIN_SLICES``)
+    into chart values, so a Helm install flips slice-loss behavior per
+    environment (``--set tpuelastic=0``) without touching the manifests.
+
+    Only env entries with a literal ``value`` are lifted: the multislice
+    block also injects ``valueFrom``/fieldRef entries (``M2KT_SLICE_ID``,
+    ``MEGASCALE_SLICE_ID`` read the JobSet job-index annotation) and
+    those must survive parameterization untouched — a fieldRef rewritten
+    into a template string would break every slice's identity."""
+    lifted = {"M2KT_ELASTIC": "tpuelastic",
+              "M2KT_ELASTIC_MIN_SLICES": "tpuelasticminslices"}
+    for svc in ir.services.values():
+        if getattr(svc, "accelerator", None) is None:
+            continue
+        for container in svc.containers:
+            for env in container.get("env", []) or []:
+                key = lifted.get(env.get("name"))
+                value = env.get("value")
+                if not key or value is None or "{{" in str(value):
+                    continue
+                ir.values.global_variables.setdefault(key, str(value))
+                env["value"] = f"{{{{ .Values.{key} }}}}"
+    return ir
+
+
 def tpu_obs_parameterizer(ir: IR) -> IR:
     """Lift the telemetry port the observability optimizer injected
     (``M2KT_METRICS_PORT``) into chart values
@@ -125,7 +152,8 @@ def tpu_obs_parameterizer(ir: IR) -> IR:
 
 PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
                   storage_class_parameterizer, tpu_training_parameterizer,
-                  tpu_serving_parameterizer, tpu_obs_parameterizer]
+                  tpu_serving_parameterizer, tpu_elastic_parameterizer,
+                  tpu_obs_parameterizer]
 
 
 def parameterize(ir: IR) -> IR:

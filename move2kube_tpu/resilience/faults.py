@@ -11,8 +11,14 @@ Knobs (all inert when unset — production pods never set them):
 - ``M2KT_FAULT_STEP``      — step number at which the fault fires
 - ``M2KT_FAULT_KIND``      — ``exit`` (sys.exit, default) | ``raise``
   (RuntimeError, reads as a retryable crash) | ``sigkill`` (os.kill
-  SIGKILL: the no-cleanup death a host failure produces)
+  SIGKILL: the no-cleanup death a host failure produces) |
+  ``slice_loss`` (a whole DCN-connected slice reclaimed: exits with
+  :data:`SLICE_LOST_EXIT_CODE` after naming the lost slice on stderr,
+  which the supervisor classifies as ``slice_lost`` and — in elastic
+  mode — answers by re-planning on the survivors)
 - ``M2KT_FAULT_EXIT_CODE`` — exit code for ``exit`` (default 1)
+- ``M2KT_FAULT_SLICE``     — which slice ``slice_loss`` reclaims
+  (default 1, i.e. the last slice of a 2-slice job)
 - ``M2KT_FAULT_MARKER``    — path to an exactly-once marker: the fault
   fires only when the file is absent and creates it first, so the
   supervisor's restarted attempt survives. Without a marker the fault
@@ -29,6 +35,12 @@ import signal
 import sys
 
 log = logging.getLogger("m2kt.faults")
+
+# Exit code for a slice-loss death (EX-range, unused by jax/python/shell
+# conventions). The emitted JobSet's podFailurePolicy keys a
+# restart-without-burning-maxRestarts rule on it, and the in-pod
+# supervisor classifies it as ``slice_lost``.
+SLICE_LOST_EXIT_CODE = 83
 
 
 class FaultInjected(RuntimeError):
@@ -72,6 +84,17 @@ def maybe_inject(step: int) -> None:
         raise FaultInjected(f"injected transient fault at step {step}")
     if kind == "sigkill":
         os.kill(os.getpid(), signal.SIGKILL)
+    if kind == "slice_loss":
+        # a reclaimed slice takes all of its processes with it; survivors
+        # see the DCN collectives break. Either way the job dies — with a
+        # distinctive exit code plus a stderr line naming the lost slice,
+        # so the supervisor's classifier (and a human reading the pod
+        # log) sees slice_lost, not a generic crash. stderr, not stdout:
+        # the supervisor classifies on the stderr tail.
+        lost = os.environ.get("M2KT_FAULT_SLICE", "1")
+        print(f"[m2kt] FAULT: slice_loss: slice {lost} reclaimed at step "
+              f"{step}; DCN peers unreachable", file=sys.stderr, flush=True)
+        sys.exit(SLICE_LOST_EXIT_CODE)
     sys.exit(int(os.environ.get("M2KT_FAULT_EXIT_CODE", "1")))
 
 

@@ -18,6 +18,10 @@ Categories:
 - ``save``       — synchronous checkpoint waits (async saves overlap
   compute and cost ~nothing; the last-chance save is synchronous);
 - ``retry``      — supervisor backoff sleeps between attempts;
+- ``replan``     — elastic slice-loss recovery: the supervisor's pause
+  before relaunching with a re-planned (shrunken ``dcn_dp``) mesh —
+  kept separate from ``retry`` because it is the price of surviving
+  capacity reclaim, not of flaky code;
 - ``lost``       — work after the last checkpoint flush that a failure
   threw away (recomputed on resume).
 
@@ -32,7 +36,8 @@ import os
 import time
 from contextlib import contextmanager
 
-CATEGORIES = ("productive", "compile", "restore", "save", "retry", "lost")
+CATEGORIES = ("productive", "compile", "restore", "save", "retry", "replan",
+              "lost")
 
 DEFAULT_FILENAME = "m2kt-goodput.json"
 

@@ -4,16 +4,30 @@ The fault-injection harness needs a *runnable* training child — real
 jit-compiled steps, real orbax checkpoints, real resume — that finishes
 in seconds on one CPU device. This module is that child: the CI target
 for kill-at-step-N / corrupt-checkpoint proofs (``tests/test_resilience``,
-``make fault-smoke``) and the workload behind ``bench.py``'s goodput
+``make fault-smoke``), the 2-slice elastic drill (``tests/test_elastic``,
+``make elastic-smoke``) and the workload behind ``bench.py``'s goodput
 phase. It deliberately mirrors the structure of the emitted
-``train_tpu.py`` loop (restore → step/fault/save → preempt check →
-goodput flush) so what CI proves here is the same control flow the
-emitted trainers run on a slice.
+``train_tpu.py`` loop (plan mesh → restore → step/fault/save → preempt
+check → goodput flush) so what CI proves here is the same control flow
+the emitted trainers run on a slice.
 
 Run under the supervisor::
 
     python -m move2kube_tpu.resilience.supervisor -- \
         python -m move2kube_tpu.resilience.minitrain
+
+Multislice on CPU: ``M2KT_FORCE_DEVICES=N`` forces an N-device host
+platform (rewrites ``XLA_FLAGS`` before jax loads), and
+``M2KT_NUM_SLICES=K`` makes the planner lay a ``dcn_dp=K`` outer data
+axis over them — a faithful single-process model of K DCN-connected
+slices. The elastic supervisor shrinks both after a slice loss, so the
+restarted attempt genuinely re-plans for a smaller world.
+
+Batch: global batch = ``M2KT_BATCH_PER_DEVICE`` (default 4) x the
+planned data x fsdp extents. Each step's batch is seeded by the step
+number alone, so two runs with the same *global* batch see identical
+data regardless of how many slices shard it — the loss-continuity
+invariant the elastic drill asserts.
 
 Knobs: ``M2KT_STEPS`` (default 8), ``M2KT_CKPT_DIR``/``M2KT_CKPT_EVERY``
 (checkpointing off when unset, like the emitted trainers),
@@ -28,10 +42,34 @@ import os
 import sys
 import time
 
+_FORCE_FLAG = "--xla_force_host_platform_device_count"
+
+
+def apply_forced_devices(environ=None) -> int | None:
+    """Honor ``M2KT_FORCE_DEVICES`` by rewriting ``XLA_FLAGS`` in place.
+
+    Must run before jax is imported — the flag is read once at backend
+    init. Returns the forced count, or None when the knob is unset or
+    malformed (existing flags untouched). Any prior force flag (e.g. the
+    test conftest's 8-device default) is replaced, not appended: XLA
+    takes the first occurrence, so appending would silently lose.
+    """
+    env = os.environ if environ is None else environ
+    raw = env.get("M2KT_FORCE_DEVICES", "")
+    if not raw.isdigit() or int(raw) < 1:
+        return None
+    n = int(raw)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith(_FORCE_FLAG)]
+    flags.append(f"{_FORCE_FLAG}={n}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return n
+
 
 def main() -> None:
     # a CPU harness by definition: never grab a TPU someone is using
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    apply_forced_devices()
 
     import jax
     import jax.numpy as jnp
@@ -41,12 +79,14 @@ def main() -> None:
 
     from move2kube_tpu.models import checkpoint as m2kt_ckpt
     from move2kube_tpu.models import train as m2kt_train
-    from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+    from move2kube_tpu.parallel.mesh import make_mesh
+    from move2kube_tpu.parallel.topology import resolve_mesh_plan
     from move2kube_tpu.resilience import faults, goodput, preemption
 
     steps = int(os.environ.get("M2KT_STEPS", "8"))
     step_sleep = float(os.environ.get("M2KT_STEP_SLEEP_S", "0"))
-    batch, dim = 4, 8
+    bpd = int(os.environ.get("M2KT_BATCH_PER_DEVICE", "4") or 4)
+    dim = 8
 
     gp = goodput.GoodputTracker()
     watcher = preemption.from_env()
@@ -58,7 +98,14 @@ def main() -> None:
         def __call__(self, x):
             return nn.Dense(4)(nn.relu(nn.Dense(8)(x)))
 
-    mesh = make_mesh(MeshConfig(data=jax.device_count()))
+    # same startup as the emitted trainers: plan (num_slices from
+    # M2KT_NUM_SLICES — shrunk by the elastic supervisor after a slice
+    # loss), then lay the mesh in plan order
+    plan = resolve_mesh_plan(jax.device_count())
+    mesh = make_mesh(plan)
+    batch = bpd * plan.config.data * plan.config.fsdp
+    print(f"[m2kt] plan: {plan.describe()} devices={jax.device_count()} "
+          f"global_batch={batch}", flush=True)
     sample = {"x": jnp.zeros((batch, dim))}
     state = m2kt_train.create_sharded_state(
         jax.random.PRNGKey(0), Tiny(), sample, optax.sgd(1e-2), mesh)
@@ -84,29 +131,43 @@ def main() -> None:
             print(f"[m2kt] resumed from step {start}", flush=True)
 
     def make_batch(i: int) -> jnp.ndarray:
+        # seeded by step alone: the data stream is a function of (step,
+        # global batch), never of the mesh — an elastic restart that
+        # preserves the global batch sees bit-identical inputs
         return jnp.asarray(
             np.random.default_rng(i).random((batch, dim), np.float32))
 
     preempted_at = None
     loss = None
-    for i in range(start + 1, steps + 1):
-        faults.maybe_inject(i)
-        t0 = time.perf_counter()
-        state, loss = step_fn(state, make_batch(i))
-        jax.block_until_ready(loss)
-        if step_sleep:
-            time.sleep(step_sleep)
-        gp.add("compile" if i == start + 1 else "productive",
-               time.perf_counter() - t0, steps=1)
-        if ckpt is not None and ckpt.maybe_save(i, state):
-            # synchronous commit: the fault tests assert resume-from-N, so
-            # a save the loop reports must be durable before a kill can land
+    try:
+        for i in range(start + 1, steps + 1):
+            faults.maybe_inject(i)
+            t0 = time.perf_counter()
+            state, loss = step_fn(state, make_batch(i))
+            jax.block_until_ready(loss)
+            if step_sleep:
+                time.sleep(step_sleep)
+            gp.add("compile" if i == start + 1 else "productive",
+                   time.perf_counter() - t0, steps=1)
+            if ckpt is not None and ckpt.maybe_save(i, state):
+                # synchronous commit: the fault tests assert resume-from-N,
+                # so a save the loop reports must be durable before a kill
+                # can land
+                ckpt.wait()
+                gp.note_saved(i)
+                gp.write()
+            if watcher is not None and watcher.should_stop(i):
+                preempted_at = i
+                break
+    except SystemExit:
+        # injected fault (slice_loss exits 83, exit kind exits N) — an
+        # async save still in flight must land before the process dies,
+        # or the supervisor's restarted attempt resumes one cadence
+        # early. The goodput report is deliberately NOT re-flushed here:
+        # post-checkpoint work is the supervisor's "lost" span.
+        if ckpt is not None:
             ckpt.wait()
-            gp.note_saved(i)
-            gp.write()
-        if watcher is not None and watcher.should_stop(i):
-            preempted_at = i
-            break
+        raise
     if ckpt is not None:
         last = preempted_at if preempted_at is not None else steps
         with gp.phase("save"):
@@ -115,7 +176,7 @@ def main() -> None:
             ckpt.close()  # block: the last save must land before exit
         gp.note_saved(last)
     if loss is not None:
-        print(f"[m2kt] step={gp.steps_done} loss={float(loss):.4f}",
+        print(f"[m2kt] step={gp.steps_done} loss={float(loss):.6f}",
               flush=True)
     gp.write()
     rep = gp.report()

@@ -14,9 +14,22 @@ Behavior:
 
 - runs the trainer as a child, streaming its stderr through while
   keeping a tail for exit classification;
-- classifies each death as ``ok`` / ``preempted`` / ``retryable`` /
-  ``fatal`` (table below) and restarts retryable ones with exponential
-  backoff, up to ``M2KT_RETRY_MAX`` attempts;
+- classifies each death as ``ok`` / ``preempted`` / ``slice_lost`` /
+  ``retryable`` / ``fatal`` (table below) and restarts retryable ones
+  with exponential backoff, up to ``M2KT_RETRY_MAX`` attempts;
+- **elastic mode** (``M2KT_ELASTIC=1``): a ``slice_lost`` death does not
+  kill the pod — the supervisor re-plans for the survivors by shrinking
+  ``M2KT_NUM_SLICES`` in the child's env (the trainer's
+  ``resolve_mesh_plan`` reads it back and rebuilds the mesh with a
+  smaller ``dcn_dp``), rescales ``M2KT_BATCH_PER_DEVICE`` to preserve
+  the global batch when divisible (recording a degraded global batch
+  otherwise), and restarts; the child restores from the last checkpoint
+  into the smaller mesh. Elastic restarts don't burn the retry budget
+  (slice reclaim is capacity weather, not a code bug) — they are bounded
+  by ``M2KT_ELASTIC_MIN_SLICES`` (default 1) instead, below which the
+  loss is terminal and the JobSet-level failure policy takes over. The
+  pause before each elastic relaunch is charged to the goodput ledger's
+  ``replan`` category and every event is recorded in the exit file;
 - forwards SIGTERM to the child and stops retrying — a preempted pod is
   going away; the last-chance checkpoint already happened in the child;
 - merges the per-attempt goodput reports (``resilience.goodput``) into a
@@ -34,6 +47,8 @@ signal / pattern      class       rationale
 rc 0                  ok          trainer finished
 SIGTERM / rc 143      preempted   node reclaim; don't fight the eviction
 SIGKILL / rc 137      retryable   OOM-killer or host kill; warm restart
+rc 83 / "slice        slice_lost  a whole DCN slice reclaimed; elastic
+lost", "slice_loss"               mode re-plans on the survivors
 SyntaxError,          fatal       the image is broken; a retry loop
 ImportError,                      cannot fix code
 ModuleNotFoundError
@@ -62,13 +77,23 @@ import time
 from collections import deque
 
 from move2kube_tpu.resilience import goodput
+from move2kube_tpu.resilience.faults import SLICE_LOST_EXIT_CODE
 
 log = logging.getLogger("m2kt.supervisor")
 
 OK = "ok"
 PREEMPTED = "preempted"
+SLICE_LOST = "slice_lost"
 RETRYABLE = "retryable"
 FATAL = "fatal"
+
+# slice-loss signatures: the injected fault's stderr line and what a
+# surviving slice's processes print when the megascale DCN transport
+# loses its peers; checked before the generic fatal/retryable tables
+SLICE_LOST_PATTERNS = (
+    "FAULT: slice_loss", "slice lost", "SliceUnreachable",
+    "megascale slice unreachable",
+)
 
 # substring tables over the stderr tail; fatal checked first
 FATAL_PATTERNS = (
@@ -86,11 +111,17 @@ BACKOFF_CAP_S = 60.0
 
 
 def classify(returncode: int, stderr_tail: str = "") -> str:
-    """Map a child exit to ok / preempted / retryable / fatal."""
+    """Map a child exit to ok / preempted / slice_lost / retryable /
+    fatal."""
     if returncode == 0:
         return OK
     if returncode in (-signal.SIGTERM, 128 + signal.SIGTERM):
         return PREEMPTED
+    if returncode == SLICE_LOST_EXIT_CODE:
+        return SLICE_LOST
+    for pat in SLICE_LOST_PATTERNS:
+        if pat in stderr_tail:
+            return SLICE_LOST
     if returncode in (-signal.SIGKILL, 128 + signal.SIGKILL):
         return RETRYABLE
     for pat in FATAL_PATTERNS:
@@ -122,10 +153,21 @@ class Supervisor:
         self.max_retries = max(0, max_retries)
         self.backoff_s = max(0.0, backoff_s)
         self.exit_file = exit_file or exit_file_path()
+        self.elastic = os.environ.get("M2KT_ELASTIC", "0") == "1"
+        try:
+            self.min_slices = max(1, int(
+                os.environ.get("M2KT_ELASTIC_MIN_SLICES", "1") or 1))
+        except ValueError:
+            self.min_slices = 1
         self._child: subprocess.Popen | None = None
         self._got_sigterm = False
         self._attempts: list[dict] = []
         self._retry_sleep_total = 0.0
+        self._replan_sleep_total = 0.0
+        self._replan_events: list[dict] = []
+        # env deltas for the NEXT attempt (elastic re-plan shrinks the
+        # slice count here rather than mutating this process's environ)
+        self._env_overrides: dict[str, str] = {}
 
     # -- signal forwarding --------------------------------------------------
 
@@ -146,8 +188,11 @@ class Supervisor:
         log is intact AND the tail is available for classification."""
         tail: deque[str] = deque(maxlen=200)
         t0 = time.monotonic()
+        env = ({**os.environ, **self._env_overrides}
+               if self._env_overrides else None)
         self._child = subprocess.Popen(
-            self.cmd, stderr=subprocess.PIPE, text=True, errors="replace")
+            self.cmd, stderr=subprocess.PIPE, text=True, errors="replace",
+            env=env)
 
         def _tee(pipe):
             for line in pipe:
@@ -199,6 +244,25 @@ class Supervisor:
                 return self._finish(OK, 0)
             if clazz == PREEMPTED:
                 return self._finish(PREEMPTED, 128 + signal.SIGTERM)
+            if clazz == SLICE_LOST:
+                event = self._plan_elastic_restart(attempt) if self.elastic \
+                    else None
+                if event is None:
+                    # not elastic (or survivors below the floor): report
+                    # slice_lost so the JobSet failure policy — which
+                    # restarts the set without burning maxRestarts on
+                    # exit code 83 — makes the scale-level decision
+                    return self._finish(SLICE_LOST, SLICE_LOST_EXIT_CODE)
+                # small floor so the ledger's replan category is never
+                # silently zero even under a zeroed test backoff
+                delay = max(0.05, self.backoff_s)
+                print(f"[m2kt] supervisor: attempt {attempt} slice_lost; "
+                      f"elastic re-plan {event['from_slices']}->"
+                      f"{event['to_slices']} slices, restarting in "
+                      f"{delay:.1f}s", flush=True)
+                time.sleep(delay)
+                self._replan_sleep_total += delay
+                continue
             if clazz == FATAL:
                 return self._finish(FATAL, self._normalize_rc(rc))
             if attempt > self.max_retries:
@@ -216,10 +280,65 @@ class Supervisor:
     def _normalize_rc(rc: int) -> int:
         return 128 - rc if rc < 0 else (rc or 1)
 
+    # -- elastic re-plan ----------------------------------------------------
+
+    def _plan_elastic_restart(self, attempt: int) -> dict | None:
+        """Shrink the next attempt's world to the surviving slices.
+
+        Returns the recorded re-plan event, or None when the survivors
+        would fall below ``M2KT_ELASTIC_MIN_SLICES`` (terminal: hand the
+        decision back to the JobSet failure policy). The child re-plans
+        the mesh itself — ``resolve_mesh_plan`` reads the shrunken
+        ``M2KT_NUM_SLICES`` — and orbax restores the last checkpoint
+        into the smaller mesh's sharding.
+
+        Global batch: ``M2KT_BATCH_PER_DEVICE`` is scaled up by
+        old/new-slice ratio when that stays integral, so the optimizer
+        sees identical global batches across the loss; when indivisible
+        the per-device batch is kept and the event records the degraded
+        global batch instead of silently changing convergence math.
+        ``M2KT_FORCE_DEVICES`` (the CPU harness's forced-host device
+        count) shrinks proportionally so the drill models the lost
+        hardware, not just the lost label."""
+        env = {**os.environ, **self._env_overrides}
+        try:
+            num = max(1, int(env.get("M2KT_NUM_SLICES", "1") or 1))
+        except ValueError:
+            num = 1
+        survivors = num - 1
+        if survivors < self.min_slices:
+            log.warning(
+                "slice lost but %d survivor(s) under the elastic floor "
+                "(M2KT_ELASTIC_MIN_SLICES=%d); not re-planning",
+                survivors, self.min_slices)
+            return None
+        overrides = {"M2KT_NUM_SLICES": str(survivors)}
+        event: dict = {"attempt": attempt, "from_slices": num,
+                       "to_slices": survivors}
+        force = env.get("M2KT_FORCE_DEVICES", "")
+        if force.isdigit() and int(force) % num == 0:
+            overrides["M2KT_FORCE_DEVICES"] = str(
+                int(force) // num * survivors)
+        bpd = env.get("M2KT_BATCH_PER_DEVICE", "")
+        if bpd.isdigit() and (int(bpd) * num) % survivors == 0:
+            overrides["M2KT_BATCH_PER_DEVICE"] = str(
+                int(bpd) * num // survivors)
+            event["batch_per_device"] = int(overrides["M2KT_BATCH_PER_DEVICE"])
+            event["global_batch_preserved"] = True
+        else:
+            # indivisible (or per-device batch unknown to the pod env):
+            # keep the per-device batch, record the degradation
+            event["global_batch_preserved"] = False
+        self._env_overrides.update(overrides)
+        self._replan_events.append(event)
+        return event
+
     def _finish(self, exit_class: str, code: int) -> int:
         merged = goodput.merge_attempts(self._attempts)
         merged["seconds"]["retry"] = round(
             merged["seconds"].get("retry", 0.0) + self._retry_sleep_total, 3)
+        merged["seconds"]["replan"] = round(
+            merged["seconds"].get("replan", 0.0) + self._replan_sleep_total, 3)
         summary = {
             "exit_class": exit_class,
             "returncode": code,
@@ -228,6 +347,7 @@ class Supervisor:
                 {k: v for k, v in a.items() if k != "ok"}
                 for a in self._attempts
             ],
+            "replan_events": self._replan_events,
             "goodput": merged,
         }
         try:

@@ -1,0 +1,499 @@
+"""Elastic multislice training: slice-loss classification, the
+supervisor's re-plan-on-survivors restart, the checkpoint flush
+guarantee on the slice-death exit path, and the elastic emission surface
+(JobSet env + coordinator Service + exit-83 failure policy, raw YAML and
+Helm parameterization).
+
+The headline drill runs the real minitrain child on CPU: two forced-host
+slices, ``slice_loss`` injected at step 5, the supervisor shrinks the
+world to the survivor (rescaling the per-device batch to preserve the
+global batch) and the restarted attempt resumes from the last
+checkpoint — finishing with the SAME final loss a never-faulted
+single-slice control run produces, because minitrain's data stream is a
+function of (step, global batch) only, never of the mesh."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from move2kube_tpu.models import checkpoint as m2kt_ckpt
+from move2kube_tpu.qa import engine as qaengine
+from move2kube_tpu.resilience import supervisor
+from move2kube_tpu.resilience.faults import SLICE_LOST_EXIT_CODE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_supervised(workdir, extra: dict) -> subprocess.CompletedProcess:
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu", **extra)
+    # knobs from the outer test environment must not leak into the drill
+    for leak in ("M2KT_METRICS_DIR", "M2KT_FAULT_STEP", "M2KT_FAULT_KIND",
+                 "M2KT_FAULT_MARKER", "M2KT_ELASTIC", "M2KT_NUM_SLICES",
+                 "M2KT_FORCE_DEVICES", "M2KT_BATCH_PER_DEVICE"):
+        if leak not in extra:
+            env.pop(leak, None)
+    return subprocess.run(
+        [sys.executable, "-m", "move2kube_tpu.resilience.supervisor", "--",
+         sys.executable, "-m", "move2kube_tpu.resilience.minitrain"],
+        env=env, cwd=str(workdir), capture_output=True, text=True,
+        timeout=600)
+
+
+# -- the headline drill: lose one of two slices, finish on the survivor ------
+
+
+def test_elastic_drill_two_slices_lose_one(tmp_path):
+    """2 slices x 4 devices x batch 2 (global 16); slice_loss at step 5.
+    The supervisor re-plans to 1 slice x 4 devices x batch 4 (global 16
+    preserved), resumes from the step-4 checkpoint, and the final loss
+    is exactly the never-faulted single-slice control run's."""
+    common = dict(M2KT_STEPS="8", M2KT_CKPT_EVERY="2",
+                  M2KT_RETRY_BACKOFF_S="0.1")
+    res = _run_supervised(tmp_path, dict(
+        common,
+        M2KT_CKPT_DIR=str(tmp_path / "ckpt"),
+        M2KT_FORCE_DEVICES="8",
+        M2KT_NUM_SLICES="2",
+        M2KT_BATCH_PER_DEVICE="2",
+        M2KT_ELASTIC="1",
+        M2KT_FAULT_STEP="5",
+        M2KT_FAULT_KIND="slice_loss",
+        M2KT_FAULT_MARKER=str(tmp_path / "fault-fired"),
+        M2KT_EXIT_FILE=str(tmp_path / "exit.json"),
+        M2KT_GOODPUT_FILE=str(tmp_path / "goodput.json"),
+    ))
+    assert res.returncode == 0, res.stderr
+    # attempt 1: the full 2-slice world
+    assert "dcn_dp=2" in res.stdout
+    assert "devices=8 global_batch=16" in res.stdout
+    assert "FAULT: slice_loss" in res.stderr
+    assert "elastic re-plan 2->1" in res.stdout
+    # attempt 2: half the devices, same global batch, resumed not restarted
+    assert "devices=4 global_batch=16" in res.stdout
+    assert "resumed from step 4" in res.stdout
+    assert "done steps=8" in res.stdout
+
+    summary = json.loads((tmp_path / "exit.json").read_text())
+    assert summary["exit_class"] == "ok"
+    assert [a["class"] for a in summary["attempts"]] == ["slice_lost", "ok"]
+    assert summary["attempts"][0]["returncode"] == SLICE_LOST_EXIT_CODE
+    assert summary["attempts"][1]["report"]["resumed_from"] == 4
+    [event] = summary["replan_events"]
+    assert event == {"attempt": 1, "from_slices": 2, "to_slices": 1,
+                     "batch_per_device": 4, "global_batch_preserved": True}
+    merged = summary["goodput"]
+    assert merged["last_saved_step"] == 8
+    # the re-plan pause is its own ledger category, not a retry
+    assert merged["seconds"]["replan"] > 0
+    assert merged["seconds"]["retry"] == 0
+
+    # loss continuity: a never-faulted control run on the survivor world
+    control = tmp_path / "control"
+    control.mkdir()
+    res_c = _run_supervised(control, dict(
+        common,
+        M2KT_CKPT_DIR=str(control / "ckpt"),
+        M2KT_FORCE_DEVICES="4",
+        M2KT_BATCH_PER_DEVICE="4",
+        M2KT_EXIT_FILE=str(control / "exit.json"),
+        M2KT_GOODPUT_FILE=str(control / "goodput.json"),
+    ))
+    assert res_c.returncode == 0, res_c.stderr
+
+    def final_loss(out: str) -> float:
+        return float(re.findall(r"loss=([0-9.]+)", out)[-1])
+
+    assert final_loss(res.stdout) == pytest.approx(
+        final_loss(res_c.stdout), abs=1e-5)
+
+
+def test_slice_loss_without_elastic_is_terminal(tmp_path):
+    """Elastic off: the supervisor surfaces exit code 83 / class
+    slice_lost without retrying, handing the decision to the JobSet
+    failure policy (whose exit-83 rule restarts the set for free)."""
+    res = _run_supervised(tmp_path, dict(
+        M2KT_STEPS="4",
+        M2KT_FORCE_DEVICES="2",
+        M2KT_NUM_SLICES="2",
+        M2KT_BATCH_PER_DEVICE="2",
+        M2KT_FAULT_STEP="2",
+        M2KT_FAULT_KIND="slice_loss",
+        M2KT_RETRY_BACKOFF_S="0.05",
+        M2KT_EXIT_FILE=str(tmp_path / "exit.json"),
+        M2KT_GOODPUT_FILE=str(tmp_path / "goodput.json"),
+    ))
+    assert res.returncode == SLICE_LOST_EXIT_CODE
+    assert "FAULT: slice_loss" in res.stderr
+    summary = json.loads((tmp_path / "exit.json").read_text())
+    assert summary["exit_class"] == "slice_lost"
+    assert len(summary["attempts"]) == 1
+    assert summary["replan_events"] == []
+
+
+# -- classification ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("rc,tail", [
+    (SLICE_LOST_EXIT_CODE, ""),
+    (1, "[m2kt] FAULT: slice_loss: slice 1 reclaimed at step 5"),
+    (1, "megascale slice unreachable"),
+    # the pattern outranks the generic SIGKILL -> retryable rule: a slice
+    # loss kills its processes too, and slice_lost is the better answer
+    (-signal.SIGKILL, "slice lost"),
+])
+def test_slice_loss_classification(rc, tail):
+    assert supervisor.classify(rc, tail) == supervisor.SLICE_LOST
+
+
+# -- re-plan unit semantics --------------------------------------------------
+
+
+def test_plan_elastic_restart_rescales_batch_and_devices(monkeypatch):
+    monkeypatch.setenv("M2KT_ELASTIC", "1")
+    monkeypatch.delenv("M2KT_ELASTIC_MIN_SLICES", raising=False)
+    monkeypatch.setenv("M2KT_NUM_SLICES", "2")
+    monkeypatch.setenv("M2KT_BATCH_PER_DEVICE", "2")
+    monkeypatch.setenv("M2KT_FORCE_DEVICES", "8")
+    sup = supervisor.Supervisor(["true"], max_retries=0, backoff_s=0.0)
+    event = sup._plan_elastic_restart(1)
+    assert event == {"attempt": 1, "from_slices": 2, "to_slices": 1,
+                     "batch_per_device": 4, "global_batch_preserved": True}
+    assert sup._env_overrides == {"M2KT_NUM_SLICES": "1",
+                                  "M2KT_FORCE_DEVICES": "4",
+                                  "M2KT_BATCH_PER_DEVICE": "4"}
+    # a second loss reads the overridden world: 1 survivor - 1 < floor
+    assert sup._plan_elastic_restart(2) is None
+    assert len(sup._replan_events) == 1
+
+
+def test_plan_elastic_restart_indivisible_batch_degrades(monkeypatch):
+    """3 -> 2 slices with batch-per-device 3: 9 is not divisible by 2, so
+    the per-device batch is kept and the event records the degradation
+    instead of silently changing the convergence math."""
+    monkeypatch.setenv("M2KT_ELASTIC", "1")
+    monkeypatch.delenv("M2KT_ELASTIC_MIN_SLICES", raising=False)
+    monkeypatch.setenv("M2KT_NUM_SLICES", "3")
+    monkeypatch.setenv("M2KT_BATCH_PER_DEVICE", "3")
+    monkeypatch.delenv("M2KT_FORCE_DEVICES", raising=False)
+    sup = supervisor.Supervisor(["true"], max_retries=0, backoff_s=0.0)
+    event = sup._plan_elastic_restart(1)
+    assert event["from_slices"] == 3 and event["to_slices"] == 2
+    assert event["global_batch_preserved"] is False
+    assert "batch_per_device" not in event
+    assert sup._env_overrides == {"M2KT_NUM_SLICES": "2"}
+
+
+def test_plan_elastic_restart_honors_min_slices_floor(monkeypatch):
+    monkeypatch.setenv("M2KT_ELASTIC", "1")
+    monkeypatch.setenv("M2KT_ELASTIC_MIN_SLICES", "2")
+    monkeypatch.setenv("M2KT_NUM_SLICES", "2")
+    sup = supervisor.Supervisor(["true"], max_retries=0, backoff_s=0.0)
+    assert sup.min_slices == 2
+    assert sup._plan_elastic_restart(1) is None  # 1 survivor < floor
+    assert sup._replan_events == []
+    assert sup._env_overrides == {}
+
+
+# -- checkpoint flush on the death path --------------------------------------
+
+
+def test_install_exit_flush_lands_async_save(tmp_path):
+    """An async save started just before a slice-loss ``sys.exit(83)``
+    must be durable when the process dies: without the atexit flush the
+    restarted attempt resumes one cadence early."""
+    script = (
+        "import sys\n"
+        "import jax.numpy as jnp\n"
+        "from move2kube_tpu.models.checkpoint import CheckpointManager\n"
+        "m = CheckpointManager(sys.argv[1], every=2)\n"
+        "m.install_exit_flush()\n"
+        "assert m.maybe_save(2, {'w': jnp.arange(4.0)})\n"
+        "sys.exit(83)\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("M2KT_CKPT_SYNC", None)  # the async path is what's under test
+    res = subprocess.run(
+        [sys.executable, "-c", script, str(tmp_path / "ckpt")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 83, res.stderr
+    mngr = m2kt_ckpt.CheckpointManager(str(tmp_path / "ckpt"), every=2)
+    assert mngr.latest_step() == 2
+    mngr.close()
+
+
+# -- emission: raw YAML ------------------------------------------------------
+
+
+class _AnswerEngine(qaengine.Engine):
+    """Resolve specific QA ids with canned answers; everything else falls
+    through to the default engine installed after it."""
+
+    def __init__(self, answers: dict):
+        self.answers = answers
+
+    def fetch_answer(self, problem):
+        if problem.id in self.answers:
+            problem.set_answer(self.answers[problem.id])
+        return problem
+
+
+def _qa(answers: dict | None = None):
+    qaengine.reset_engines()
+    if answers:
+        qaengine.add_engine(_AnswerEngine(answers))
+    qaengine.start_engine(qa_skip=True)
+
+
+def _slice_service(name="trainer", num_slices=2):
+    from move2kube_tpu.types.ir import Service
+    from move2kube_tpu.types.plan import AcceleratorInfo
+
+    svc = Service(name=name)
+    svc.containers = [{"name": "t", "image": "x"}]
+    svc.accelerator = AcceleratorInfo(
+        gpu_count=8 * num_slices, tpu_accelerator="tpu-v5-lite-podslice",
+        tpu_topology="2x4", num_hosts=2, num_slices=num_slices)
+    svc.job = True
+    return svc
+
+
+@pytest.fixture
+def _clean_env(monkeypatch):
+    for var in ("M2KT_ELASTIC", "M2KT_ELASTIC_MIN_SLICES",
+                "M2KT_MAX_RESTARTS", "M2KT_BACKOFF_LIMIT"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_multislice_jobset_carries_elastic_env_and_exit83_rule(_clean_env):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    _qa()
+    try:
+        obj = DeploymentAPIResource()._create_workload(
+            _slice_service(), {"JobSet"})
+    finally:
+        qaengine.reset_engines()
+    assert obj["spec"]["replicatedJobs"][0]["replicas"] == 2
+    job_spec = obj["spec"]["replicatedJobs"][0]["template"]["spec"]
+    pod = job_spec["template"]["spec"]
+    env = {e["name"]: e for e in pod["containers"][0]["env"]}
+    assert env["M2KT_ELASTIC"]["value"] == "1"  # QA default: elastic on
+    assert env["M2KT_ELASTIC_MIN_SLICES"]["value"] == "1"
+    # coordinator resolves through the dedicated headless Service
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"]["value"] == \
+        "trainer-coord:8080"
+    assert "fieldRef" in env["M2KT_SLICE_ID"]["valueFrom"]
+
+    rules = job_spec["podFailurePolicy"]["rules"]
+    assert len(rules) == 2  # disruption rule + terminal slice loss
+    [exit_rule] = [r for r in rules if "onExitCodes" in r]
+    assert exit_rule["action"] == "FailJob"
+    assert exit_rule["onExitCodes"] == {
+        "operator": "In", "values": [SLICE_LOST_EXIT_CODE]}
+
+
+def test_elastic_knob_off_drops_env_keeps_exit_rule(_clean_env):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    _qa({"m2kt.services.trainer.elastic": False})
+    try:
+        obj = DeploymentAPIResource()._create_workload(
+            _slice_service(), {"JobSet"})
+    finally:
+        qaengine.reset_engines()
+    job_spec = obj["spec"]["replicatedJobs"][0]["template"]["spec"]
+    names = {e["name"]
+             for e in job_spec["template"]["spec"]["containers"][0]["env"]}
+    assert "M2KT_ELASTIC" not in names
+    assert "M2KT_ELASTIC_MIN_SLICES" not in names
+    # the exit-83 rule stays: a non-elastic slice loss still wants the
+    # free JobSet-level restart lane
+    assert any("onExitCodes" in r
+               for r in job_spec["podFailurePolicy"]["rules"])
+
+
+def test_coordinator_headless_service_emitted_for_multislice(_clean_env):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+    from move2kube_tpu.types.ir import IR
+
+    ir = IR(name="p")
+    ir.add_service(_slice_service())
+    ir.add_service(_slice_service(name="single", num_slices=1))
+    _qa()
+    try:
+        objs = DeploymentAPIResource().create_new_resources(ir, {"JobSet"})
+    finally:
+        qaengine.reset_engines()
+    coords = [o for o in objs if o.get("kind") == "Service"
+              and o["metadata"]["name"].endswith("-coord")]
+    [coord] = coords  # the single-slice service gets none
+    assert coord["metadata"]["name"] == "trainer-coord"
+    spec = coord["spec"]
+    assert spec["clusterIP"] == "None"
+    assert spec["publishNotReadyAddresses"] is True
+    # pins slice 0's pod 0 via the JobSet controller's pod labels
+    assert spec["selector"] == {
+        "jobset.sigs.k8s.io/jobset-name": "trainer",
+        "jobset.sigs.k8s.io/job-index": "0",
+        "batch.kubernetes.io/job-completion-index": "0",
+    }
+    assert {p["port"] for p in spec["ports"]} == {8080, 8476}
+
+
+def test_single_slice_jobset_has_no_elastic_surface(_clean_env):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    _qa()
+    try:
+        obj = DeploymentAPIResource()._create_workload(
+            _slice_service(num_slices=1), {"JobSet"})
+    finally:
+        qaengine.reset_engines()
+    job_spec = obj["spec"]["replicatedJobs"][0]["template"]["spec"]
+    names = {e["name"]
+             for e in job_spec["template"]["spec"]["containers"][0]["env"]}
+    assert "M2KT_ELASTIC" not in names
+    # single-slice keeps the original single-rule failure policy
+    [rule] = job_spec["podFailurePolicy"]["rules"]
+    assert "onExitCodes" not in rule
+
+
+# -- emission: optimizer pass + Helm parameterization ------------------------
+
+
+def test_elastic_optimizer_injects_env_for_multislice_jobs(_clean_env):
+    from move2kube_tpu.passes.optimize import tpu_elastic_optimizer
+    from move2kube_tpu.types.ir import IR
+
+    ir = IR(name="p")
+    multi = _slice_service()
+    single = _slice_service(name="single", num_slices=1)
+    serving = _slice_service(name="decode")
+    serving.accelerator.serving = True
+    serving.job = False
+    for svc in (multi, single, serving):
+        ir.add_service(svc)
+    _qa()
+    try:
+        ir = tpu_elastic_optimizer(ir)
+        ir = tpu_elastic_optimizer(ir)  # idempotent
+    finally:
+        qaengine.reset_engines()
+    env = {e["name"]: e["value"] for e in multi.containers[0]["env"]}
+    assert env == {"M2KT_ELASTIC": "1", "M2KT_ELASTIC_MIN_SLICES": "1"}
+    assert len(multi.containers[0]["env"]) == 2
+    assert "env" not in single.containers[0]
+    assert "env" not in serving.containers[0]
+
+
+def test_elastic_parameterizer_lifts_knobs_and_preserves_fieldref():
+    """Helm output: the elastic knobs become ``{{ .Values.tpuelastic }}``
+    refs seeded into values, while the multislice fieldRef entries
+    (M2KT_SLICE_ID reads the JobSet job-index annotation) must survive
+    parameterization byte-identical — a templated fieldRef would break
+    every slice's identity."""
+    from move2kube_tpu.passes.parameterize import tpu_elastic_parameterizer
+    from move2kube_tpu.types.ir import IR
+
+    ir = IR(name="p")
+    svc = _slice_service()
+    slice_ref = {"fieldRef": {"fieldPath":
+        "metadata.annotations['jobset.sigs.k8s.io/job-index']"}}
+    svc.containers[0]["env"] = [
+        {"name": "M2KT_ELASTIC", "value": "1"},
+        {"name": "M2KT_ELASTIC_MIN_SLICES", "value": "1"},
+        {"name": "M2KT_SLICE_ID", "valueFrom": dict(slice_ref)},
+        {"name": "MEGASCALE_SLICE_ID", "valueFrom": dict(slice_ref)},
+    ]
+    ir.add_service(svc)
+    ir = tpu_elastic_parameterizer(ir)
+    env = {e["name"]: e for e in svc.containers[0]["env"]}
+    assert env["M2KT_ELASTIC"]["value"] == "{{ .Values.tpuelastic }}"
+    assert env["M2KT_ELASTIC_MIN_SLICES"]["value"] == \
+        "{{ .Values.tpuelasticminslices }}"
+    assert ir.values.global_variables["tpuelastic"] == "1"
+    assert ir.values.global_variables["tpuelasticminslices"] == "1"
+    for name in ("M2KT_SLICE_ID", "MEGASCALE_SLICE_ID"):
+        assert env[name]["valueFrom"] == slice_ref
+        assert "value" not in env[name]
+    # idempotent: already-templated values are not double-lifted
+    ir = tpu_elastic_parameterizer(ir)
+    assert env["M2KT_ELASTIC"]["value"] == "{{ .Values.tpuelastic }}"
+
+
+def test_helm_chain_workload_to_parameterized_yaml(_clean_env):
+    """Full Helm-side chain over the real workload emission: optimizer ->
+    parameterizer -> convert_objects. The JobSet env carries the values
+    refs AND the untouched fieldRef entries."""
+    from move2kube_tpu.apiresource.base import convert_objects
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+    from move2kube_tpu.passes.optimize import tpu_elastic_optimizer
+    from move2kube_tpu.passes.parameterize import tpu_elastic_parameterizer
+    from move2kube_tpu.types.ir import IR
+
+    ir = IR(name="p")
+    svc = _slice_service()
+    ir.add_service(svc)
+    _qa()
+    try:
+        ir = tpu_elastic_optimizer(ir)
+        ir = tpu_elastic_parameterizer(ir)
+        objs = convert_objects(ir, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+    [jobset] = [o for o in objs if o.get("kind") == "JobSet"]
+    pod = (jobset["spec"]["replicatedJobs"][0]["template"]["spec"]
+           ["template"]["spec"])
+    env = {e["name"]: e for e in pod["containers"][0]["env"]}
+    assert env["M2KT_ELASTIC"]["value"] == "{{ .Values.tpuelastic }}"
+    assert "fieldRef" in env["M2KT_SLICE_ID"]["valueFrom"]
+    assert ir.values.global_variables["tpuelastic"] == "1"
+    [coord] = [o for o in objs if o.get("kind") == "Service"
+               and o["metadata"]["name"] == "trainer-coord"]
+    assert coord["spec"]["clusterIP"] == "None"
+
+
+# -- kube2kube round trip ----------------------------------------------------
+
+
+def test_kube2kube_reingests_num_slices(_clean_env):
+    """A re-ingested GPU workload big enough to span slices must read the
+    slice fan-out back: 512 GPUs -> 2 v5p-256 slices, and re-emission
+    carries the multislice JobSet surface."""
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+    from move2kube_tpu.source.kube2kube import tpu_service_from_gpu_workload
+
+    job = {
+        "apiVersion": "batch/v1", "kind": "Job",
+        "metadata": {"name": "big-train"},
+        "spec": {
+            "parallelism": 64,
+            "template": {"spec": {"containers": [{
+                "name": "t", "image": "x",
+                "resources": {"limits": {"nvidia.com/gpu": 8}},
+            }]}},
+        },
+    }
+    svc = tpu_service_from_gpu_workload(job)
+    assert svc is not None
+    assert svc.accelerator.num_slices == 2
+    assert svc.accelerator.gpu_count == 512
+
+    _qa()
+    try:
+        obj = DeploymentAPIResource()._create_workload(svc, {"JobSet"})
+    finally:
+        qaengine.reset_engines()
+    assert obj["spec"]["replicatedJobs"][0]["replicas"] == 2
+    pod = (obj["spec"]["replicatedJobs"][0]["template"]["spec"]
+           ["template"]["spec"])
+    env = {e["name"]: e.get("value") for e in pod["containers"][0]["env"]}
+    assert env["M2KT_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "big-train-coord:8080"

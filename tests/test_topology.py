@@ -150,6 +150,66 @@ def test_device_order_identity_on_length_mismatch():
     assert plan.device_order(devs) == devs
 
 
+# ------------------------------------------------- multislice (DCN) planning
+
+def test_plan_multislice_dcn_dp_outer_data_axis():
+    """2 slices of 2x4: the data extent doubles (dcn_dp=2 outer factor),
+    while topology / layout / per-slice permutation are EXACTLY the
+    single-slice plan — only DP rides DCN, everything else stays ICI."""
+    single = plan_parallelism(8, topology="2x4")
+    multi = plan_parallelism(16, topology="2x4", num_slices=2)
+    assert multi.config.dims() == (16, 1, 1, 1, 1, 1)
+    assert multi.dcn_dp == 2
+    assert multi.topology.dims == single.topology.dims == (2, 4)
+    assert multi.layout == single.layout
+    assert multi.ici_cost == single.ici_cost  # per-slice semantics
+    # slice-major blocks: slice s's devices stay contiguous, each block
+    # internally ordered by the single-slice permutation
+    assert multi.perm[:8] == single.perm
+    assert multi.perm[8:] == tuple(8 + p for p in single.perm)
+    assert " dcn_dp=2 " in multi.describe()
+    assert " dcn_dp=" not in single.describe()
+
+
+def test_plan_multislice_model_axes_stay_per_slice():
+    single = plan_parallelism(8, topology="2x4", tensor_parallel=2)
+    multi = plan_parallelism(16, topology="2x4", tensor_parallel=2,
+                             num_slices=2)
+    assert multi.config.dims() == (8, 1, 1, 2, 1, 1)  # data x2, tensor same
+    assert multi.layout["tensor"] == single.layout["tensor"] == (1,)
+    assert multi.ici_cost == single.ici_cost
+
+
+def test_plan_multislice_memory_resplit_is_per_slice():
+    """The 30 GB fp32-state model that forces fsdp=8 on one v5e 2x4 slice
+    must re-split each slice the same way: DCN neighbours can't shard
+    params, so fsdp stays per-slice and only data multiplies."""
+    multi = plan_parallelism(16, topology="2x4",
+                             slice_type="tpu-v5-lite-podslice",
+                             param_bytes=int(30e9), num_slices=2)
+    assert multi.config.fsdp == 8
+    assert multi.config.data == 2  # dcn_dp x per-slice data (1)
+    assert multi.dcn_dp == 2
+
+
+def test_plan_indivisible_slices_falls_back_to_single():
+    plan = plan_parallelism(8, topology="2x4", num_slices=3)
+    assert plan.dcn_dp == 1
+    assert plan.config.dims() == (8, 1, 1, 1, 1, 1)
+    assert plan.source == "planner"
+
+
+def test_resolve_num_slices_from_env():
+    plan = resolve_mesh_plan(
+        16, env={"M2KT_TPU_TOPOLOGY": "2x4", "M2KT_NUM_SLICES": "2"})
+    assert plan.dcn_dp == 2
+    assert plan.config.data == 16
+    # malformed env value must not kill a real run
+    plan = resolve_mesh_plan(
+        8, env={"M2KT_TPU_TOPOLOGY": "2x4", "M2KT_NUM_SLICES": "banana"})
+    assert plan.dcn_dp == 1
+
+
 # ------------------------------------------------------ mesh construction
 
 @needs_8
@@ -418,5 +478,23 @@ def test_topology_fingerprint_distinguishes_mesh_shapes(tmp_path,
     path = setup_compilation_cache(mesh=m_dp)
     assert path == str(tmp_path / fp_dp)
     # restore the default dir so later tests don't write under tmp_path
+    monkeypatch.delenv("M2KT_COMPILE_CACHE_DIR")
+    setup_compilation_cache()
+
+
+@needs_8
+def test_topology_fingerprint_slice_tag(tmp_path, monkeypatch):
+    """The same logical mesh compiles different DCN collectives per slice
+    count, so an elastic shrink (2 slices -> 1) must land in a different
+    cache partition instead of replaying stale 2-slice executables."""
+    mesh = make_mesh(MeshConfig(data=8))
+    fp1 = topology_fingerprint(mesh)
+    fp2 = topology_fingerprint(mesh, num_slices=2)
+    assert fp2 == fp1 + "-s2"
+
+    monkeypatch.setenv("M2KT_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("M2KT_COMPILE_CACHE", raising=False)
+    path = setup_compilation_cache(mesh=mesh, num_slices=2)
+    assert path == str(tmp_path / fp2)
     monkeypatch.delenv("M2KT_COMPILE_CACHE_DIR")
     setup_compilation_cache()
