@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -103,6 +103,14 @@ fault-smoke:
 # against a never-faulted run; plus the elastic JobSet/Helm emission
 elastic-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_elastic.py -q
+
+# runtime tracing in isolation (all CPU-mode): span-ring semantics,
+# Chrome/OTLP export well-formedness, per-request TTFT decomposition,
+# straggler scoring, and the forced-host slice-loss minitrain drill
+# asserting the crash flight recorder (m2kt-flight.json with the final
+# step's spans + the slice-lost classification)
+trace-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_tracing.py -q
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

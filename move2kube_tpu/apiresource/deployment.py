@@ -17,7 +17,15 @@ from __future__ import annotations
 
 import os
 
+from move2kube_tpu.apiresource import obs_wiring
 from move2kube_tpu.apiresource.base import APIResource, make_obj, obj_kind
+# re-exported from obs_wiring (the shared JobSet/Deployment/Knative
+# helper home); kept importable from here for callers predating the hoist
+from move2kube_tpu.apiresource.obs_wiring import (  # noqa: F401
+    METRICS_PATH,
+    metrics_port_value,
+    scrape_annotations,
+)
 from move2kube_tpu.resilience import preemption
 from move2kube_tpu.resilience.faults import SLICE_LOST_EXIT_CODE
 from move2kube_tpu.types.ir import IR, Service
@@ -35,42 +43,23 @@ JOB_SET = "JobSet"
 
 SELECTOR_LABEL = "move2kube-tpu.io/service"
 
-METRICS_PATH = "/metrics"
-
-
-def metrics_port_value(svc: Service) -> str | None:
-    """The telemetry port the observability optimizer baked into the pod
-    env (``M2KT_METRICS_PORT``), as a string — in Helm output this is the
-    ``{{ .Values.tpumetricsport }}`` ref, which is exactly what the
-    scrape annotation should carry so chart overrides retune both
-    together. None / "0" means telemetry is off."""
-    for c in svc.containers:
-        for e in c.get("env", []) or []:
-            if e.get("name") == "M2KT_METRICS_PORT":
-                v = str(e.get("value", "")).strip()
-                return v if v and v != "0" else None
-    return None
-
-
-def scrape_annotations(svc: Service) -> dict:
-    """prometheus.io/* pod annotations for a telemetry-enabled service
-    (empty when the obs optimizer left the service uninstrumented)."""
-    port = metrics_port_value(svc)
-    if not port:
-        return {}
-    return {
-        "prometheus.io/scrape": "true",
-        "prometheus.io/port": port,
-        "prometheus.io/path": METRICS_PATH,
-    }
-
 
 def pod_template(svc: Service, labels: dict) -> dict:
     meta: dict = {"labels": dict(labels)}
     scrape = scrape_annotations(svc)
     if scrape:
         meta["annotations"] = scrape
-    return {"metadata": meta, "spec": svc.pod_spec()}
+    spec = svc.pod_spec()
+    probe = obs_wiring.readiness_probe(svc)
+    if probe:
+        # serving pods gate traffic on /readyz (obs/server.py): the probe
+        # goes on the container carrying the telemetry port
+        for c in spec.get("containers", []) or []:
+            env_names = {e.get("name") for e in c.get("env", []) or []}
+            if "M2KT_METRICS_PORT" in env_names:
+                c.setdefault("readinessProbe", probe)
+                break
+    return {"metadata": meta, "spec": spec}
 
 
 def _tpu_resources(svc: Service, workload_kind: str = JOB_SET) -> None:
@@ -282,6 +271,8 @@ class DeploymentAPIResource(APIResource):
             pm = self._maybe_podmonitor(svc, ir)
             if pm:
                 objs.append(pm)
+            objs.extend(
+                obs_wiring.maybe_rules_objects(svc, ir, SELECTOR_LABEL))
             if JOB_SET in supported_kinds:
                 coord = self._coordinator_service(svc)
                 if coord:

@@ -149,14 +149,26 @@ class KnativeServiceAPIResource(APIResource):
             # Prometheus scrapes the pod IP directly, so the telemetry
             # port needs no Knative routing (queue-proxy only fronts the
             # serving port)
-            from move2kube_tpu.apiresource.deployment import (
-                scrape_annotations)
+            from move2kube_tpu.apiresource import obs_wiring
 
-            tmpl_annotations.update(scrape_annotations(svc))
+            tmpl_annotations.update(obs_wiring.scrape_annotations(svc))
+            if obs_wiring.readiness_probe(svc) is not None:
+                # knative probes may only target the traffic port, not the
+                # telemetry port where /readyz lives — the serve template's
+                # own /healthz 503s until the engine is warm, which is the
+                # same gate the Deployment path reads from /readyz
+                for c in pod_spec.get("containers", []) or []:
+                    c.setdefault("readinessProbe",
+                                 {"httpGet": {"path": "/healthz"}})
+                    break
             if tmpl_annotations:
                 template["metadata"] = {"annotations": tmpl_annotations}
             obj["spec"] = {"template": template}
             objs.append(obj)
+            # alert rules + dashboard ride along with the knative Service
+            # too (same QA knob); revision pod labels carry "app", so the
+            # PromQL selector keys off that instead of the JobSet label
+            objs.extend(obs_wiring.maybe_rules_objects(svc, ir, "app"))
         return objs
 
     def _supported_on(self, cluster) -> set[str]:

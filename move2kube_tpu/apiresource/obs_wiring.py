@@ -1,0 +1,125 @@
+"""Shared observability wiring for emitted workloads.
+
+One helper set used by ALL workload emitters — JobSet/Deployment
+(``apiresource/deployment.py``) and Knative (``apiresource/knative.py``)
+— so the scrape annotations, readiness probes, and alert-rule/dashboard
+objects a pod carries cannot drift between target kinds (the
+scrape-annotation logic used to live in deployment.py with knative
+importing it sideways; first concrete step toward the unified pass
+pipeline in ROADMAP item 5).
+"""
+
+from __future__ import annotations
+
+from move2kube_tpu.types.ir import IR, Service
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("apiresource.obswiring")
+
+METRICS_PATH = "/metrics"
+READYZ_PATH = "/readyz"
+
+
+def metrics_port_value(svc: Service) -> str | None:
+    """The telemetry port the observability optimizer baked into the pod
+    env (``M2KT_METRICS_PORT``), as a string — in Helm output this is the
+    ``{{ .Values.tpumetricsport }}`` ref, which is exactly what the
+    scrape annotation should carry so chart overrides retune both
+    together. None / "0" means telemetry is off."""
+    for c in svc.containers:
+        for e in c.get("env", []) or []:
+            if e.get("name") == "M2KT_METRICS_PORT":
+                v = str(e.get("value", "")).strip()
+                return v if v and v != "0" else None
+    return None
+
+
+def scrape_annotations(svc: Service) -> dict:
+    """prometheus.io/* pod annotations for a telemetry-enabled service
+    (empty when the obs optimizer left the service uninstrumented)."""
+    port = metrics_port_value(svc)
+    if not port:
+        return {}
+    return {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": port,
+        "prometheus.io/path": METRICS_PATH,
+    }
+
+
+def readiness_probe(svc: Service) -> dict | None:
+    """readinessProbe for an emitted *serving* pod: ``/readyz`` on the
+    telemetry port, which reports the engine's starting/serving/draining
+    state (503 until warm — obs/server.py) so a pod compiling its decode
+    executables takes no traffic. None for training services (a JobSet
+    worker has no traffic to gate) and when telemetry is off (the
+    template's own port would 503 forever on a trainer)."""
+    acc = svc.accelerator
+    if acc is None or not getattr(acc, "serving", False):
+        return None
+    port = metrics_port_value(svc)
+    if not port:
+        return None
+    try:
+        port_val: int | str = int(port)
+    except ValueError:
+        port_val = port  # Helm ref: stays a template string in chart mode
+    return {
+        "httpGet": {"path": READYZ_PATH, "port": port_val},
+        "initialDelaySeconds": 5,
+        "periodSeconds": 10,
+        "failureThreshold": 6,
+    }
+
+
+def rules_enabled(svc_name: str) -> bool:
+    """The ``m2kt.services.<name>.obs.rules`` QA knob — asked with the
+    same id by the workload emitters (to decide whether to attach the
+    objects) and the Helm parameterizer (to decide whether to seed the
+    threshold chart values), so one cached answer keeps both agreed."""
+    from move2kube_tpu import qa
+    from move2kube_tpu.utils import common
+
+    name = common.make_dns_label(svc_name)
+    return qa.fetch_bool(
+        f"m2kt.services.{name}.obs.rules",
+        f"Emit PrometheusRule alerts + a Grafana dashboard for [{name}]?",
+        ["Goodput floor, step-time p95 regression, restart storm and "
+         "serving queue-depth alerts, plus a dashboard ConfigMap for "
+         "the Grafana sidecar; needs the prometheus-operator stack"],
+        False)
+
+
+def maybe_rules_objects(svc: Service, ir: IR,
+                        selector_label: str) -> list[dict]:
+    """PrometheusRule + Grafana dashboard ConfigMap next to the
+    workload, behind the ``m2kt.services.<name>.obs.rules`` QA knob
+    (default off — they are useful only on clusters running the
+    prometheus-operator/Grafana stack). Same emit-anyway-with-a-warning
+    contract as the PodMonitor knob when the cluster does not advertise
+    the monitoring.coreos.com CRDs."""
+    if svc.accelerator is None or not metrics_port_value(svc):
+        return []
+    from move2kube_tpu.obs import rules
+
+    if not rules_enabled(svc.name):
+        return []
+    cluster = ir.target_cluster_spec
+    if cluster.api_kind_version_map and not cluster.supports_kind(
+            "PrometheusRule"):
+        log.warning(
+            "%s: PrometheusRule requested but the target cluster does not "
+            "advertise monitoring.coreos.com; emitting anyway "
+            "(honored once the CRDs are installed)", svc.name)
+    # Helm output: the rules parameterizer already seeded the threshold
+    # chart values, so the exprs carry {{ .Values.<key> }} refs instead of
+    # the literals — values.yaml holds the defaults
+    thresholds = None
+    if all(k in ir.values.global_variables for k in rules.THRESHOLDS):
+        thresholds = {k: f"{{{{ .Values.{k} }}}}" for k in rules.THRESHOLDS}
+    serving = bool(getattr(svc.accelerator, "serving", False))
+    return [
+        rules.prometheus_rule(svc.name, selector_label, serving=serving,
+                              thresholds=thresholds),
+        rules.dashboard_configmap(svc.name, selector_label, serving=serving),
+    ]

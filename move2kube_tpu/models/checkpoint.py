@@ -26,6 +26,20 @@ import jax
 log = logging.getLogger("m2kt.checkpoint")
 
 
+def _maybe_span(name: str, attrs: dict | None = None):
+    """Span into the runtime trace ring when tracing is on; a no-op
+    context otherwise. The async-save submit/sync/wait phases are
+    exactly what the crash flight recorder needs to show whether a
+    death raced an in-flight checkpoint commit."""
+    from move2kube_tpu.obs import tracing
+
+    if tracing.enabled():
+        return tracing.get().span(name, attrs)
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
 def _manager(ckpt_dir: str, max_to_keep: int = 3):
     import orbax.checkpoint as ocp
 
@@ -140,16 +154,19 @@ class CheckpointManager:
             return False
         import orbax.checkpoint as ocp
 
-        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        with _maybe_span("ckpt.save_submit", {"step": step}):
+            self._mngr.save(step, args=ocp.args.StandardSave(state))
         if os.environ.get("M2KT_CKPT_SYNC", "0") == "1":
-            self._mngr.wait_until_finished()
+            with _maybe_span("ckpt.save_sync", {"step": step}):
+                self._mngr.wait_until_finished()
         return True
 
     def wait(self) -> None:
         """Block until in-flight async saves commit. The last-chance
         preemption path and the fault-injection tests need the step
         durably on disk before the process may die."""
-        self._mngr.wait_until_finished()
+        with _maybe_span("ckpt.wait"):
+            self._mngr.wait_until_finished()
 
     def install_exit_flush(self) -> None:
         """Guarantee in-flight async saves land on EVERY interpreter

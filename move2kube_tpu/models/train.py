@@ -542,10 +542,19 @@ class StepTelemetry:
     step time."""
 
     def __init__(self, registry=None, items_per_step: int = 0,
-                 unit: str = "tokens", mem_every: int = 10):
+                 unit: str = "tokens", mem_every: int = 10, tracer=None):
+        from move2kube_tpu.obs import tracing
         from move2kube_tpu.obs.metrics import default_registry
         reg = registry if registry is not None else default_registry()
         self.registry = reg
+        # per-step spans into the runtime trace ring (obs/tracing.py):
+        # record() with the step's own clock readings, so tracing adds no
+        # timing calls to the loop and the same <=3% overhead budget holds.
+        # None -> the process tracer when M2KT_TRACE is on; False -> off
+        # (the bench probe times the telemetry-only variant this way)
+        if tracer is None:
+            tracer = tracing.get() if tracing.enabled() else None
+        self.tracer = tracer or None
         self.items_per_step = items_per_step
         self.mem_every = max(1, mem_every)
         # step times: sub-ms (tiny CPU models) up to tens of seconds
@@ -577,9 +586,22 @@ class StepTelemetry:
     def record_compile(self, seconds: float) -> None:
         self._compiles.inc()
         self._compile_seconds.inc(max(0.0, seconds))
+        if self.tracer is not None:
+            now = time.perf_counter()
+            self.tracer.record("train.compile", now - max(0.0, seconds), now)
 
     def record_step(self, step: int, seconds: float, loss=None,
                     state=None, items: int | None = None) -> None:
+        if self.tracer is not None:
+            now = time.perf_counter()
+            attrs = {"step": step}
+            if loss is not None:
+                try:
+                    attrs["loss"] = float(loss)
+                except (TypeError, ValueError):
+                    pass
+            self.tracer.record("train.step", now - max(0.0, seconds), now,
+                               attrs=attrs)
         self._step_hist.observe(seconds)
         self._steps.inc()
         self._step_gauge.set(step)

@@ -9,11 +9,18 @@ registry (:mod:`metrics`), a stdlib HTTP server exposing ``/metrics`` /
 bridges folding translate-trace spans and goodput reports into the same
 registry (:mod:`bridge`).
 
+PR 7 adds the distributed-runtime tracing plane: a bounded span ring
+with Chrome-trace / OTLP-lines export (:mod:`tracing`), MegaScale-style
+straggler scoring (:class:`bridge.StragglerDetector`), and the alert/
+dashboard manifest builders the emitters attach to workloads
+(:mod:`rules`).
+
 Stdlib-only on import (jax is loaded lazily, only for profiling and
 device-memory reads) so the whole package vendors into emitted images.
 """
 
 from move2kube_tpu.obs.bridge import (
+    StragglerDetector,
     install_goodput_hook,
     install_trace_hook,
     mirror_goodput,
@@ -34,6 +41,13 @@ from move2kube_tpu.obs.server import (
     metrics_port_from_env,
     start_telemetry_server,
 )
+from move2kube_tpu.obs.tracing import (
+    Span,
+    SpanRecorder,
+    install_ring_flush,
+)
+from move2kube_tpu.obs.tracing import enabled as tracing_enabled
+from move2kube_tpu.obs.tracing import get as get_tracer
 
 __all__ = [
     "Counter",
@@ -51,4 +65,10 @@ __all__ = [
     "mirror_goodput",
     "install_trace_hook",
     "install_goodput_hook",
+    "StragglerDetector",
+    "Span",
+    "SpanRecorder",
+    "get_tracer",
+    "tracing_enabled",
+    "install_ring_flush",
 ]

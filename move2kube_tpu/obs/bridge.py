@@ -8,6 +8,9 @@ scrape instead of the workload polling on a timer.
 
 from __future__ import annotations
 
+import threading
+from collections import deque
+
 from move2kube_tpu.obs.metrics import Registry, default_registry
 
 
@@ -51,6 +54,92 @@ def mirror_goodput(report: dict, registry: Registry | None = None) -> None:
         if key in report:
             reg.gauge(name, f"Goodput watermark: {key}"
                       ).set(float(report[key]))
+
+
+class StragglerDetector:
+    """MegaScale-style slow-host identification from per-host step-time
+    reports.
+
+    Each host (or simulated slice in the forced-host drill) reports its
+    wall time for every step; the detector keeps a bounded window per
+    host and scores each host as ``median(host window) / median(fleet
+    medians)`` — 1.0 means in line with the fleet, 1.5 means this host's
+    steps take 50% longer than the typical host. Synchronous data-
+    parallel training runs at the speed of the slowest participant, so a
+    single straggling host taxes every step of every other host; the
+    score makes the guilty one visible *before* anyone stares at 256
+    per-host dashboards.
+
+    Scores are exported as ``m2kt_straggler_score{host=...}`` gauges and
+    crossing ``threshold`` increments
+    ``m2kt_straggler_events_total{host=...}`` once per excursion (hyst:
+    re-arms only after the score drops back under) — alertable without
+    firing once per step while a host stays slow.
+    """
+
+    def __init__(self, registry: Registry | None = None,
+                 threshold: float = 1.5, window: int = 32):
+        reg = registry if registry is not None else default_registry()
+        self.threshold = float(threshold)
+        self.window = max(2, int(window))
+        self._lock = threading.Lock()
+        self._times: dict[str, deque[float]] = {}
+        self._over: set[str] = set()
+        self.events = 0
+        self._score_gauge = reg.gauge(
+            "m2kt_straggler_score",
+            "Per-host median step time / fleet median (1.0 = in line)",
+            labels=("host",))
+        self._event_counter = reg.counter(
+            "m2kt_straggler_events_total",
+            "Straggler threshold crossings", labels=("host",))
+
+    @staticmethod
+    def _median(values) -> float:
+        vals = sorted(values)
+        n = len(vals)
+        mid = n // 2
+        return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2.0
+
+    def report(self, host: str, step: int, seconds: float) -> None:
+        """Fold one (host, step time) observation in and refresh that
+        host's score + event state."""
+        with self._lock:
+            times = self._times.get(host)
+            if times is None:
+                times = self._times[host] = deque(maxlen=self.window)
+            times.append(max(0.0, float(seconds)))
+            scores = self._scores_locked()
+        score = scores.get(host)
+        if score is None:
+            return
+        self._score_gauge.labels(host=host).set(round(score, 6))
+        with self._lock:
+            if score >= self.threshold and host not in self._over:
+                self._over.add(host)
+                self.events += 1
+                fire = True
+            else:
+                if score < self.threshold:
+                    self._over.discard(host)
+                fire = False
+        if fire:
+            self._event_counter.labels(host=host).inc()
+
+    def _scores_locked(self) -> dict[str, float]:
+        medians = {h: self._median(t)
+                   for h, t in self._times.items() if t}
+        if not medians:
+            return {}
+        fleet = self._median(medians.values())
+        if fleet <= 0:
+            return {h: 1.0 for h in medians}
+        return {h: m / fleet for h, m in medians.items()}
+
+    def scores(self) -> dict[str, float]:
+        """Current per-host scores (host median / fleet median)."""
+        with self._lock:
+            return self._scores_locked()
 
 
 def install_trace_hook(registry: Registry | None = None) -> None:

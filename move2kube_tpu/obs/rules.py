@@ -1,0 +1,196 @@
+"""Alerting + dashboard manifest builders for emitted TPU workloads.
+
+The telemetry plane (PR 5) made the metrics scrapeable; this module
+makes them *actionable* at emission time: every JobSet/Deployment/
+Knative target can carry a ``monitoring.coreos.com/v1`` PrometheusRule
+encoding the fleet's operational contract — goodput fraction, step-time
+p95 regression, restart storms (the exit-83 slice-loss signature), and
+serving queue depth — plus a Grafana dashboard ConfigMap (the standard
+``grafana_dashboard: "1"`` sidecar-discovery label) so the first scrape
+lands on a dashboard instead of a blank Explore tab.
+
+Builders return plain manifest dicts and keep this module stdlib-only
+(it is vendored into emitted images with the rest of ``obs/``; nothing
+imports it at runtime there, but an import must not drag the QA engine
+in). The QA gating and cluster-support warnings live in
+``apiresource/obs_wiring.py``; Helm parameterization of the thresholds
+lives in ``passes/parameterize.py`` keyed off :data:`THRESHOLDS`.
+"""
+
+from __future__ import annotations
+
+import json
+
+# alert thresholds, single source of truth: builders bake the default
+# into the PromQL expr, the parameterizer lifts exactly these literals
+# into chart values (value key -> default). Keys double as .Values names.
+THRESHOLDS = {
+    "tpugoodputmin": "0.5",        # goodput fraction alarm floor
+    "tpustepp95factor": "1.5",     # p95 step time vs 1h-ago baseline
+    "tpurestartstormcount": "3",   # restarts per window before alarm
+    "tpuservequeuemax": "64",      # queued requests before alarm
+}
+
+
+def prometheus_rule(name: str, selector_label: str,
+                    serving: bool = False,
+                    thresholds: dict | None = None) -> dict:
+    """A PrometheusRule for one emitted service. Training targets get
+    the goodput/step-time/restart rules; serving targets get the queue
+    rule as well (their engine exports ``m2kt_serve_*``).
+
+    ``thresholds`` overrides the baked-in defaults per key — in Helm
+    output the caller passes ``{{ .Values.<key> }}`` refs so the chart
+    retunes alert floors without touching the manifests."""
+    th = dict(THRESHOLDS)
+    th.update(thresholds or {})
+    sel = f'{{{selector_label.replace("/", "_").replace(".", "_")}="{name}"}}'
+    # the relabeled pod-label selector: annotation-driven scrapes expose
+    # pod labels through labelmap relabeling with / and . sanitized
+    rules = [
+        {
+            "alert": "M2KTGoodputLow",
+            "expr": (f"m2kt_goodput_fraction{sel} "
+                     f"< {th['tpugoodputmin']}"),
+            "for": "15m",
+            "labels": {"severity": "warning", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: goodput fraction below floor",
+                "description": (
+                    "Productive step time is a low fraction of wall "
+                    "clock — the pod is spending its life in restarts, "
+                    "restores, or retry backoff."),
+            },
+        },
+        {
+            "alert": "M2KTStepTimeP95Regression",
+            "expr": (
+                "histogram_quantile(0.95, sum(rate("
+                f"m2kt_train_step_seconds_bucket{sel}[10m])) by (le)) > "
+                f"{th['tpustepp95factor']} * "
+                "histogram_quantile(0.95, sum(rate("
+                f"m2kt_train_step_seconds_bucket{sel}[1h] offset 1h)) "
+                "by (le))"),
+            "for": "10m",
+            "labels": {"severity": "warning", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: step-time p95 regressed",
+                "description": (
+                    "p95 step wall time exceeds its 1h-ago baseline by "
+                    "the configured factor — check the straggler scores "
+                    "(m2kt_straggler_score) and the flight recorder of "
+                    "any recent restarts."),
+            },
+        },
+        {
+            "alert": "M2KTRestartStorm",
+            "expr": (
+                "sum(increase(kube_pod_container_status_restarts_total"
+                f'{{pod=~"{name}.*"}}[30m])) > '
+                f"{th['tpurestartstormcount']}"),
+            "for": "0m",
+            "labels": {"severity": "critical", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: restart storm",
+                "description": (
+                    "Container restarts are above budget for the "
+                    "window. Exit code 83 means slice loss "
+                    "(capacity weather — check the elastic re-plan "
+                    "events in m2kt-exit.json); anything else, read "
+                    "m2kt-flight.json from the pod volume."),
+            },
+        },
+    ]
+    if serving:
+        rules.append({
+            "alert": "M2KTServeQueueDeep",
+            "expr": (f"m2kt_serve_queue_depth{sel} "
+                     f"> {th['tpuservequeuemax']}"),
+            "for": "5m",
+            "labels": {"severity": "warning", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: serving admission queue is deep",
+                "description": (
+                    "Requests are waiting longer than the decode slots "
+                    "can absorb — TTFT is queue-dominated. Scale "
+                    "replicas or raise the max decode batch."),
+            },
+        })
+    return {
+        "apiVersion": "monitoring.coreos.com/v1",
+        "kind": "PrometheusRule",
+        "metadata": {
+            "name": f"{name}-alerts",
+            "labels": {selector_label: name, "role": "alert-rules"},
+        },
+        "spec": {"groups": [{"name": f"m2kt-{name}", "rules": rules}]},
+    }
+
+
+def _panel(panel_id: int, title: str, expr: str, x: int, y: int,
+           unit: str = "short") -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}},
+        "targets": [{"expr": expr, "refId": "A"}],
+    }
+
+
+def grafana_dashboard(name: str, selector_label: str,
+                      serving: bool = False) -> dict:
+    """The Grafana dashboard JSON model for one service: goodput, step
+    time p50/p95, straggler scores, restarts — plus the serving TTFT/
+    queue panels for serving targets."""
+    sel = f'{{{selector_label.replace("/", "_").replace(".", "_")}="{name}"}}'
+    panels = [
+        _panel(1, "Goodput fraction",
+               f"m2kt_goodput_fraction{sel}", 0, 0, "percentunit"),
+        _panel(2, "Step time p50 / p95",
+               "histogram_quantile(0.95, sum(rate("
+               f"m2kt_train_step_seconds_bucket{sel}[5m])) by (le))",
+               12, 0, "s"),
+        _panel(3, "Straggler score by host",
+               f"m2kt_straggler_score{sel}", 0, 8),
+        _panel(4, "Container restarts (30m)",
+               "sum(increase(kube_pod_container_status_restarts_total"
+               f'{{pod=~"{name}.*"}}[30m]))', 12, 8),
+    ]
+    if serving:
+        panels.append(_panel(
+            5, "TTFT p95",
+            "histogram_quantile(0.95, sum(rate("
+            f"m2kt_serve_ttft_seconds_bucket{sel}[5m])) by (le))",
+            0, 16, "s"))
+        panels.append(_panel(
+            6, "Serving queue depth",
+            f"m2kt_serve_queue_depth{sel}", 12, 16))
+    return {
+        "title": f"move2kube-tpu: {name}",
+        "uid": f"m2kt-{name}",
+        "tags": ["move2kube-tpu", name],
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "panels": panels,
+    }
+
+
+def dashboard_configmap(name: str, selector_label: str,
+                        serving: bool = False) -> dict:
+    """The dashboard wrapped in a ConfigMap the standard Grafana sidecar
+    discovers via the ``grafana_dashboard: "1"`` label."""
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {
+            "name": f"{name}-dashboard",
+            "labels": {selector_label: name, "grafana_dashboard": "1"},
+        },
+        "data": {
+            f"{name}-dashboard.json": json.dumps(
+                grafana_dashboard(name, selector_label, serving=serving),
+                indent=2, sort_keys=True) + "\n",
+        },
+    }

@@ -1,4 +1,4 @@
-"""Stdlib-HTTP telemetry server: /metrics, /healthz, /profile?seconds=N.
+"""Stdlib-HTTP telemetry server: /metrics, /healthz, /readyz, /profile.
 
 One daemon thread per process (ThreadingHTTPServer: a slow profiler
 capture must not block a concurrent scrape). ``/profile`` drives
@@ -6,6 +6,14 @@ capture must not block a concurrent scrape). ``/profile`` drives
 the operator curls the pod, waits N seconds, and pulls the trace from
 the volume, no workload restart. jax is imported lazily so the server
 (and the whole obs package) stays importable in slim images.
+
+Liveness vs readiness are distinct probes: ``/healthz`` answers 200
+whenever the process (and this thread) is alive — restarting a pod
+because its model is still compiling would be self-inflicted crashloop —
+while ``/readyz`` reports the workload's actual state (``starting`` /
+``serving`` / ``draining``) via a caller-supplied provider and returns
+503 until it says ``serving``, so a serving pod takes no traffic before
+warm-up and is drained from endpoints before shutdown.
 """
 
 from __future__ import annotations
@@ -42,11 +50,16 @@ class TelemetryServer:
     OS-assigned port (tests); ``.port`` is the bound port either way."""
 
     def __init__(self, port: int = 0, registry: Registry | None = None,
-                 profile_dir: str | None = None) -> None:
+                 profile_dir: str | None = None,
+                 readiness=None) -> None:
         self.registry = registry if registry is not None else default_registry()
         self.profile_dir = (profile_dir
                             or os.environ.get(PROFILE_DIR_ENV, "")
                             or DEFAULT_PROFILE_DIR)
+        # readiness provider: a zero-arg callable returning "starting" /
+        # "serving" / "draining". None keeps /readyz always-ready for
+        # back-compat (trainers have no warm-up gate to report).
+        self._readiness = readiness
         self._profile_lock = threading.Lock()
         server = self
 
@@ -79,13 +92,35 @@ class TelemetryServer:
         if parsed.path == "/metrics":
             self._send(req, 200, self.registry.render(), CONTENT_TYPE)
         elif parsed.path == "/healthz":
+            # liveness only: reachable == alive; workload state belongs
+            # to /readyz (a compiling model must not be restart-killed)
             self._send(req, 200, "ok\n")
+        elif parsed.path == "/readyz":
+            self._handle_readyz(req)
         elif parsed.path == "/profile":
             self._handle_profile(req, parse_qs(parsed.query))
         else:
             self._send(req, 404, "not found\n")
 
+    def set_readiness(self, readiness) -> None:
+        """Install/replace the readiness provider after construction (the
+        serve template builds the server before the engine exists)."""
+        self._readiness = readiness
+
+    def _handle_readyz(self, req) -> None:
+        state = "serving"
+        if self._readiness is not None:
+            try:
+                state = str(self._readiness())
+            except Exception as e:  # noqa: BLE001 - probe must not 500
+                self._send(req, 503, f"readiness probe errored: {e}\n")
+                return
+        self._send(req, 200 if state == "serving" else 503, state + "\n")
+
     def _handle_profile(self, req, query: dict) -> None:
+        # every failure here is fail-open and non-5xx: a bad or unlucky
+        # /profile request must degrade to a handled client-error reply,
+        # never to a 5xx that trips alerting on the workload itself
         try:
             seconds = float(query.get("seconds", ["1"])[0])
         except (TypeError, ValueError):
@@ -95,13 +130,22 @@ class TelemetryServer:
             self._send(req, 400,
                        f"seconds must be in (0, {MAX_PROFILE_SECONDS:g}]\n")
             return
+        try:
+            os.makedirs(self.profile_dir, exist_ok=True)
+            writable = os.access(self.profile_dir, os.W_OK)
+        except OSError:
+            writable = False
+        if not writable:
+            self._send(req, 403,
+                       f"profile dir {self.profile_dir} is not writable\n")
+            return
         if not self._profile_lock.acquire(blocking=False):
             self._send(req, 409, "a profile capture is already running\n")
             return
         try:
             result = self._capture(seconds)
         except Exception as e:  # noqa: BLE001 - surface, don't kill the server
-            self._send(req, 501, f"profiler unavailable: {e}\n")
+            self._send(req, 422, f"profiler unavailable: {e}\n")
             return
         finally:
             self._profile_lock.release()
@@ -132,8 +176,8 @@ class TelemetryServer:
 
 def start_telemetry_server(port: int | None = None,
                            registry: Registry | None = None,
-                           profile_dir: str | None = None
-                           ) -> TelemetryServer | None:
+                           profile_dir: str | None = None,
+                           readiness=None) -> TelemetryServer | None:
     """Start the telemetry server. ``port=None`` resolves from
     ``M2KT_METRICS_PORT`` and returns None when that says disabled (0 /
     unset) — the shape the emitted templates use. An explicit ``port=0``
@@ -144,7 +188,8 @@ def start_telemetry_server(port: int | None = None,
             return None
     try:
         return TelemetryServer(port=port, registry=registry,
-                               profile_dir=profile_dir).start()
+                               profile_dir=profile_dir,
+                               readiness=readiness).start()
     except OSError:
         # never kill a training run over a busy metrics port
         return None

@@ -1,0 +1,409 @@
+"""Runtime distributed tracing: bounded span ring + flight-recorder dump.
+
+Distinct from ``utils/trace.py`` on purpose: that module times *pipeline*
+stages of one translate run (seconds-scale, rolled up by name into one
+JSON document). This one traces the *emitted runtime's* hot paths —
+per-training-step and per-serving-request spans at µs resolution — and
+must therefore be (a) cheap enough to leave on (a dict append under a
+lock; the bench obs phase gates the cost at ≤3% of step time), (b)
+bounded (a ring holding the last ``M2KT_TRACE_RING_SECONDS`` of spans,
+with a hard entry cap — a month-long trainer must not grow a month-long
+span list), and (c) crash-useful: the ring is exactly what the flight
+recorder dumps when the supervisor sees a retryable/fatal/slice-lost
+death, so the last seconds before an exit-83 are reconstructable.
+
+Clocks: span endpoints are ``time.perf_counter()`` (monotonic — a wall
+clock stepped by NTP mid-span would corrupt durations); one wall-clock
+anchor captured at recorder construction maps them back to unix time for
+export. Identity: every span carries a 16-hex trace id and 8-hex span id
+(W3C-sized), plus ``M2KT_SLICE_ID``/hostname/pid resource tags so rings
+flushed by different hosts of a multislice job can be merged and still
+attributed.
+
+Exports:
+
+- ``chrome_trace()`` — Chrome trace-event JSON (``ph: "X"`` complete
+  events, µs timestamps), loadable directly in Perfetto / chrome://tracing;
+- ``otlp_lines()`` — OTLP/JSON-shaped lines (one ``resourceSpans`` object
+  per line) for a collector's filelog receiver, without taking an
+  opentelemetry dependency;
+- ``flush_ring(path)`` — the crash-flight half: atomic JSON dump of the
+  ring for the supervisor to fold into ``m2kt-flight.json``.
+
+Stdlib-only: this module is vendored into emitted images next to
+``obs/metrics.py``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import socket
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+TRACE_ENV = "M2KT_TRACE"
+RING_SECONDS_ENV = "M2KT_TRACE_RING_SECONDS"
+FLIGHT_PATH_ENV = "M2KT_FLIGHT_PATH"
+
+DEFAULT_RING_SECONDS = 120.0
+# hard cap regardless of ring_seconds: a serving engine decoding 1k
+# steps/s must not hold 120k span dicts because the window says so
+DEFAULT_MAX_SPANS = 8192
+
+
+def enabled() -> bool:
+    """Tracing defaults ON: the recorder is a bounded dict-append whose
+    cost the bench obs phase gates at ≤3% of step time, and a flight
+    recorder that is off by default records no flights."""
+    return os.environ.get(TRACE_ENV, "1").lower() not in ("0", "false", "off")
+
+
+def ring_seconds() -> float:
+    raw = os.environ.get(RING_SECONDS_ENV, "")
+    try:
+        val = float(raw) if raw else DEFAULT_RING_SECONDS
+    except ValueError:
+        return DEFAULT_RING_SECONDS
+    return val if val > 0 else DEFAULT_RING_SECONDS
+
+
+def flight_path() -> str:
+    """Where the supervisor writes ``m2kt-flight.json`` (and, derived,
+    where the dying child flushes its span ring for the supervisor to
+    pick up). Defaults next to the goodput/metrics artifacts."""
+    p = os.environ.get(FLIGHT_PATH_ENV, "")
+    if p:
+        return p
+    return os.path.join(os.environ.get("M2KT_METRICS_DIR", "") or ".",
+                        "m2kt-flight.json")
+
+
+def ring_path() -> str:
+    """Child-side ring dump path: the supervisor and the supervised
+    process compute the same name from the same env, no handshake."""
+    return flight_path() + ".ring"
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+class Span:
+    """One timed operation. ``t0``/``t1`` are perf_counter readings of
+    the owning recorder's clock; ``t1 is None`` while in flight."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "t0", "t1",
+                 "attrs", "_token")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: str, t0: float, attrs: dict | None = None):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = t0
+        self.t1: float | None = None
+        self.attrs: dict = dict(attrs) if attrs else {}
+        self._token = None
+
+
+class SpanRecorder:
+    """Thread-safe bounded ring of completed spans + in-flight set.
+
+    Completed spans older than ``ring_seconds`` (or beyond ``max_spans``)
+    are evicted on append — memory is O(window), not O(run length).
+    In-flight spans are tracked separately so a crash dump still shows
+    what was executing when the process died.
+    """
+
+    def __init__(self, ring_seconds: float | None = None,
+                 max_spans: int = DEFAULT_MAX_SPANS,
+                 host: str | None = None, slice_id: int | None = None):
+        self._lock = threading.Lock()
+        self._ring: deque[Span] = deque()
+        self._active: dict[str, Span] = {}
+        self.max_spans = max(1, int(max_spans))
+        self.ring_seconds = float(ring_seconds) if ring_seconds else (
+            globals()["ring_seconds"]())
+        # wall-clock anchor for export; all span math stays monotonic
+        self._t0_perf = time.perf_counter()
+        self._t0_unix = time.time()
+        self.host = host or socket.gethostname()
+        if slice_id is None:
+            try:
+                slice_id = int(os.environ.get("M2KT_SLICE_ID", "0") or 0)
+            except ValueError:
+                slice_id = 0
+        self.slice_id = slice_id
+        self.dropped = 0
+        # per-recorder context: nested start() calls parent automatically
+        # within one thread/task without threading ids through call sites
+        self._current: contextvars.ContextVar[Span | None] = (
+            contextvars.ContextVar(f"m2kt_span_{id(self)}", default=None))
+
+    # -- recording ---------------------------------------------------------
+
+    def start(self, name: str, attrs: dict | None = None,
+              parent: Span | None = None, trace_id: str | None = None,
+              detached: bool = False) -> Span:
+        """Open a span. Parent/trace identity comes from (in order) the
+        explicit args, the calling context's current span, or a fresh
+        root trace. The new span becomes the context's current span —
+        unless ``detached``, which neither inherits nor sets the context
+        (the serving engine interleaves many live request traces in one
+        thread and threads identity explicitly instead)."""
+        if parent is None and not detached:
+            parent = self._current.get()
+        if parent is not None:
+            trace_id = trace_id or parent.trace_id
+            parent_id = parent.span_id
+        else:
+            trace_id = trace_id or _new_id(16)
+            parent_id = ""
+        span = Span(name, trace_id, _new_id(8), parent_id,
+                    time.perf_counter(), attrs)
+        if not detached:
+            span._token = self._current.set(span)
+        with self._lock:
+            self._active[span.span_id] = span
+        return span
+
+    def end(self, span: Span, attrs: dict | None = None) -> Span:
+        span.t1 = time.perf_counter()
+        if attrs:
+            span.attrs.update(attrs)
+        if span._token is not None:
+            try:
+                self._current.reset(span._token)
+            except ValueError:
+                self._current.set(None)  # ended from another context
+            span._token = None
+        with self._lock:
+            self._active.pop(span.span_id, None)
+            self._append_locked(span)
+        return span
+
+    @contextmanager
+    def span(self, name: str, attrs: dict | None = None,
+             parent: Span | None = None, trace_id: str | None = None):
+        s = self.start(name, attrs, parent=parent, trace_id=trace_id)
+        try:
+            yield s
+        finally:
+            self.end(s)
+
+    def record(self, name: str, t0: float, t1: float,
+               attrs: dict | None = None, trace_id: str | None = None,
+               parent_id: str = "") -> Span:
+        """Append an already-timed span from explicit perf_counter
+        endpoints — the serving engine times prefill/decode itself and
+        must hand the *same* readings to both the TTFT histogram and the
+        trace, so the two decompositions agree exactly."""
+        span = Span(name, trace_id or _new_id(16), _new_id(8), parent_id,
+                    t0, attrs)
+        span.t1 = t1
+        with self._lock:
+            self._append_locked(span)
+        return span
+
+    @staticmethod
+    def annotate(span: Span, **attrs) -> None:
+        span.attrs.update(attrs)
+
+    def current(self) -> Span | None:
+        return self._current.get()
+
+    def _append_locked(self, span: Span) -> None:
+        self._ring.append(span)
+        horizon = time.perf_counter() - self.ring_seconds
+        while self._ring and (
+                len(self._ring) > self.max_spans
+                or (self._ring[0].t1 is not None
+                    and self._ring[0].t1 < horizon)):
+            self._ring.popleft()
+            self.dropped += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._active.clear()
+            self.dropped = 0
+
+    # -- export ------------------------------------------------------------
+
+    def _unix(self, t: float) -> float:
+        return self._t0_unix + (t - self._t0_perf)
+
+    def snapshot(self) -> list[dict]:
+        """Completed + in-flight spans as plain dicts (oldest first);
+        in-flight spans report the duration so far and ``in_flight``."""
+        now = time.perf_counter()
+        with self._lock:
+            spans = list(self._ring) + sorted(
+                self._active.values(), key=lambda s: s.t0)
+        out = []
+        for s in spans:
+            end = s.t1 if s.t1 is not None else now
+            out.append({
+                "name": s.name,
+                "trace_id": s.trace_id,
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "ts_unix": round(self._unix(s.t0), 6),
+                "dur_s": round(end - s.t0, 9),
+                "in_flight": s.t1 is None,
+                "attrs": dict(s.attrs),
+            })
+        return out
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON: ``ph: "X"`` complete events with µs
+        timestamps, pid = host process, tid = slice id — drop the file
+        in Perfetto and the per-step/per-request timeline renders."""
+        pid = os.getpid()
+        events = []
+        for s in self.snapshot():
+            events.append({
+                "name": s["name"],
+                "ph": "X",
+                "ts": round((s["ts_unix"] - self._t0_unix) * 1e6, 3),
+                "dur": round(s["dur_s"] * 1e6, 3),
+                "pid": pid,
+                "tid": self.slice_id,
+                "cat": "m2kt",
+                "args": {**s["attrs"], "trace_id": s["trace_id"],
+                         "span_id": s["span_id"],
+                         "parent_id": s["parent_id"]},
+            })
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"host": self.host, "slice_id": self.slice_id,
+                          "anchor_unix": self._t0_unix},
+        }
+
+    def otlp_lines(self) -> list[str]:
+        """OTLP/JSON-shaped lines: one ``resourceSpans`` object per line
+        (filelog-receiver friendly), string/int attributes only."""
+        resource_attrs = [
+            {"key": "host.name", "value": {"stringValue": self.host}},
+            {"key": "m2kt.slice_id",
+             "value": {"intValue": str(self.slice_id)}},
+            {"key": "service.name", "value": {"stringValue": "move2kube-tpu"}},
+        ]
+        lines = []
+        for s in self.snapshot():
+            attrs = []
+            for k, v in s["attrs"].items():
+                if isinstance(v, bool):
+                    attrs.append({"key": k, "value": {"boolValue": v}})
+                elif isinstance(v, int):
+                    attrs.append({"key": k, "value": {"intValue": str(v)}})
+                elif isinstance(v, float):
+                    attrs.append({"key": k, "value": {"doubleValue": v}})
+                else:
+                    attrs.append({"key": k,
+                                  "value": {"stringValue": str(v)}})
+            start_ns = int(s["ts_unix"] * 1e9)
+            lines.append(json.dumps({"resourceSpans": [{
+                "resource": {"attributes": resource_attrs},
+                "scopeSpans": [{
+                    "scope": {"name": "m2kt.obs.tracing"},
+                    "spans": [{
+                        "traceId": s["trace_id"],
+                        "spanId": s["span_id"],
+                        "parentSpanId": s["parent_id"],
+                        "name": s["name"],
+                        "kind": 1,
+                        "startTimeUnixNano": str(start_ns),
+                        "endTimeUnixNano": str(
+                            start_ns + int(s["dur_s"] * 1e9)),
+                        "attributes": attrs,
+                    }],
+                }],
+            }]}, separators=(",", ":")))
+        return lines
+
+    # -- flight-recorder half ---------------------------------------------
+
+    def flush_ring(self, path: str | None = None) -> str | None:
+        """Atomically dump the ring for the supervisor's flight recorder.
+        Best-effort by design — this runs on dying-process paths and must
+        never mask the original exit code."""
+        path = path or ring_path()
+        doc = {
+            "host": self.host,
+            "slice_id": self.slice_id,
+            "pid": os.getpid(),
+            "written_unix": time.time(),
+            "ring_seconds": self.ring_seconds,
+            "dropped": self.dropped,
+            "spans": self.snapshot(),
+        }
+        try:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+                f.write("\n")
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None
+
+
+_recorder: SpanRecorder | None = None
+_recorder_lock = threading.Lock()
+
+
+def get() -> SpanRecorder:
+    """Process-wide recorder (lazy: env knobs are read at first use)."""
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = SpanRecorder()
+    return _recorder
+
+
+def reset() -> None:
+    global _recorder
+    with _recorder_lock:
+        _recorder = None
+
+
+_flush_installed = False
+
+
+def install_ring_flush(path: str | None = None) -> None:
+    """Flush the span ring on every interpreter exit path that runs
+    teardown — the same ``threading._register_atexit`` trick as
+    ``checkpoint.install_exit_flush`` (see that docstring for why plain
+    atexit is too late), so a ``sys.exit(83)`` from an injected
+    slice-loss fault still leaves the ring on disk for the supervisor's
+    flight recorder. SIGKILL skips teardown; that flight is simply the
+    goodput ledger alone."""
+    global _flush_installed
+    if _flush_installed or not enabled():
+        return
+    _flush_installed = True
+
+    def _flush() -> None:
+        try:
+            if _recorder is not None:
+                _recorder.flush_ring(path)
+        except Exception:  # noqa: BLE001 - dying process, best effort
+            pass
+
+    register = getattr(threading, "_register_atexit", None)
+    if register is None:
+        import atexit
+
+        atexit.register(_flush)
+    else:
+        register(_flush)

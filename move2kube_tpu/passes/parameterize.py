@@ -150,10 +150,39 @@ def tpu_obs_parameterizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_rules_parameterizer(ir: IR) -> IR:
+    """Lift the alert-rule thresholds (obs/rules.py ``THRESHOLDS``) into
+    chart values for every service whose ``m2kt.services.<name>.obs.rules``
+    knob is on, so a Helm install retunes alert floors per environment
+    (``--set tpugoodputmin=0.8``) without touching the manifests.
+
+    Unlike the env-lifting parameterizers this one cannot rewrite the
+    manifests itself — the PrometheusRule objects are built *after*
+    parameterization, at apiresource time. The contract is split: this
+    pass seeds the values (keys double as the ``.Values`` names), and
+    ``apiresource/obs_wiring.maybe_rules_objects`` sees them seeded and
+    bakes ``{{ .Values.<key> }}`` refs into the PromQL instead of the
+    literals. The QA knob is fetched with the same id the emitters use,
+    so one cached answer keeps both sides agreed."""
+    from move2kube_tpu.apiresource.obs_wiring import (
+        metrics_port_value, rules_enabled)
+    from move2kube_tpu.obs.rules import THRESHOLDS
+
+    for svc in ir.services.values():
+        if getattr(svc, "accelerator", None) is None:
+            continue
+        if not metrics_port_value(svc) or not rules_enabled(svc.name):
+            continue
+        for key, default in THRESHOLDS.items():
+            ir.values.global_variables.setdefault(key, default)
+        break  # one global threshold set — same shape as ingresshost
+    return ir
+
+
 PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
                   storage_class_parameterizer, tpu_training_parameterizer,
                   tpu_serving_parameterizer, tpu_elastic_parameterizer,
-                  tpu_obs_parameterizer]
+                  tpu_obs_parameterizer, tpu_rules_parameterizer]
 
 
 def parameterize(ir: IR) -> IR:

@@ -79,9 +79,18 @@ def main() -> None:
 
     from move2kube_tpu.models import checkpoint as m2kt_ckpt
     from move2kube_tpu.models import train as m2kt_train
+    from move2kube_tpu.obs import tracing
     from move2kube_tpu.parallel.mesh import make_mesh
     from move2kube_tpu.parallel.topology import resolve_mesh_plan
     from move2kube_tpu.resilience import faults, goodput, preemption
+
+    # runtime tracing: per-step spans into the bounded ring, flushed to
+    # <flight>.ring on every teardown-running exit path (incl. the
+    # injected sys.exit(83) slice loss) so the supervisor's flight
+    # recorder can reconstruct the final seconds of a dead attempt
+    tracer = tracing.get() if tracing.enabled() else None
+    if tracer is not None:
+        tracing.install_ring_flush()
 
     steps = int(os.environ.get("M2KT_STEPS", "8"))
     step_sleep = float(os.environ.get("M2KT_STEP_SLEEP_S", "0"))
@@ -103,6 +112,13 @@ def main() -> None:
     # loss), then lay the mesh in plan order
     plan = resolve_mesh_plan(jax.device_count())
     mesh = make_mesh(plan)
+    straggler = None
+    host = ""
+    if tracer is not None:
+        from move2kube_tpu.obs.bridge import StragglerDetector
+
+        straggler = StragglerDetector()
+        host = tracer.host
     batch = bpd * plan.config.data * plan.config.fsdp
     print(f"[m2kt] plan: {plan.describe()} devices={jax.device_count()} "
           f"global_batch={batch}", flush=True)
@@ -147,8 +163,20 @@ def main() -> None:
             jax.block_until_ready(loss)
             if step_sleep:
                 time.sleep(step_sleep)
+            t1 = time.perf_counter()
+            if tracer is not None:
+                tracer.record(
+                    "train.compile" if i == start + 1 else "train.step",
+                    t0, t1, attrs={"step": i})
             gp.add("compile" if i == start + 1 else "productive",
-                   time.perf_counter() - t0, steps=1)
+                   t1 - t0, steps=1)
+            if straggler is not None and i != start + 1:
+                # one report per simulated slice: the forced-host drill
+                # runs every slice in this process so the dt is shared,
+                # but the scoring/gauge path is the same one a per-host
+                # reporter feeds on real multislice
+                for s in range(max(1, plan.dcn_dp)):
+                    straggler.report(f"{host}/s{s}", i, t1 - t0)
             if ckpt is not None and ckpt.maybe_save(i, state):
                 # synchronous commit: the fault tests assert resume-from-N,
                 # so a save the loop reports must be durable before a kill
@@ -178,6 +206,11 @@ def main() -> None:
     if loss is not None:
         print(f"[m2kt] step={gp.steps_done} loss={float(loss):.6f}",
               flush=True)
+    if straggler is not None and straggler.scores():
+        worst = max(straggler.scores().items(), key=lambda kv: kv[1])
+        print(f"[m2kt] straggler: hosts={len(straggler.scores())} "
+              f"worst={worst[0]} score={worst[1]:.3f} "
+              f"events={straggler.events}", flush=True)
     gp.write()
     rep = gp.report()
     if preempted_at is not None:

@@ -240,6 +240,12 @@ class Supervisor:
                 "report": report, "ok": clazz == OK,
             })
             log.warning("attempt %d exited rc=%d class=%s", attempt, rc, clazz)
+            if clazz in (RETRYABLE, FATAL, SLICE_LOST):
+                # flight recorder: capture THIS death's context now —
+                # an elastic re-plan or a successful retry will end the
+                # pod with class ok, but the flight from the dead
+                # attempt is exactly what the postmortem needs
+                self._write_flight(clazz, rc, attempt, report)
             if clazz == OK:
                 return self._finish(OK, 0)
             if clazz == PREEMPTED:
@@ -332,6 +338,54 @@ class Supervisor:
         self._env_overrides.update(overrides)
         self._replan_events.append(event)
         return event
+
+    def _write_flight(self, exit_class: str, rc: int, attempt: int,
+                      report: dict | None) -> None:
+        """Crash flight recorder: fold the dead child's span ring (the
+        child flushes its last ``M2KT_TRACE_RING_SECONDS`` of spans to
+        ``<flight>.ring`` on teardown — ``obs.tracing.install_ring_flush``)
+        together with its goodput ledger and the stderr tail into
+        ``m2kt-flight.json``. A SIGKILL'd child leaves no ring; the
+        flight then carries the ledger and classification alone.
+        Best-effort: a flight the supervisor cannot write must never
+        change the exit path."""
+        from move2kube_tpu.obs import tracing
+
+        ring: dict = {}
+        ring_file = tracing.ring_path()
+        try:
+            with open(ring_file, encoding="utf-8") as f:
+                ring = json.load(f)
+        except (OSError, ValueError):
+            pass
+        tail = self._attempts[-1].get("stderr_tail", "") \
+            if self._attempts else ""
+        flight = {
+            "exit_class": exit_class,
+            "returncode": rc,
+            "attempt": attempt,
+            "written_unix": time.time(),
+            "cmd": self.cmd,
+            "stderr_tail": tail[-2000:],
+            "goodput": report or {},
+            "ring": {k: ring.get(k) for k in
+                     ("host", "slice_id", "pid", "written_unix",
+                      "ring_seconds", "dropped")} if ring else {},
+            "spans": ring.get("spans", []),
+        }
+        path = tracing.flight_path()
+        try:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(flight, f, indent=2, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+            log.warning("flight recorder: %s (%d spans) -> %s",
+                        exit_class, len(flight["spans"]), path)
+        except OSError as e:
+            log.warning("could not write flight file %s: %s", path, e)
 
     def _finish(self, exit_class: str, code: int) -> int:
         merged = goodput.merge_attempts(self._attempts)

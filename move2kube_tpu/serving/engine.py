@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from move2kube_tpu.obs import tracing
 from move2kube_tpu.obs.metrics import Registry
 from move2kube_tpu.serving import kvcache
 from move2kube_tpu.serving.kvcache import (
@@ -139,7 +140,8 @@ class ServingEngine:
     """
 
     def __init__(self, model, variables, config: EngineConfig | None = None,
-                 registry: Registry | None = None):
+                 registry: Registry | None = None,
+                 tracer: "tracing.SpanRecorder | None" = None):
         self.model = model
         self.variables = variables
         self.config = config or EngineConfig.from_env()
@@ -158,6 +160,12 @@ class ServingEngine:
         self._decode_tokens = 0
         self._prefill_count = 0
         self._submit_ts: dict[str, float] = {}
+        # per-request distributed traces (admit -> queue-wait -> prefill
+        # -> decode steps -> complete); identity is threaded explicitly
+        # because many live request traces interleave in one thread
+        self.tracer = tracer if tracer is not None else (
+            tracing.get() if tracing.enabled() else None)
+        self._req_spans: dict[str, tracing.Span] = {}
         # a private registry by default: engine instruments must not
         # cross-pollute between engines tests build in one process; the
         # serve template passes obs.default_registry() so /metrics sees it
@@ -272,6 +280,10 @@ class ServingEngine:
             self._rejected.inc()
             raise
         self._submit_ts[req.rid] = time.perf_counter()
+        if self.tracer is not None:
+            self._req_spans[req.rid] = self.tracer.start(
+                "serve.request", attrs={"rid": req.rid, "prompt_len": plen},
+                detached=True)
         self._pending.append(req)
         self._queue_depth.set(len(self._pending))
 
@@ -307,6 +319,15 @@ class ServingEngine:
             tok = int(next_tokens[i])
             slot.tokens.append(tok)
             slot.last_token = tok
+            if self.tracer is not None:
+                root = self._req_spans.get(slot.req.rid)
+                if root is not None:
+                    # reuse the step's own t0/dt readings: the span adds
+                    # no clock calls to the decode hot path
+                    self.tracer.record(
+                        "serve.decode_step", t0, t0 + dt,
+                        attrs={"token_index": len(slot.tokens)},
+                        trace_id=root.trace_id, parent_id=root.span_id)
             done = self._finish_reason(slot, tok)
             if done:
                 finished.append(self._release(i, done))
@@ -343,6 +364,11 @@ class ServingEngine:
         self._allocator.free(slot.pages)
         self._slots[slot_idx] = None
         self._completed.labels(reason=reason).inc()
+        if self.tracer is not None:
+            root = self._req_spans.pop(slot.req.rid, None)
+            if root is not None:
+                self.tracer.end(root, attrs={
+                    "finish_reason": reason, "tokens": len(slot.tokens)})
         self._update_occupancy()
         return Completion(rid=slot.req.rid, prompt_len=len(slot.req.prompt),
                           tokens=list(slot.tokens), finish_reason=reason)
@@ -374,6 +400,7 @@ class ServingEngine:
         bt_row = np.full((self.cache_cfg.max_pages_per_seq,), NULL_PAGE,
                          np.int32)
         bt_row[:len(pages)] = pages
+        t_prefill = time.perf_counter()
         first, _, cache = self._prefill(
             self.variables, self._cache, ids, bt_row,
             np.int32(slot_idx), np.int32(plen))
@@ -382,7 +409,22 @@ class ServingEngine:
         self._admitted.inc()
         submit_ts = self._submit_ts.pop(req.rid, None)
         if submit_ts is not None:
-            self._ttft_hist.observe(time.perf_counter() - submit_ts)
+            # ONE clock reading closes both the histogram sample and the
+            # trace: queue_wait + prefill spans sum to exactly the TTFT
+            # the histogram observed (the trace decomposes the metric,
+            # it doesn't approximate it)
+            now = time.perf_counter()
+            self._ttft_hist.observe(now - submit_ts)
+            root = self._req_spans.get(req.rid)
+            if self.tracer is not None and root is not None:
+                self.tracer.record(
+                    "serve.queue_wait", submit_ts, t_prefill,
+                    trace_id=root.trace_id, parent_id=root.span_id)
+                self.tracer.record(
+                    "serve.prefill", t_prefill, now,
+                    attrs={"bucket": bucket, "prompt_len": plen},
+                    trace_id=root.trace_id, parent_id=root.span_id)
+                root.attrs["ttft_s"] = now - submit_ts
         tok = int(first)
         slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
                      max_new=max_new)

@@ -16,34 +16,48 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 
 _lock = threading.Lock()
 _local = threading.local()
 
+# raw-event ring bound: per-name TOTALS are kept exactly in a dict whose
+# cardinality is the span-name set (small and fixed by the pipeline), but
+# the raw append-per-call event list must not grow with call count — the
+# obs bridge re-mirrors the recorder on every scrape of a long-lived
+# process (same grow-forever class as the serving engine's old
+# _step_latencies list)
+SPAN_RING_MAX = 1024
+
 
 class Recorder:
     def __init__(self) -> None:
-        self.spans: list[dict] = []
+        self.spans: deque[dict] = deque(maxlen=SPAN_RING_MAX)
         self.counters: dict[str, int] = {}
         self.started = time.time()
+        self._span_totals: dict[str, float] = {}
 
     def add_span(self, name: str, seconds: float) -> None:
         with _lock:
             self.spans.append({"name": name, "seconds": round(seconds, 6)})
+            self._span_totals[name] = (
+                self._span_totals.get(name, 0.0) + seconds)
 
     def count(self, name: str, n: int = 1) -> None:
         with _lock:
             self.counters[name] = self.counters.get(name, 0) + n
 
     def to_dict(self) -> dict:
+        """Totals come from the persistent accumulator, NOT the ring:
+        rolled per-name sums stay exact even after the ring evicts old
+        raw events, so ``write_metrics`` output keeps its shape and its
+        meaning regardless of run length."""
         with _lock:
-            rolled: dict[str, float] = {}
-            for s in self.spans:
-                rolled[s["name"]] = rolled.get(s["name"], 0.0) + s["seconds"]
             return {
                 "wall_seconds": round(time.time() - self.started, 3),
-                "spans": {k: round(v, 6) for k, v in sorted(rolled.items())},
+                "spans": {k: round(v, 6)
+                          for k, v in sorted(self._span_totals.items())},
                 "counters": dict(sorted(self.counters.items())),
             }
 

@@ -229,6 +229,79 @@ def test_server_profile_endpoint(tmp_path):
         srv.close()
 
 
+def test_server_readyz_splits_liveness_from_readiness():
+    """/healthz stays 200 whatever the workload state (liveness must not
+    restart a compiling pod); /readyz follows the provider and 503s for
+    anything but "serving" — including a provider that throws."""
+    srv = TelemetryServer(port=0, registry=Registry()).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        # no provider: trainers have no warm-up gate, /readyz is ready
+        code, _, body = _get(f"{base}/readyz")
+        assert code == 200 and body == "serving\n"
+
+        state = {"s": "starting"}
+        srv.set_readiness(lambda: state["s"])
+        for not_ready in ("starting", "draining"):
+            state["s"] = not_ready
+            try:
+                _get(f"{base}/readyz")
+                raise AssertionError(f"{not_ready} must 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert e.read().decode() == not_ready + "\n"
+            # liveness is unaffected by workload state
+            code, _, _ = _get(f"{base}/healthz")
+            assert code == 200
+        state["s"] = "serving"
+        code, _, body = _get(f"{base}/readyz")
+        assert code == 200 and body == "serving\n"
+
+        srv.set_readiness(lambda: 1 / 0)
+        try:
+            _get(f"{base}/readyz")
+            raise AssertionError("raising provider must 503, not 500")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert "readiness probe errored" in e.read().decode()
+    finally:
+        srv.close()
+
+
+def test_server_profile_unwritable_dir_fails_open(tmp_path):
+    """A profile dir that cannot be created/written replies 403 — a
+    client error, never a 5xx that pages on the workload itself."""
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where the profile dir wants a directory\n")
+    srv = TelemetryServer(port=0, registry=Registry(),
+                          profile_dir=str(blocker / "prof")).start()
+    try:
+        try:
+            _get(f"http://127.0.0.1:{srv.port}/profile?seconds=0.01")
+            raise AssertionError("unwritable profile dir must 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+            assert "is not writable" in e.read().decode()
+    finally:
+        srv.close()
+
+
+def test_server_profile_rejects_concurrent_capture(tmp_path):
+    srv = TelemetryServer(port=0, registry=Registry(),
+                          profile_dir=str(tmp_path / "prof")).start()
+    assert srv._profile_lock.acquire(blocking=False)
+    try:
+        try:
+            _get(f"http://127.0.0.1:{srv.port}/profile?seconds=0.01")
+            raise AssertionError("concurrent capture must 409")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+            assert "already running" in e.read().decode()
+    finally:
+        srv._profile_lock.release()
+        srv.close()
+
+
 def test_start_telemetry_server_env_resolution(monkeypatch):
     monkeypatch.delenv("M2KT_METRICS_PORT", raising=False)
     assert metrics_port_from_env(0) == 0
@@ -649,3 +722,176 @@ def test_helm_emission_parameterizes_scrape_port(tmp_path):
     # tpumetricsport=9464 retunes both together
     assert rendered.count("{{ .Values.tpumetricsport }}") >= 2
     assert "prometheus.io/port" in rendered
+
+
+# ----------------------------------------------------------------------
+# alert rules + dashboard emission (obs_wiring / obs.rules)
+# ----------------------------------------------------------------------
+
+
+def test_rules_emission_default_off():
+    ir, _ = _accel_service()
+    _qa()
+    try:
+        ir = tpu_observability_optimizer(ir)
+        objs = convert_objects(ir, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+    assert not [o for o in objs if o.get("kind") == "PrometheusRule"]
+    assert not [o for o in objs if o.get("kind") == "ConfigMap"
+                and "dashboard" in o["metadata"]["name"]]
+
+
+def test_rules_emission_behind_qa_knob():
+    """Knob on: the JobSet rides with a PrometheusRule carrying the four
+    alert contracts (literal thresholds in k8s output) and a Grafana
+    dashboard ConfigMap with the sidecar-discovery label."""
+    ir, _ = _accel_service()
+    _qa({"m2kt.services.trainer.obs.rules": True})
+    try:
+        ir = tpu_observability_optimizer(ir)
+        objs = convert_objects(ir, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+    [pr] = [o for o in objs if o.get("kind") == "PrometheusRule"]
+    assert pr["apiVersion"] == "monitoring.coreos.com/v1"
+    assert pr["metadata"]["name"] == "trainer-alerts"
+    assert pr["metadata"]["labels"]["move2kube-tpu.io/service"] == "trainer"
+    [group] = pr["spec"]["groups"]
+    alerts = {r["alert"]: r for r in group["rules"]}
+    assert set(alerts) == {"M2KTGoodputLow", "M2KTStepTimeP95Regression",
+                           "M2KTRestartStorm"}  # trainer: no serving rule
+    # k8s output bakes the literal defaults into the PromQL
+    assert "< 0.5" in alerts["M2KTGoodputLow"]["expr"]
+    assert "> 1.5 *" in alerts["M2KTStepTimeP95Regression"]["expr"]
+    assert "> 3" in alerts["M2KTRestartStorm"]["expr"]
+    # selector uses the relabeled (sanitized) pod label
+    assert 'move2kube-tpu_io_service="trainer"' in \
+        alerts["M2KTGoodputLow"]["expr"]
+
+    [cm] = [o for o in objs if o.get("kind") == "ConfigMap"
+            and "dashboard" in o["metadata"]["name"]]
+    assert cm["metadata"]["name"] == "trainer-dashboard"
+    assert cm["metadata"]["labels"]["grafana_dashboard"] == "1"
+    dash = json.loads(cm["data"]["trainer-dashboard.json"])
+    assert dash["uid"] == "m2kt-trainer"
+    titles = {p["title"] for p in dash["panels"]}
+    assert "Goodput fraction" in titles
+    assert "Straggler score by host" in titles
+
+
+def test_rules_gated_on_metrics_port():
+    """Telemetry off (port 0) means nothing to alert on: the knob being
+    on must not emit rules for an unscrapable workload."""
+    ir, _ = _accel_service()
+    _qa({"m2kt.services.trainer.obs.port": "0",
+         "m2kt.services.trainer.obs.rules": True})
+    try:
+        ir = tpu_observability_optimizer(ir)
+        objs = convert_objects(ir, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+    assert not [o for o in objs if o.get("kind") == "PrometheusRule"]
+
+
+def test_knative_rules_serving_alerts_and_probe():
+    """Serving target on Knative: the rule set adds the queue-depth
+    alert, selectors use the revision's ``app`` pod label, and the
+    container carries a readiness probe on the traffic port (knative
+    rejects probes naming other ports; /healthz 503s until warm there)."""
+    ir, svc = _accel_service(name="srv", serving=True)
+    svc.containers[0]["ports"] = [{"containerPort": 8000}]
+    _qa({"m2kt.services.srv.obs.rules": True})
+    try:
+        ir = tpu_observability_optimizer(ir)
+        objs = convert_objects(ir, [KnativeServiceAPIResource(create=True)])
+    finally:
+        qaengine.reset_engines()
+    [pr] = [o for o in objs if o.get("kind") == "PrometheusRule"]
+    alerts = {r["alert"]: r for r in pr["spec"]["groups"][0]["rules"]}
+    assert "M2KTServeQueueDeep" in alerts
+    assert 'app="srv"' in alerts["M2KTServeQueueDeep"]["expr"]
+    assert "> 64" in alerts["M2KTServeQueueDeep"]["expr"]
+    [cm] = [o for o in objs if o.get("kind") == "ConfigMap"]
+    dash = json.loads(cm["data"]["srv-dashboard.json"])
+    assert "Serving queue depth" in {p["title"] for p in dash["panels"]}
+
+    [ksvc] = [o for o in objs if o.get("kind") == "Service"]
+    c = ksvc["spec"]["template"]["spec"]["containers"][0]
+    assert c["readinessProbe"] == {"httpGet": {"path": "/healthz"}}
+
+
+def test_readiness_probe_on_serving_deployment_not_trainer():
+    from move2kube_tpu.apiresource.obs_wiring import readiness_probe
+
+    # serving Deployment: /readyz on the telemetry port
+    ir, svc = _accel_service(name="srv", serving=True)
+    _qa()
+    try:
+        ir = tpu_observability_optimizer(ir)
+        objs = convert_objects(ir, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+    [dep] = [o for o in objs if o.get("kind") == "Deployment"]
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["readinessProbe"]["httpGet"] == {"path": "/readyz",
+                                              "port": 9090}
+    assert c["readinessProbe"]["failureThreshold"] == 6
+
+    # trainer: no readiness gate (a JobSet pod "not ready" means nothing
+    # to a headless training workload) — helper answers None directly
+    ir2, svc2 = _accel_service()
+    _qa()
+    try:
+        ir2 = tpu_observability_optimizer(ir2)
+        assert readiness_probe(svc2) is None
+        objs2 = convert_objects(ir2, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+    [js] = [o for o in objs2 if o.get("kind") == "JobSet"]
+    pod = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]
+    for cont in pod["spec"]["containers"]:
+        assert "readinessProbe" not in cont
+
+
+def test_rules_helm_parameterization_roundtrip():
+    """Helm mode: the parameterizer seeds the threshold defaults into
+    chart values, emission detects the seeded keys and bakes
+    ``{{ .Values.<key> }}`` refs into the PromQL — a --set retunes alert
+    floors without touching manifests."""
+    from move2kube_tpu.obs.rules import THRESHOLDS
+    from move2kube_tpu.passes.parameterize import tpu_rules_parameterizer
+
+    ir, _ = _accel_service()
+    _qa({"m2kt.services.trainer.obs.rules": True})
+    try:
+        ir = tpu_observability_optimizer(ir)
+        ir = tpu_obs_parameterizer(ir)
+        ir = tpu_rules_parameterizer(ir)
+        assert {k: ir.values.global_variables[k] for k in THRESHOLDS} \
+            == THRESHOLDS
+        objs = convert_objects(ir, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+    [pr] = [o for o in objs if o.get("kind") == "PrometheusRule"]
+    alerts = {r["alert"]: r for r in pr["spec"]["groups"][0]["rules"]}
+    assert "< {{ .Values.tpugoodputmin }}" in \
+        alerts["M2KTGoodputLow"]["expr"]
+    assert "> {{ .Values.tpustepp95factor }} *" in \
+        alerts["M2KTStepTimeP95Regression"]["expr"]
+    assert "> {{ .Values.tpurestartstormcount }}" in \
+        alerts["M2KTRestartStorm"]["expr"]
+
+
+def test_rules_parameterizer_noop_when_knob_off():
+    from move2kube_tpu.obs.rules import THRESHOLDS
+    from move2kube_tpu.passes.parameterize import tpu_rules_parameterizer
+
+    ir, _ = _accel_service()
+    _qa()
+    try:
+        ir = tpu_observability_optimizer(ir)
+        ir = tpu_rules_parameterizer(ir)
+    finally:
+        qaengine.reset_engines()
+    assert not any(k in ir.values.global_variables for k in THRESHOLDS)

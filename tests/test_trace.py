@@ -26,6 +26,22 @@ def test_spans_nest_and_roll_up():
     assert doc["counters"] == {"things": 3}
 
 
+def test_span_ring_bounded_but_totals_exact():
+    """The raw event list is a bounded ring (a long-lived process must
+    not grow one entry per call), while the per-name totals accumulate
+    forever — eviction changes memory, never the to_dict() sums."""
+    trace.reset()
+    rec = trace.get()
+    n = trace.SPAN_RING_MAX + 500
+    for _ in range(n):
+        rec.add_span("hot", 0.001)
+    assert len(rec.spans) == trace.SPAN_RING_MAX
+    doc = rec.to_dict()
+    # output shape unchanged: one rolled-up number per name
+    assert set(doc) == {"wall_seconds", "spans", "counters"}
+    assert doc["spans"]["hot"] == round(n * 0.001, 6)
+
+
 def test_write_metrics(tmp_path):
     trace.reset()
     with trace.span("stage"):

@@ -1,0 +1,406 @@
+"""Runtime tracing plane: span-ring semantics, Chrome/OTLP export
+well-formedness, the serving engine's per-request TTFT decomposition,
+straggler scoring, and the crash flight recorder exercised end-to-end by
+the forced-host 2-slice slice-loss drill (``make trace-smoke``)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from move2kube_tpu.obs import tracing
+from move2kube_tpu.obs.bridge import StragglerDetector
+from move2kube_tpu.obs.metrics import Registry
+from move2kube_tpu.obs.tracing import SpanRecorder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# recorder semantics
+# ----------------------------------------------------------------------
+
+def test_span_ids_and_context_nesting():
+    rec = SpanRecorder()
+    with rec.span("outer") as outer:
+        assert re.fullmatch(r"[0-9a-f]{32}", outer.trace_id)
+        assert re.fullmatch(r"[0-9a-f]{16}", outer.span_id)
+        assert outer.parent_id == ""
+        assert rec.current() is outer
+        with rec.span("inner") as inner:
+            # nested spans inherit identity through the contextvar
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            assert rec.current() is inner
+        assert rec.current() is outer
+    assert rec.current() is None
+    snap = rec.snapshot()
+    assert [s["name"] for s in snap] == ["inner", "outer"]  # end order
+    assert all(not s["in_flight"] and s["dur_s"] >= 0 for s in snap)
+
+
+def test_detached_spans_do_not_chain():
+    """The serving engine interleaves many live request traces in one
+    thread: detached roots must neither inherit nor become the current
+    context span."""
+    rec = SpanRecorder()
+    with rec.span("step"):
+        a = rec.start("req-a", detached=True)
+        b = rec.start("req-b", detached=True)
+        assert a.parent_id == "" and b.parent_id == ""
+        assert a.trace_id != b.trace_id
+        assert rec.current().name == "step"
+    rec.end(a)
+    rec.end(b)
+
+
+def test_ring_bounded_by_max_spans():
+    rec = SpanRecorder(ring_seconds=3600.0, max_spans=16)
+    now = time.perf_counter()
+    for i in range(100):
+        rec.record(f"s{i}", now, now)
+    snap = rec.snapshot()
+    assert len(snap) == 16
+    assert rec.dropped == 84
+    assert snap[0]["name"] == "s84"  # oldest survivors evicted first
+
+
+def test_ring_evicts_by_time_horizon():
+    rec = SpanRecorder(ring_seconds=0.5)
+    now = time.perf_counter()
+    rec.record("old", now - 10.0, now - 9.0)  # ended far past the window
+    rec.record("fresh", now - 0.01, now)
+    names = [s["name"] for s in rec.snapshot()]
+    assert names == ["fresh"]
+    assert rec.dropped == 1
+
+
+def test_in_flight_spans_appear_in_snapshot():
+    rec = SpanRecorder()
+    s = rec.start("hung")
+    snap = rec.snapshot()
+    assert snap[-1]["name"] == "hung"
+    assert snap[-1]["in_flight"]
+    assert snap[-1]["dur_s"] >= 0
+    rec.end(s)
+
+
+def test_record_preserves_exact_endpoints():
+    rec = SpanRecorder()
+    t0 = time.perf_counter()
+    t1 = t0 + 0.125
+    span = rec.record("exact", t0, t1, attrs={"step": 3})
+    assert span.t0 == t0 and span.t1 == t1
+    [snap] = rec.snapshot()
+    assert snap["dur_s"] == pytest.approx(0.125, abs=1e-9)
+    assert snap["attrs"] == {"step": 3}
+
+
+def test_env_knobs(monkeypatch, tmp_path):
+    monkeypatch.setenv("M2KT_TRACE", "0")
+    assert not tracing.enabled()
+    monkeypatch.setenv("M2KT_TRACE", "off")
+    assert not tracing.enabled()
+    monkeypatch.delenv("M2KT_TRACE", raising=False)
+    assert tracing.enabled()  # default ON
+    monkeypatch.setenv("M2KT_TRACE_RING_SECONDS", "7.5")
+    assert tracing.ring_seconds() == 7.5
+    monkeypatch.setenv("M2KT_TRACE_RING_SECONDS", "garbage")
+    assert tracing.ring_seconds() == tracing.DEFAULT_RING_SECONDS
+    monkeypatch.setenv("M2KT_FLIGHT_PATH", str(tmp_path / "f.json"))
+    assert tracing.flight_path() == str(tmp_path / "f.json")
+    assert tracing.ring_path() == str(tmp_path / "f.json") + ".ring"
+    monkeypatch.delenv("M2KT_FLIGHT_PATH", raising=False)
+    monkeypatch.setenv("M2KT_METRICS_DIR", str(tmp_path))
+    assert tracing.flight_path() == str(tmp_path / "m2kt-flight.json")
+
+
+# ----------------------------------------------------------------------
+# exports
+# ----------------------------------------------------------------------
+
+def _populated_recorder() -> SpanRecorder:
+    rec = SpanRecorder(slice_id=1)
+    time.sleep(0.002)  # spans start measurably after the clock anchor
+    with rec.span("train.step", attrs={"step": 1, "loss": 2.5}):
+        with rec.span("ckpt.save_submit", attrs={"async": True}):
+            pass
+    return rec
+
+
+def test_chrome_trace_well_formed():
+    rec = _populated_recorder()
+    doc = json.loads(json.dumps(rec.chrome_trace()))  # JSON round-trip
+    events = doc["traceEvents"]
+    assert len(events) == 2
+    for ev in events:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        assert ev["tid"] == 1  # slice id
+        assert ev["cat"] == "m2kt"
+        assert re.fullmatch(r"[0-9a-f]{32}", ev["args"]["trace_id"])
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"]["slice_id"] == 1
+    # parent/child linkage survives the export
+    by_name = {e["name"]: e for e in events}
+    assert (by_name["ckpt.save_submit"]["args"]["parent_id"]
+            == by_name["train.step"]["args"]["span_id"])
+
+
+def test_otlp_lines_parse_and_carry_resource():
+    rec = _populated_recorder()
+    lines = rec.otlp_lines()
+    assert len(lines) == 2
+    for line in lines:
+        doc = json.loads(line)
+        [rs] = doc["resourceSpans"]
+        keys = {a["key"] for a in rs["resource"]["attributes"]}
+        assert {"host.name", "m2kt.slice_id", "service.name"} <= keys
+        [span] = rs["scopeSpans"][0]["spans"]
+        assert int(span["endTimeUnixNano"]) >= int(span["startTimeUnixNano"])
+    # typed attributes: int step, double loss, bool async
+    merged = "\n".join(lines)
+    assert '"intValue":"1"' in merged
+    assert '"doubleValue":2.5' in merged
+    assert '"boolValue":true' in merged
+
+
+def test_flush_ring_atomic_dump(tmp_path):
+    rec = _populated_recorder()
+    path = str(tmp_path / "sub" / "ring.json")
+    assert rec.flush_ring(path) == path
+    doc = json.loads(open(path, encoding="utf-8").read())
+    assert doc["slice_id"] == 1
+    assert doc["pid"] == os.getpid()
+    assert doc["ring_seconds"] == rec.ring_seconds
+    assert [s["name"] for s in doc["spans"]] == ["ckpt.save_submit",
+                                                 "train.step"]
+
+
+# ----------------------------------------------------------------------
+# serving: per-request trace decomposes the TTFT histogram sample
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_llama_parts():
+    import jax
+    import jax.numpy as jnp
+
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+def test_engine_request_trace_decomposes_ttft(tiny_llama_parts):
+    """One trace per request: queue_wait + prefill spans must sum to the
+    exact TTFT the engine's histogram observed (same clock readings close
+    both), and decode steps/complete hang off the same trace id."""
+    from move2kube_tpu.serving.engine import (
+        EngineConfig, Request, ServingEngine)
+
+    model, variables = tiny_llama_parts
+    tracer = SpanRecorder()
+    eng = ServingEngine(
+        model, variables,
+        EngineConfig(max_batch=2, max_seq=64, block_size=8, buckets=(8,)),
+        registry=Registry(), tracer=tracer)
+    comps = eng.run([Request("r0", [5, 9, 12], 3)])
+    assert len(comps) == 1 and len(comps[0].tokens) == 3
+
+    by_name = {}
+    for s in tracer.snapshot():
+        by_name.setdefault(s["name"], []).append(s)
+    [root] = by_name["serve.request"]
+    [queue] = by_name["serve.queue_wait"]
+    [prefill] = by_name["serve.prefill"]
+    decodes = by_name["serve.decode_step"]
+    # single trace: every span carries the request's trace id, children
+    # point at the root
+    for s in [queue, prefill] + decodes:
+        assert s["trace_id"] == root["trace_id"]
+        assert s["parent_id"] == root["span_id"]
+    assert prefill["attrs"]["bucket"] == 8
+    assert root["attrs"]["finish_reason"] == "length"
+    assert root["attrs"]["tokens"] == 3
+    # prefill emits the first token; each decode step appends one
+    assert len(decodes) == 2
+
+    # the acceptance bound is 1ms; construction makes it exact, so assert
+    # far tighter than the criterion
+    ttft_hist = eng._ttft_hist
+    assert ttft_hist.count == 1
+    decomposed = queue["dur_s"] + prefill["dur_s"]
+    assert decomposed == pytest.approx(ttft_hist.sum, abs=1e-6)
+    assert root["attrs"]["ttft_s"] == pytest.approx(ttft_hist.sum, abs=1e-9)
+
+
+def test_engine_without_tracer_records_nothing(tiny_llama_parts,
+                                               monkeypatch):
+    from move2kube_tpu.serving.engine import (
+        EngineConfig, Request, ServingEngine)
+
+    monkeypatch.setenv("M2KT_TRACE", "0")
+    model, variables = tiny_llama_parts
+    eng = ServingEngine(
+        model, variables,
+        EngineConfig(max_batch=2, max_seq=64, block_size=8, buckets=(8,)),
+        registry=Registry())
+    assert eng.tracer is None
+    comps = eng.run([Request("r0", [5, 9], 2)])
+    assert len(comps) == 1  # tracing off is purely observational
+
+
+# ----------------------------------------------------------------------
+# straggler detection
+# ----------------------------------------------------------------------
+
+def test_straggler_scores_and_hysteresis():
+    reg = Registry()
+    det = StragglerDetector(registry=reg, threshold=1.5, window=8)
+    # 3 healthy hosts + one 2x straggler
+    for step in range(8):
+        for h in ("h0", "h1", "h2"):
+            det.report(h, step, 0.10)
+        det.report("h3", step, 0.20)
+    scores = det.scores()
+    assert scores["h0"] == pytest.approx(1.0)
+    assert scores["h3"] == pytest.approx(2.0)
+    # one event per excursion, not one per step
+    assert det.events == 1
+    # recovery re-arms: dilute the window back under the threshold...
+    for step in range(8, 16):
+        for h in ("h0", "h1", "h2", "h3"):
+            det.report(h, step, 0.10)
+    assert det.scores()["h3"] == pytest.approx(1.0)
+    # ...then a second excursion fires a second event
+    for step in range(16, 24):
+        for h in ("h0", "h1", "h2"):
+            det.report(h, step, 0.10)
+        det.report("h3", step, 0.30)
+    assert det.events == 2
+    # scores surface in the exposition for the PrometheusRule to alert on
+    text = reg.render()
+    assert 'm2kt_straggler_score{host="h3"}' in text
+    assert 'm2kt_straggler_events_total{host="h3"} 2' in text
+
+
+def test_straggler_single_host_is_baseline():
+    det = StragglerDetector(registry=Registry())
+    for step in range(4):
+        det.report("only", step, 0.5)
+    assert det.scores()["only"] == pytest.approx(1.0)
+    assert det.events == 0
+
+
+# ----------------------------------------------------------------------
+# the drill: slice loss must leave a flight recording
+# ----------------------------------------------------------------------
+
+def _run_supervised(workdir, extra: dict) -> subprocess.CompletedProcess:
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu", **extra)
+    for leak in ("M2KT_METRICS_DIR", "M2KT_FAULT_STEP", "M2KT_FAULT_KIND",
+                 "M2KT_FAULT_MARKER", "M2KT_ELASTIC", "M2KT_NUM_SLICES",
+                 "M2KT_FORCE_DEVICES", "M2KT_BATCH_PER_DEVICE",
+                 "M2KT_TRACE", "M2KT_FLIGHT_PATH",
+                 "M2KT_TRACE_RING_SECONDS"):
+        if leak not in extra:
+            env.pop(leak, None)
+    return subprocess.run(
+        [sys.executable, "-m", "move2kube_tpu.resilience.supervisor", "--",
+         sys.executable, "-m", "move2kube_tpu.resilience.minitrain"],
+        env=env, cwd=str(workdir), capture_output=True, text=True,
+        timeout=600)
+
+
+def test_slice_loss_drill_writes_flight_recording(tmp_path):
+    """The 2-slice forced-host drill with ``slice_loss`` injected at step
+    4: the dying child flushes its span ring on the exit-83 teardown
+    path, the supervisor folds it into ``m2kt-flight.json`` with the
+    slice-lost classification and the goodput ledger — and the elastic
+    re-plan still finishes the run (the flight records the dead attempt,
+    not the pod's final state)."""
+    flight = tmp_path / "m2kt-flight.json"
+    res = _run_supervised(tmp_path, dict(
+        M2KT_STEPS="6",
+        M2KT_CKPT_EVERY="2",
+        M2KT_RETRY_BACKOFF_S="0.1",
+        M2KT_CKPT_DIR=str(tmp_path / "ckpt"),
+        M2KT_FORCE_DEVICES="8",
+        M2KT_NUM_SLICES="2",
+        M2KT_BATCH_PER_DEVICE="2",
+        M2KT_ELASTIC="1",
+        M2KT_FAULT_STEP="4",
+        M2KT_FAULT_KIND="slice_loss",
+        M2KT_FAULT_MARKER=str(tmp_path / "fault-fired"),
+        M2KT_EXIT_FILE=str(tmp_path / "exit.json"),
+        M2KT_GOODPUT_FILE=str(tmp_path / "goodput.json"),
+        M2KT_FLIGHT_PATH=str(flight),
+    ))
+    assert res.returncode == 0, res.stderr
+    assert "done steps=6" in res.stdout
+    # straggler scoring ran on the per-step reports
+    assert "straggler: hosts=" in res.stdout
+
+    doc = json.loads(flight.read_text())
+    assert doc["exit_class"] == "slice_lost"
+    assert doc["returncode"] == 83
+    assert doc["attempt"] == 1
+    # the dead attempt's ledger rode along
+    assert doc["goodput"].get("steps_done", 0) >= 1
+    # the child's ring was flushed on the sys.exit(83) teardown path and
+    # carries the spans of the final completed step before the loss
+    assert doc["ring"]["pid"]
+    steps = [s["attrs"].get("step") for s in doc["spans"]
+             if s["name"] == "train.step"]
+    assert steps, doc["spans"]
+    assert max(steps) == 3  # fault fires at step 4, before its step runs
+    # every span in the flight is export-grade: ids + timing present
+    for s in doc["spans"]:
+        assert re.fullmatch(r"[0-9a-f]{32}", s["trace_id"])
+        assert s["dur_s"] >= 0 and s["ts_unix"] > 0
+    # the .ring file next to the flight is the *latest* flush — the
+    # surviving attempt overwrote the dead one's at its own clean exit —
+    # but it must always be a well-formed dump
+    ring = json.loads((tmp_path / "m2kt-flight.json.ring").read_text())
+    assert isinstance(ring["spans"], list) and ring["spans"]
+    assert ring["pid"] and ring["ring_seconds"] > 0
+
+
+def test_trace_disabled_drill_writes_flight_without_spans(tmp_path):
+    """M2KT_TRACE=0: no ring, but the flight recorder still captures the
+    classification + ledger (observability off must not cost the
+    postmortem everything)."""
+    flight = tmp_path / "m2kt-flight.json"
+    res = _run_supervised(tmp_path, dict(
+        M2KT_STEPS="4",
+        M2KT_CKPT_EVERY="2",
+        M2KT_RETRY_BACKOFF_S="0.1",
+        M2KT_CKPT_DIR=str(tmp_path / "ckpt"),
+        M2KT_FORCE_DEVICES="4",
+        M2KT_FAULT_STEP="3",
+        M2KT_FAULT_KIND="raise",
+        M2KT_FAULT_MARKER=str(tmp_path / "fault-fired"),
+        M2KT_EXIT_FILE=str(tmp_path / "exit.json"),
+        M2KT_GOODPUT_FILE=str(tmp_path / "goodput.json"),
+        M2KT_FLIGHT_PATH=str(flight),
+        M2KT_TRACE="0",
+    ))
+    assert res.returncode == 0, res.stderr  # crash is retryable
+    doc = json.loads(flight.read_text())
+    assert doc["exit_class"] == "retryable"
+    assert doc["spans"] == []
+    assert doc["ring"] == {}
+    assert doc["goodput"].get("steps_done", 0) >= 1
