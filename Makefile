@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -111,6 +111,21 @@ elastic-smoke:
 # step's spans + the slice-lost classification)
 trace-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_tracing.py -q
+
+# compiled-program cost model in isolation (all CPU-mode): backend
+# fallback tolerance, chip-spec aliasing, roofline/MFU math, plan-report
+# round-trip; then the forced-host dryrun must land m2kt-plan-report.json
+# with predicted HBM inside the documented 4.0x tolerance of the
+# compiled memory_analysis footprint
+mfu-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_costmodel.py -q
+	rm -rf /tmp/m2kt-mfu-smoke && mkdir -p /tmp/m2kt-mfu-smoke
+	$(CPU_ENV) M2KT_PLAN_REPORT=/tmp/m2kt-mfu-smoke $(PY) -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
+	import json, __graft_entry__ as g; g.dryrun_multichip(8); \
+	doc = json.load(open('/tmp/m2kt-mfu-smoke/m2kt-plan-report.json')); \
+	assert doc['verdict'] == 'fit', doc['verdict']; \
+	assert doc['drift']['within_tolerance'], doc['drift']; \
+	print('[mfu-smoke] drift %.2fx, mfu ceiling %s' % (doc['drift']['predicted_over_measured'], doc['estimated_mfu']['roofline_ceiling']))"
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

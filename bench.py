@@ -415,6 +415,7 @@ def bench_llama(n: int) -> dict:
                 + c.num_layers * per_layer)
 
     flops_per_token = 6 * n_params(cfg)
+    cost_holder: dict = {}
 
     def measure_at(batch: int):
         ids0 = jnp.zeros((batch, LLAMA_SEQ), jnp.int32)
@@ -438,6 +439,11 @@ def bench_llama(n: int) -> dict:
                 break
             print(f"[bench] llama warmup call {i}: {dt:.1f}s",
                   file=sys.stderr)
+        # compiled-program cost model (analyze_step_fn is exception-safe
+        # and returns None when the backend exposes no cost analysis)
+        from move2kube_tpu.obs import costmodel
+        cost_holder["report"] = costmodel.analyze_step_fn(
+            step, state, batch_data)
         t0 = time.perf_counter()
         for _ in range(MEASURE_CALLS):
             state, loss = step(state, batch_data)
@@ -451,6 +457,17 @@ def bench_llama(n: int) -> dict:
                                                 min_batch=1, phase="llama")
     mfu = tok_s * flops_per_token / V5E_PEAK_BF16_FLOPS
     print(f"[bench] llama loss={loss:.3f} mfu={mfu:.1%}", file=sys.stderr)
+    # the measured counterpart of the analytic 6*N*T mfu above: XLA's own
+    # per-step flop count over the measured step time, plus the compiled
+    # peak-HBM footprint. Null on backends without cost analysis.
+    from move2kube_tpu.obs import costmodel
+    train_mfu = train_hbm = None
+    report = cost_holder.get("report")
+    if report is not None:
+        spec, _ = costmodel.chip_spec(
+            os.environ.get(costmodel.ACCELERATOR_ENV, ""))
+        train_mfu = report.mfu(batch * LLAMA_SEQ / tok_s, spec)
+        train_hbm = report.peak_hbm_bytes
     metric, unit = PHASE_METRICS["llama"]
     anchor = V5E_PEAK_BF16_FLOPS * ANCHOR_MFU / flops_per_token
     return {
@@ -459,6 +476,8 @@ def bench_llama(n: int) -> dict:
         "value": round(tok_s, 1),
         "unit": unit,
         "mfu": round(mfu, 4),
+        "train_mfu": round(train_mfu, 6) if train_mfu is not None else None,
+        "train_hbm_peak_bytes": train_hbm,
         "batch": batch,
         "seq_len": LLAMA_SEQ,
         "vs_baseline": round(tok_s / anchor, 3),

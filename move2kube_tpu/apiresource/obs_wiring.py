@@ -90,6 +90,26 @@ def rules_enabled(svc_name: str) -> bool:
         False)
 
 
+def plan_report_enabled(svc_name: str) -> bool:
+    """The ``m2kt.services.<name>.obs.planreport`` QA knob: should the
+    emitted trainer write the preflight fit report
+    (``m2kt-plan-report.{json,md}`` — obs/costmodel.py) on startup?
+    Asked here so the optimizer baking ``M2KT_PLAN_REPORT`` and any
+    future emitter surfacing the artifact share one cached answer."""
+    from move2kube_tpu import qa
+    from move2kube_tpu.utils import common
+
+    name = common.make_dns_label(svc_name)
+    return qa.fetch_bool(
+        f"m2kt.services.{name}.obs.planreport",
+        f"Write a preflight HBM-fit/MFU plan report for [{name}]?",
+        ["m2kt-plan-report.{json,md} into M2KT_METRICS_DIR at startup: "
+         "predicted HBM plan vs the compiled step's memory_analysis, fit "
+         "verdict, roofline/MFU estimate, and an fsdp re-split suggestion "
+         "when over budget"],
+        False)
+
+
 def maybe_rules_objects(svc: Service, ir: IR,
                         selector_label: str) -> list[dict]:
     """PrometheusRule + Grafana dashboard ConfigMap next to the

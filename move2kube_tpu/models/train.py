@@ -582,6 +582,30 @@ class StepTelemetry:
         self._compile_seconds = reg.counter(
             "m2kt_train_compile_seconds_total",
             "Wall seconds spent in observed compile events")
+        # filled by record_cost_model; record_step then keeps the MFU
+        # gauge live from measured wall times
+        self._cost_report = None
+        self._chip_spec = None
+
+    def record_cost_model(self, step_fn, *args,
+                          accelerator: str = "") -> None:
+        """AOT-introspect the compiled train step (obs/costmodel.py) and
+        export the static cost gauges — step FLOPs, roofline class,
+        peak-HBM breakdown. Call once after the first step has compiled;
+        subsequent :meth:`record_step` calls derive live MFU from it.
+        Best-effort: a non-jitted step or an introspection failure is
+        recorded as absent, never raised."""
+        from move2kube_tpu.obs import costmodel
+        try:
+            report = costmodel.analyze_step_fn(step_fn, *args)
+        except Exception:  # noqa: BLE001 - accounting must never kill a run
+            report = None
+        if report is None:
+            return
+        self._cost_report = report
+        self._chip_spec, _ = costmodel.chip_spec(accelerator)
+        costmodel.export_train_gauges(
+            report, self.registry, accelerator=accelerator)
 
     def record_compile(self, seconds: float) -> None:
         self._compiles.inc()
@@ -617,6 +641,14 @@ class StepTelemetry:
             norm = grad_norm_from_state(state)
             if norm is not None:
                 self._grad_norm.set(norm)
+        if (self._cost_report is not None and self._chip_spec is not None
+                and seconds > 0):
+            mfu = self._cost_report.mfu(seconds, self._chip_spec)
+            if mfu is not None:
+                self.registry.gauge(
+                    "m2kt_train_mfu",
+                    "Achieved model-FLOP utilization per chip "
+                    "(0 = unknown)").set(mfu)
         if step % self.mem_every == 0:
             self.record_device_memory()
 

@@ -15,6 +15,11 @@ straggler scoring (:class:`bridge.StragglerDetector`), and the alert/
 dashboard manifest builders the emitters attach to workloads
 (:mod:`rules`).
 
+PR 8 adds the compiled-program cost model (:mod:`costmodel`): MFU and
+roofline accounting from ``cost_analysis``/``memory_analysis``, the
+preflight plan report (``m2kt-plan-report.{json,md}``), and the OOM
+memory-snapshot sidecar the flight recorder folds in.
+
 Stdlib-only on import (jax is loaded lazily, only for profiling and
 device-memory reads) so the whole package vendors into emitted images.
 """
@@ -25,6 +30,22 @@ from move2kube_tpu.obs.bridge import (
     install_trace_hook,
     mirror_goodput,
     mirror_trace,
+)
+from move2kube_tpu.obs.costmodel import (
+    CHIP_SPECS,
+    ChipSpec,
+    CostReport,
+    analyze_compiled,
+    analyze_step_fn,
+    build_plan_report,
+    chip_spec,
+    export_drift_gauge,
+    export_serving_gauges,
+    export_train_gauges,
+    install_memory_snapshot,
+    normalize_accelerator,
+    write_memory_snapshot,
+    write_plan_report,
 )
 from move2kube_tpu.obs.metrics import (
     Counter,
@@ -71,4 +92,18 @@ __all__ = [
     "get_tracer",
     "tracing_enabled",
     "install_ring_flush",
+    "CHIP_SPECS",
+    "ChipSpec",
+    "CostReport",
+    "analyze_compiled",
+    "analyze_step_fn",
+    "build_plan_report",
+    "chip_spec",
+    "export_drift_gauge",
+    "export_serving_gauges",
+    "export_train_gauges",
+    "install_memory_snapshot",
+    "normalize_accelerator",
+    "write_memory_snapshot",
+    "write_plan_report",
 ]

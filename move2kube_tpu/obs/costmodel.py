@@ -1,0 +1,791 @@
+"""Compiled-program cost model: MFU/roofline accounting + HBM forensics.
+
+The telemetry plane (PR 5) reports what the runtime *did* (step times,
+throughput) and the memory planner (``parallel/memory.py``) predicts what
+a run *should* need — but nothing connected either to what XLA actually
+compiled. This module is that connection: it introspects a compiled
+executable through ``compiled.cost_analysis()`` /
+``compiled.memory_analysis()`` and derives the numbers every TPU
+training/serving stack is judged on:
+
+* **step FLOPs and bytes accessed** — straight from the cost analysis of
+  the per-device program;
+* **arithmetic intensity + roofline class** — FLOPs/byte against the
+  chip's ridge point (peak FLOP/s ÷ HBM bandwidth): below the ridge the
+  program is bandwidth-bound and no kernel tuning will reach peak FLOPs;
+* **achieved MFU** — (FLOPs / measured step seconds) ÷ peak chip FLOP/s;
+* **peak-HBM breakdown** — argument / output / temp / generated-code
+  bytes of the executable, the numbers an OOM postmortem needs.
+
+Exported as gauges (``m2kt_train_mfu``, ``m2kt_hbm_peak_bytes{category}``,
+``m2kt_roofline_bound``) through the existing registry, and folded into
+two artifacts: the **preflight plan report** (``m2kt-plan-report.{json,md}``
+— MemoryPlan prediction vs fit budget vs the measured memory_analysis of
+the same compiled step, with the next fsdp re-split suggested when over
+budget) and the **crash flight recorder** (a ``<flight>.mem`` sidecar the
+supervisor folds into ``m2kt-flight.json`` on retryable/fatal deaths).
+
+Graceful degradation is the contract: backends return ``None``, empty
+dicts, lists of dicts (CPU), objects (TPU/CPU ``CompiledMemoryStats``) or
+partial key sets depending on version — every accessor here tolerates
+all of them and produces a degraded-but-valid report, never an exception.
+
+Stdlib-only on import (jax and the parallel planner are loaded lazily)
+so the module vendors into emitted images with the rest of ``obs/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from move2kube_tpu.obs import tracing
+
+PLAN_REPORT_ENV = "M2KT_PLAN_REPORT"
+PLAN_REPORT_STRICT_ENV = "M2KT_PLAN_REPORT_STRICT"
+ACCELERATOR_ENV = "M2KT_TPU_ACCELERATOR"
+
+# predicted-vs-measured HBM tolerance, documented in docs/ARCHITECTURE.md:
+# the analytic plan (remat activation model, fp32 master assumption) and
+# XLA's buffer assignment agree within 4x either way on the seed models;
+# drift beyond that factor means the memory model needs recalibrating and
+# fails the mfu-smoke golden assert.
+PLAN_DRIFT_TOLERANCE_FACTOR = 4.0
+
+# roofline classes, also the value of the m2kt_roofline_bound gauge
+COMPUTE_BOUND = 1.0
+BANDWIDTH_BOUND = 0.0
+UNKNOWN_BOUND = -1.0
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """Per-chip peak numbers for one TPU generation (public specs)."""
+
+    name: str
+    peak_bf16_flops: float
+    peak_int8_flops: float
+    hbm_bytes: float
+    hbm_bandwidth: float  # bytes/s
+
+    @property
+    def ridge_flops_per_byte(self) -> float:
+        """Arithmetic intensity at which the roofline's bandwidth and
+        compute ceilings meet; programs below it are bandwidth-bound."""
+        return self.peak_bf16_flops / self.hbm_bandwidth
+
+
+# keyed on the GKE nodeSelector accelerator strings (the same keys as
+# parallel/memory.HBM_BYTES — gpu_detect.py owns the mapping to them)
+CHIP_SPECS = {
+    "tpu-v4-podslice": ChipSpec(
+        "v4", peak_bf16_flops=275e12, peak_int8_flops=275e12,
+        hbm_bytes=32e9, hbm_bandwidth=1228e9),
+    "tpu-v5-lite-podslice": ChipSpec(
+        "v5e", peak_bf16_flops=197e12, peak_int8_flops=394e12,
+        hbm_bytes=16e9, hbm_bandwidth=819e9),
+    "tpu-v5p-slice": ChipSpec(
+        "v5p", peak_bf16_flops=459e12, peak_int8_flops=918e12,
+        hbm_bytes=95e9, hbm_bandwidth=2765e9),
+    "tpu-v6e-slice": ChipSpec(
+        "v6e", peak_bf16_flops=918e12, peak_int8_flops=1836e12,
+        hbm_bytes=32e9, hbm_bandwidth=1640e9),
+}
+
+# v5e is the conservative default for unknown accelerators — the same
+# budget-like-v5e convention as topology._DEFAULT_HBM
+DEFAULT_CHIP = "tpu-v5-lite-podslice"
+
+# alias -> canonical nodeSelector string; matched on the lowercased
+# accelerator with separators stripped (so "TPU v5e", "v5litepod-8" and
+# "tpu-v5-lite-device" all land on the v5e row)
+_ALIASES = {
+    "v4": "tpu-v4-podslice",
+    "tpuv4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5lite": "tpu-v5-lite-podslice",
+    "v5litepod": "tpu-v5-lite-podslice",
+    "tpuv5e": "tpu-v5-lite-podslice",
+    "tpuv5lite": "tpu-v5-lite-podslice",
+    "tpuv5litedevice": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "tpuv5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+    "tpuv6e": "tpu-v6e-slice",
+    "trillium": "tpu-v6e-slice",
+}
+
+
+def normalize_accelerator(accelerator: str) -> str | None:
+    """Canonical CHIP_SPECS/HBM_BYTES key for an accelerator string, or
+    None when nothing matches (callers pick their own conservative
+    fallback — :func:`chip_spec` here, the v5e budget in ``memory.py``)."""
+    raw = str(accelerator or "").strip().lower()
+    if not raw:
+        return None
+    if raw in CHIP_SPECS:
+        return raw
+    squashed = "".join(c for c in raw if c.isalnum())
+    # strip a trailing chip/pod count ("v5litepod8", "v5e4")
+    base = squashed.rstrip("0123456789")
+    for key in (squashed, base):
+        if key in _ALIASES:
+            return _ALIASES[key]
+    for key, canon in _ALIASES.items():
+        if key in squashed and len(key) >= 3:
+            return canon
+    return None
+
+
+def chip_spec(accelerator: str = "") -> tuple[ChipSpec, bool]:
+    """(spec, assumed): the chip spec for ``accelerator`` (or, unset, the
+    ``M2KT_TPU_ACCELERATOR`` env). ``assumed`` is True when the string
+    didn't resolve and the conservative v5e default stands in — MFU
+    numbers derived from an assumed spec are still emitted (a forced-host
+    CI probe has no TPU string at all) but the reports flag them."""
+    raw = accelerator or os.environ.get(ACCELERATOR_ENV, "")
+    canon = normalize_accelerator(raw)
+    if canon is None:
+        return CHIP_SPECS[DEFAULT_CHIP], True
+    return CHIP_SPECS[canon], False
+
+
+# ---------------------------------------------------------------------------
+# compiled-executable introspection (the fallback-tolerant wrappers)
+# ---------------------------------------------------------------------------
+
+
+def cost_analysis(compiled) -> dict:
+    """``compiled.cost_analysis()`` as a flat float dict, tolerating every
+    observed backend shape: a dict, a one-per-device list of dicts (CPU),
+    None, or a raising/absent method. Always returns a dict (possibly
+    empty); non-numeric values are dropped."""
+    try:
+        raw = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 - backend-specific, absent on some
+        return {}
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not isinstance(raw, dict):
+        return {}
+    out = {}
+    for k, v in raw.items():
+        try:
+            out[str(k)] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+_MEM_KEYS = {
+    "args": "argument_size_in_bytes",
+    "outputs": "output_size_in_bytes",
+    "temps": "temp_size_in_bytes",
+    "generated_code": "generated_code_size_in_bytes",
+    "aliased": "alias_size_in_bytes",
+}
+
+
+def memory_analysis(compiled) -> dict:
+    """``compiled.memory_analysis()`` as ``{args, outputs, temps,
+    generated_code, aliased}`` ints, tolerating the attribute-carrying
+    ``CompiledMemoryStats`` object, a plain dict, None, and missing keys.
+    Missing fields are simply absent from the result (empty dict when the
+    backend reports nothing), never an exception."""
+    try:
+        raw = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - backend-specific, absent on some
+        return {}
+    if raw is None:
+        return {}
+    out = {}
+    for name, attr in _MEM_KEYS.items():
+        if isinstance(raw, dict):
+            val = raw.get(attr, raw.get(name))
+        else:
+            val = getattr(raw, attr, None)
+        try:
+            if val is not None:
+                out[name] = int(val)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def peak_hbm_total(mem: dict) -> int | None:
+    """Peak executable footprint: arguments + outputs + temps (donated
+    bytes counted once — ``aliased`` outputs reuse argument buffers) plus
+    the program text itself. None when the analysis reported nothing."""
+    if not mem:
+        return None
+    total = (mem.get("args", 0) + mem.get("outputs", 0)
+             + mem.get("temps", 0) + mem.get("generated_code", 0)
+             - mem.get("aliased", 0))
+    return max(0, int(total))
+
+
+@dataclass
+class CostReport:
+    """Derived cost model of ONE compiled executable (per-device program:
+    cost_analysis describes the partitioned module each chip runs, so
+    flops/bytes — and any MFU derived from them — are per chip)."""
+
+    flops: float | None = None
+    bytes_accessed: float | None = None
+    memory: dict = field(default_factory=dict)
+    raw_cost_keys: int = 0
+
+    @property
+    def arithmetic_intensity(self) -> float | None:
+        if not self.flops or not self.bytes_accessed:
+            return None
+        return self.flops / self.bytes_accessed
+
+    @property
+    def peak_hbm_bytes(self) -> int | None:
+        return peak_hbm_total(self.memory)
+
+    def roofline(self, spec: ChipSpec) -> str:
+        """"compute" / "bandwidth" / "unknown" against the chip ridge."""
+        ai = self.arithmetic_intensity
+        if ai is None:
+            return "unknown"
+        return ("compute" if ai >= spec.ridge_flops_per_byte
+                else "bandwidth")
+
+    def mfu(self, step_seconds: float | None, spec: ChipSpec) -> float | None:
+        """Achieved model-FLOP utilization of one chip for a measured
+        step wall time; None when either half is unknown."""
+        if not self.flops or not step_seconds or step_seconds <= 0:
+            return None
+        return (self.flops / step_seconds) / spec.peak_bf16_flops
+
+    def mfu_ceiling(self, spec: ChipSpec) -> float | None:
+        """Roofline MFU ceiling: a bandwidth-bound program cannot exceed
+        intensity/ridge no matter how well it schedules; 1.0 when
+        compute-bound."""
+        ai = self.arithmetic_intensity
+        if ai is None:
+            return None
+        return min(1.0, ai / spec.ridge_flops_per_byte)
+
+    def to_dict(self) -> dict:
+        return {
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "arithmetic_intensity": self.arithmetic_intensity,
+            "memory": dict(self.memory),
+            "peak_hbm_bytes": self.peak_hbm_bytes,
+        }
+
+
+def analyze_compiled(compiled) -> CostReport:
+    """Full degraded-tolerant report for one compiled executable. Never
+    raises: a backend reporting nothing yields an all-None report."""
+    cost = cost_analysis(compiled)
+    return CostReport(
+        flops=cost.get("flops"),
+        bytes_accessed=cost.get("bytes accessed"),
+        memory=memory_analysis(compiled),
+        raw_cost_keys=len(cost),
+    )
+
+
+def lower_and_compile(step_fn, *args):
+    """AOT-compile a (possibly mesh-wrapped) jitted function for
+    introspection — the ``_m2kt_jit``/``_m2kt_mesh`` unwrap that
+    ``train.assert_state_donated`` established. Returns the compiled
+    executable, or None when the function isn't jitted or the lowering
+    fails (introspection must never kill a training run)."""
+    jit_fn = getattr(step_fn, "_m2kt_jit", step_fn)
+    mesh = getattr(step_fn, "_m2kt_mesh", None)
+    if not hasattr(jit_fn, "lower"):
+        return None
+    try:
+        if mesh is not None:
+            from move2kube_tpu.models.train import _mesh_context
+
+            with _mesh_context(mesh):
+                return jit_fn.lower(*args).compile()
+        return jit_fn.lower(*args).compile()
+    except Exception:  # noqa: BLE001 - best-effort introspection
+        return None
+
+
+def analyze_step_fn(step_fn, *args) -> CostReport | None:
+    """Lower + compile + analyze in one call; None when the function
+    can't be lowered (not jitted, tracing failure)."""
+    compiled = lower_and_compile(step_fn, *args)
+    if compiled is None:
+        return None
+    report = analyze_compiled(compiled)
+    note_memory_report(report)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# gauge export
+# ---------------------------------------------------------------------------
+
+
+def export_train_gauges(report: CostReport, registry=None, *,
+                        accelerator: str = "",
+                        step_seconds: float | None = None) -> float | None:
+    """Set the training cost-model gauges from one report: MFU (0 when
+    flops or timing are unknown — the gauge stays present so dashboards
+    and the mfu-smoke assert never see a missing family), the roofline
+    class, per-category peak-HBM bytes, and the raw flops/intensity.
+    Returns the MFU value (None when it could not be derived)."""
+    from move2kube_tpu.obs.metrics import default_registry
+
+    reg = registry if registry is not None else default_registry()
+    spec, assumed = chip_spec(accelerator)
+    mfu = report.mfu(step_seconds, spec)
+    reg.gauge(
+        "m2kt_train_mfu",
+        "Achieved model-FLOP utilization per chip (0 = unknown)",
+    ).set(mfu or 0.0)
+    reg.gauge(
+        "m2kt_roofline_bound",
+        "Roofline class of the train step (1 compute-bound, "
+        "0 bandwidth-bound, -1 unknown)",
+    ).set({"compute": COMPUTE_BOUND, "bandwidth": BANDWIDTH_BOUND,
+           "unknown": UNKNOWN_BOUND}[report.roofline(spec)])
+    reg.gauge(
+        "m2kt_train_step_flops",
+        "Per-chip FLOPs of the compiled train step",
+    ).set(report.flops or 0.0)
+    reg.gauge(
+        "m2kt_train_arithmetic_intensity",
+        "Train-step FLOPs per HBM byte accessed",
+    ).set(report.arithmetic_intensity or 0.0)
+    reg.gauge(
+        "m2kt_chip_spec_assumed",
+        "1 when the accelerator string did not resolve and the v5e "
+        "spec was assumed for MFU/roofline math",
+    ).set(1.0 if assumed else 0.0)
+    # the denominator the M2KTHBMHeadroomLow rule divides peak-HBM by
+    reg.gauge(
+        "m2kt_chip_hbm_bytes",
+        "HBM capacity of the chip generation the cost model resolved",
+    ).set(spec.hbm_bytes)
+    hbm = reg.gauge(
+        "m2kt_hbm_peak_bytes",
+        "Compiled-executable HBM footprint by category",
+        labels=("category",))
+    for category, nbytes in report.memory.items():
+        hbm.labels(category=category).set(nbytes)
+    total = report.peak_hbm_bytes
+    if total is not None:
+        hbm.labels(category="total").set(total)
+    return mfu
+
+
+def export_serving_gauges(reports: dict, registry=None, *,
+                          accelerator: str = "",
+                          decode_step_seconds: float | None = None) -> None:
+    """Per-executable serving gauges from ``{name: CostReport}`` (the
+    engine's bucketed prefills + the decode step): roofline class and
+    step FLOPs labeled by executable, peak-HBM by (executable, category),
+    and an achieved decode MFU when the engine has timing."""
+    from move2kube_tpu.obs.metrics import default_registry
+
+    reg = registry if registry is not None else default_registry()
+    spec, _ = chip_spec(accelerator)
+    bound = reg.gauge(
+        "m2kt_serve_roofline_bound",
+        "Roofline class per serving executable (1 compute, 0 bandwidth, "
+        "-1 unknown)", labels=("executable",))
+    flops = reg.gauge(
+        "m2kt_serve_step_flops",
+        "Per-chip FLOPs per serving executable", labels=("executable",))
+    hbm = reg.gauge(
+        "m2kt_serve_hbm_peak_bytes",
+        "Serving executable HBM footprint by category",
+        labels=("executable", "category"))
+    for name, report in reports.items():
+        bound.labels(executable=name).set(
+            {"compute": COMPUTE_BOUND, "bandwidth": BANDWIDTH_BOUND,
+             "unknown": UNKNOWN_BOUND}[report.roofline(spec)])
+        flops.labels(executable=name).set(report.flops or 0.0)
+        for category, nbytes in report.memory.items():
+            hbm.labels(executable=name, category=category).set(nbytes)
+        total = report.peak_hbm_bytes
+        if total is not None:
+            hbm.labels(executable=name, category="total").set(total)
+    decode = reports.get("decode")
+    if decode is not None:
+        reg.gauge(
+            "m2kt_serve_mfu",
+            "Achieved decode-step MFU per chip (0 = unknown)",
+        ).set(decode.mfu(decode_step_seconds, spec) or 0.0)
+
+
+def export_drift_gauge(predicted_total: float | None,
+                       measured_total: float | None,
+                       registry=None) -> float | None:
+    """The calibration loop for ``parallel/memory.py``: predicted/measured
+    peak-HBM ratio as a gauge (1.0 = the analytic model matched XLA's
+    buffer assignment exactly). Returns the ratio, or None (gauge set to
+    0) when either side is unknown."""
+    from move2kube_tpu.obs.metrics import default_registry
+
+    reg = registry if registry is not None else default_registry()
+    ratio = None
+    if predicted_total and measured_total:
+        ratio = float(predicted_total) / float(measured_total)
+    reg.gauge(
+        "m2kt_plan_hbm_drift_ratio",
+        "Predicted (MemoryPlan) over measured (memory_analysis) peak-HBM "
+        "bytes; 0 = unknown",
+    ).set(ratio or 0.0)
+    return ratio
+
+
+# ---------------------------------------------------------------------------
+# preflight plan report
+# ---------------------------------------------------------------------------
+
+
+def plan_report_dir() -> str | None:
+    """Where ``m2kt-plan-report.{json,md}`` lands: ``M2KT_PLAN_REPORT``
+    unset/0/false -> None (off), "1"/true -> ``M2KT_METRICS_DIR`` or cwd,
+    anything else -> treated as the target directory."""
+    raw = os.environ.get(PLAN_REPORT_ENV, "").strip()
+    if not raw or raw.lower() in ("0", "false", "off"):
+        return None
+    if raw.lower() in ("1", "true", "on"):
+        return os.environ.get("M2KT_METRICS_DIR", "") or "."
+    return raw
+
+
+def build_plan_report(memory_plan, accelerator: str, *,
+                      mesh_plan=None, n_devices: int | None = None,
+                      cost: CostReport | None = None,
+                      step_seconds: float | None = None,
+                      headroom: float = 0.9,
+                      optimizer_slots: int = 2) -> dict:
+    """The preflight fit report: MemoryPlan prediction vs chip budget,
+    the chosen mesh plan, the roofline/MFU estimate from the compiled
+    step (when one exists — emission-time reports carry prediction only),
+    and — over budget — the smallest fsdp re-split that would fit.
+
+    ``memory_plan`` is a ``parallel.memory.MemoryPlan``; ``mesh_plan`` a
+    ``parallel.topology.MeshPlan`` (optional). Pure dict output so the
+    emitter can render it without jax."""
+    spec, assumed = chip_spec(accelerator)
+    budget = spec.hbm_bytes * headroom
+    predicted_total = int(memory_plan.total)
+    fits = predicted_total <= budget
+    report = {
+        "schema": "m2kt-plan-report/v1",
+        "accelerator": {
+            "requested": accelerator,
+            "resolved": normalize_accelerator(accelerator),
+            "chip": spec.name,
+            "assumed_default": assumed,
+            "peak_bf16_flops": spec.peak_bf16_flops,
+            "hbm_bytes": spec.hbm_bytes,
+            "hbm_bandwidth_bytes_s": spec.hbm_bandwidth,
+        },
+        "predicted": {
+            "params_bytes": int(memory_plan.params),
+            "grads_bytes": int(memory_plan.grads),
+            "opt_state_bytes": int(memory_plan.opt_state),
+            "activations_bytes": int(memory_plan.activations),
+            "total_bytes": predicted_total,
+            "breakdown": [
+                {"leaf": name, "bytes": int(nbytes)}
+                for name, nbytes in memory_plan.breakdown
+            ],
+        },
+        "fit": {
+            "fits": fits,
+            "headroom": headroom,
+            "budget_bytes": int(budget),
+            "utilization": (predicted_total / budget) if budget else None,
+        },
+        "verdict": "fit" if fits else "over-budget",
+    }
+    if mesh_plan is not None:
+        report["mesh"] = {
+            "describe": mesh_plan.describe(),
+            "extents": {
+                axis: getattr(mesh_plan.config, axis)
+                for axis in type(mesh_plan.config).AXES
+            },
+            "dcn_dp": mesh_plan.dcn_dp,
+            "source": mesh_plan.source,
+        }
+    if not fits:
+        report["suggestion"] = _fsdp_suggestion(
+            memory_plan, mesh_plan, n_devices, spec, headroom,
+            optimizer_slots)
+    if cost is not None:
+        report["compiled"] = cost.to_dict()
+        report["compiled"]["roofline"] = cost.roofline(spec)
+        report["estimated_mfu"] = {
+            "roofline_ceiling": cost.mfu_ceiling(spec),
+            "achieved": cost.mfu(step_seconds, spec),
+            "step_seconds": step_seconds,
+        }
+        measured_total = cost.peak_hbm_bytes
+        drift = None
+        if measured_total:
+            drift = predicted_total / measured_total
+        report["drift"] = {
+            "measured_peak_hbm_bytes": measured_total,
+            "predicted_over_measured": drift,
+            "tolerance_factor": PLAN_DRIFT_TOLERANCE_FACTOR,
+            "within_tolerance": (
+                None if drift is None else
+                1 / PLAN_DRIFT_TOLERANCE_FACTOR <= drift
+                <= PLAN_DRIFT_TOLERANCE_FACTOR),
+        }
+    return report
+
+
+def _fsdp_suggestion(memory_plan, mesh_plan, n_devices, spec: ChipSpec,
+                     headroom: float, optimizer_slots: int) -> dict:
+    """Next fsdp re-split that fits: reuse the planner's own memory
+    split (``topology._memory_min_fsdp``) over the dp x fsdp pool so the
+    suggestion is exactly what ``plan_parallelism`` would choose given
+    the measured parameter bytes."""
+    suggestion: dict = {"action": "re-split fsdp"}
+    try:
+        from move2kube_tpu.parallel.topology import _memory_min_fsdp
+
+        if mesh_plan is not None:
+            cfg = mesh_plan.config
+            resident = cfg.data * cfg.fsdp
+            tensor, current = cfg.tensor, cfg.fsdp
+        else:
+            resident = max(1, int(n_devices or 1))
+            tensor, current = 1, 1
+        # params in the plan are already per-chip: scale back to the
+        # replica-pool total the planner's split reasons over
+        param_bytes = int(memory_plan.params) * max(1, current)
+        fsdp = _memory_min_fsdp(
+            resident, tensor, param_bytes, spec.hbm_bytes, headroom,
+            optimizer_slots)
+        suggestion.update({
+            "current_fsdp": current,
+            "suggested_fsdp": max(fsdp, current),
+            "resident_pool": resident,
+        })
+        if fsdp <= current:
+            # state already sharded as far as the pool allows: the
+            # overage is activations — suggest the other lever
+            suggestion["action"] = (
+                "state fully sharded; reduce batch/sequence or add chips")
+    except Exception:  # noqa: BLE001 - a suggestion must not fail the report
+        suggestion["action"] = "add chips or reduce model/batch"
+    return suggestion
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(value) < 1024 or unit == "GiB":
+            return f"{value:.2f} {unit}" if unit != "B" else f"{int(value)} B"
+        value /= 1024
+    return f"{value:.2f} GiB"
+
+
+def render_plan_markdown(report: dict) -> str:
+    """Human half of the artifact pair: the same report as a short
+    markdown brief (the JSON is for tooling/golden asserts)."""
+    acc = report.get("accelerator", {})
+    pred = report.get("predicted", {})
+    fit = report.get("fit", {})
+    lines = [
+        "# m2kt preflight plan report",
+        "",
+        f"- **verdict**: {report.get('verdict', '?')}",
+        f"- **chip**: {acc.get('chip', '?')}"
+        + (" (assumed default)" if acc.get("assumed_default") else "")
+        + f" — HBM {_fmt_bytes(acc.get('hbm_bytes'))}, "
+          f"peak bf16 {acc.get('peak_bf16_flops', 0) / 1e12:.0f} TFLOP/s",
+        f"- **budget**: {_fmt_bytes(fit.get('budget_bytes'))} "
+        f"(headroom {fit.get('headroom')})",
+        "",
+        "| component | bytes/chip |",
+        "|---|---|",
+    ]
+    for key, label in (("params_bytes", "params"), ("grads_bytes", "grads"),
+                       ("opt_state_bytes", "optimizer state"),
+                       ("activations_bytes", "activations"),
+                       ("total_bytes", "**total**")):
+        lines.append(f"| {label} | {_fmt_bytes(pred.get(key))} |")
+    if report.get("mesh"):
+        lines += ["", f"Mesh plan: `{report['mesh']['describe']}`"]
+    if report.get("suggestion"):
+        s = report["suggestion"]
+        lines += ["", f"**Over budget** — {s.get('action')}"]
+        if s.get("suggested_fsdp"):
+            lines.append(f"Suggested fsdp: {s['current_fsdp']} -> "
+                         f"{s['suggested_fsdp']} "
+                         f"(pool {s['resident_pool']})")
+    est = report.get("estimated_mfu")
+    if est:
+        ceil = est.get("roofline_ceiling")
+        ach = est.get("achieved")
+        lines += ["", "Compiled-step estimate: "
+                  + (f"MFU ceiling {ceil:.1%}" if ceil is not None
+                     else "MFU ceiling unknown")
+                  + (f", achieved {ach:.2%}" if ach is not None else "")]
+    drift = report.get("drift")
+    if drift and drift.get("predicted_over_measured") is not None:
+        lines += ["", f"Predicted/measured peak HBM: "
+                  f"{drift['predicted_over_measured']:.2f}x "
+                  f"(tolerance {drift['tolerance_factor']}x, "
+                  f"{'OK' if drift['within_tolerance'] else 'DRIFTED'})"]
+    return "\n".join(lines) + "\n"
+
+
+def write_plan_report(report: dict, out_dir: str | None = None,
+                      strict: bool | None = None) -> tuple[str, str] | None:
+    """Atomically write ``m2kt-plan-report.json`` + ``.md`` into
+    ``out_dir`` (default: the ``M2KT_PLAN_REPORT`` directory; None when
+    the knob is off). ``strict`` (default ``M2KT_PLAN_REPORT_STRICT``)
+    turns an over-budget verdict into a SystemExit — the fail-fast half
+    of the preflight loop; non-strict callers get the suggestion in the
+    artifact and a warning on stderr."""
+    out_dir = out_dir if out_dir is not None else plan_report_dir()
+    if out_dir is None:
+        return None
+    paths = None
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        json_path = os.path.join(out_dir, "m2kt-plan-report.json")
+        md_path = os.path.join(out_dir, "m2kt-plan-report.md")
+        for path, payload in ((json_path, json.dumps(
+                report, indent=2, sort_keys=True) + "\n"),
+                (md_path, render_plan_markdown(report))):
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        paths = (json_path, md_path)
+    except OSError:
+        pass
+    if report.get("verdict") == "over-budget":
+        if strict is None:
+            strict = os.environ.get(
+                PLAN_REPORT_STRICT_ENV, "0").lower() in ("1", "true", "on")
+        msg = (f"[m2kt] plan report: predicted "
+               f"{report['predicted']['total_bytes'] / 1e9:.2f} GB/chip "
+               f"exceeds the {report['fit']['budget_bytes'] / 1e9:.2f} GB "
+               f"budget; suggestion: {report.get('suggestion', {})}")
+        if strict:
+            raise SystemExit(msg)
+        import sys
+
+        print(msg, file=sys.stderr, flush=True)
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics: memory snapshot sidecar for the flight recorder
+# ---------------------------------------------------------------------------
+
+_latest_memory: dict = {}
+_mem_lock = threading.Lock()
+_mem_flush_installed = False
+
+
+def mem_snapshot_path() -> str:
+    """Child-side memory-snapshot dump: derived from the flight path the
+    same way as the span ring, so the supervisor needs no handshake."""
+    return tracing.flight_path() + ".mem"
+
+
+def note_memory_report(report: CostReport) -> None:
+    """Remember the latest compiled-executable memory analysis so a later
+    death dumps it into the flight sidecar (the analysis of the step that
+    was running is exactly what an OOM postmortem wants)."""
+    if report.memory:
+        with _mem_lock:
+            _latest_memory["memory_analysis"] = dict(report.memory)
+            _latest_memory["peak_hbm_bytes"] = report.peak_hbm_bytes
+
+
+def live_buffer_summary(top_n: int = 8) -> dict:
+    """Host-visible live device buffers via ``jax.live_arrays()`` —
+    count, total bytes, and the largest shapes. Best-effort and lazy
+    (jax may not even be importable in the caller); {} on any failure."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+        sizes = []
+        total = 0
+        for a in arrays:
+            nbytes = int(getattr(a, "nbytes", 0))
+            total += nbytes
+            sizes.append((nbytes, str(getattr(a, "shape", "?")),
+                          str(getattr(a, "dtype", "?"))))
+        sizes.sort(key=lambda t: -t[0])
+        return {
+            "count": len(arrays),
+            "total_bytes": total,
+            "top": [{"bytes": b, "shape": s, "dtype": d}
+                    for b, s, d in sizes[:top_n]],
+        }
+    except Exception:  # noqa: BLE001 - forensics must never raise
+        return {}
+
+
+def write_memory_snapshot(path: str | None = None) -> str | None:
+    """Atomic dump of the latest memory analysis + a live-buffer summary
+    for the supervisor's flight recorder. Best-effort by design: it runs
+    on dying-process paths (RESOURCE_EXHAUSTED raises through teardown;
+    a SIGKILL'd OOM leaves only the analysis from a previous flush)."""
+    path = path or mem_snapshot_path()
+    with _mem_lock:
+        doc = dict(_latest_memory)
+    doc["live_buffers"] = live_buffer_summary()
+    doc["written_unix"] = time.time()
+    doc["pid"] = os.getpid()
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def install_memory_snapshot(path: str | None = None) -> None:
+    """Dump the memory snapshot on every teardown-running exit path —
+    the same ``threading._register_atexit`` trick as
+    ``tracing.install_ring_flush`` (see there for why plain atexit is
+    too late), so a RESOURCE_EXHAUSTED abort still leaves the OOM
+    forensics on disk next to the span ring."""
+    global _mem_flush_installed
+    if _mem_flush_installed:
+        return
+    _mem_flush_installed = True
+
+    def _flush() -> None:
+        try:
+            write_memory_snapshot(path)
+        except Exception:  # noqa: BLE001 - dying process, best effort
+            pass
+
+    register = getattr(threading, "_register_atexit", None)
+    if register is None:
+        import atexit
+
+        atexit.register(_flush)
+    else:
+        register(_flush)

@@ -29,6 +29,8 @@ THRESHOLDS = {
     "tpustepp95factor": "1.5",     # p95 step time vs 1h-ago baseline
     "tpurestartstormcount": "3",   # restarts per window before alarm
     "tpuservequeuemax": "64",      # queued requests before alarm
+    "tpumfumin": "0.05",           # achieved-MFU alarm floor
+    "tpuhbmheadroomfrac": "0.92",  # peak-HBM fraction of chip capacity
 }
 
 
@@ -100,6 +102,42 @@ def prometheus_rule(name: str, selector_label: str,
                     "m2kt-flight.json from the pod volume."),
             },
         },
+        {
+            "alert": "M2KTMFULow",
+            # the > 0 guard keeps the alert quiet when the cost model
+            # could not derive flops (gauge pinned at 0 = unknown)
+            "expr": (f"(m2kt_train_mfu{sel} > 0) and "
+                     f"(m2kt_train_mfu{sel} < {th['tpumfumin']})"),
+            "for": "30m",
+            "labels": {"severity": "warning", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: achieved MFU below floor",
+                "description": (
+                    "The compiled step's FLOPs over measured wall time "
+                    "is far from the chip peak. Check "
+                    "m2kt_roofline_bound (0 = bandwidth-bound: no "
+                    "kernel tuning will help, re-shard or grow batch) "
+                    "and the straggler scores."),
+            },
+        },
+        {
+            "alert": "M2KTHBMHeadroomLow",
+            "expr": (
+                f'm2kt_hbm_peak_bytes{{category="total",'
+                f'{sel[1:-1]}}} > '
+                f"{th['tpuhbmheadroomfrac']} * m2kt_chip_hbm_bytes{sel}"),
+            "for": "5m",
+            "labels": {"severity": "critical", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: compiled peak HBM near capacity",
+                "description": (
+                    "The executable's argument+output+temp footprint is "
+                    "within the fragmentation margin of chip HBM — the "
+                    "next recompile (longer bucket, bigger batch) OOMs. "
+                    "Read the memory block of m2kt-flight.json / the "
+                    "plan report's fsdp re-split suggestion."),
+            },
+        },
     ]
     if serving:
         rules.append({
@@ -157,16 +195,25 @@ def grafana_dashboard(name: str, selector_label: str,
         _panel(4, "Container restarts (30m)",
                "sum(increase(kube_pod_container_status_restarts_total"
                f'{{pod=~"{name}.*"}}[30m]))', 12, 8),
+        # cost-model row (obs/costmodel.py): how close to the hardware
+        # ceiling, and how close to the HBM cliff
+        _panel(7, "Achieved MFU",
+               f"m2kt_train_mfu{sel}", 0, 16, "percentunit"),
+        _panel(8, "Peak HBM by category",
+               f"m2kt_hbm_peak_bytes{sel}", 12, 16, "bytes"),
     ]
     if serving:
         panels.append(_panel(
             5, "TTFT p95",
             "histogram_quantile(0.95, sum(rate("
             f"m2kt_serve_ttft_seconds_bucket{sel}[5m])) by (le))",
-            0, 16, "s"))
+            0, 24, "s"))
         panels.append(_panel(
             6, "Serving queue depth",
-            f"m2kt_serve_queue_depth{sel}", 12, 16))
+            f"m2kt_serve_queue_depth{sel}", 12, 24))
+        panels.append(_panel(
+            9, "Serving roofline class by executable",
+            f"m2kt_serve_roofline_bound{sel}", 0, 32))
     return {
         "title": f"move2kube-tpu: {name}",
         "uid": f"m2kt-{name}",

@@ -18,6 +18,7 @@ v6e 32 GB.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 
 import jax
@@ -25,12 +26,34 @@ import numpy as np
 
 from move2kube_tpu.parallel.sharding import ShardingRules, infer_param_axes
 
+logger = logging.getLogger(__name__)
+
 HBM_BYTES = {
     "tpu-v5-lite-podslice": 16e9,
     "tpu-v5p-slice": 95e9,
     "tpu-v4-podslice": 32e9,
     "tpu-v6e-slice": 32e9,
 }
+
+
+def hbm_budget_bytes(accelerator: str) -> float:
+    """HBM capacity for an accelerator string, tolerating the aliases
+    users actually type ("v5e", "v5litepod-8", "TPU v5p"). Strings that
+    resolve to no known generation budget like v5e — the smallest table
+    entry, so a fit verdict is conservative — with a logged warning
+    rather than a KeyError."""
+    if accelerator in HBM_BYTES:
+        return HBM_BYTES[accelerator]
+    from move2kube_tpu.obs.costmodel import normalize_accelerator
+
+    canon = normalize_accelerator(accelerator)
+    if canon in HBM_BYTES:
+        return HBM_BYTES[canon]
+    fallback = min(HBM_BYTES.values())
+    logger.warning(
+        "unknown accelerator %r: assuming conservative %d GB HBM budget",
+        accelerator, int(fallback / 1e9))
+    return fallback
 
 
 @dataclass
@@ -50,8 +73,10 @@ class MemoryPlan:
 
     def fits(self, accelerator: str, headroom: float = 0.9) -> bool:
         """True when total fits ``headroom`` of the chip's HBM (the
-        remaining fraction covers XLA scratch + fragmentation)."""
-        return self.total <= HBM_BYTES[accelerator] * headroom
+        remaining fraction covers XLA scratch + fragmentation).
+        Accelerator aliases are normalized; unknown strings budget
+        conservatively (smallest table entry) instead of raising."""
+        return self.total <= hbm_budget_bytes(accelerator) * headroom
 
 
 def _sharded_bytes(shape_dtype, spec, extents: dict[str, int]) -> int:

@@ -280,6 +280,31 @@ def tpu_observability_optimizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_planreport_optimizer(ir: IR) -> IR:
+    """Bake ``M2KT_PLAN_REPORT=1`` into accelerated *training* services
+    behind the ``m2kt.services.<name>.obs.planreport`` QA knob
+    (``apiresource.obs_wiring.plan_report_enabled`` — shared + cached, so
+    every consumer of the knob agrees). The emitted trainer then writes
+    ``m2kt-plan-report.{json,md}`` (obs/costmodel.py) into
+    M2KT_METRICS_DIR on startup: the analytic HBM plan checked against
+    the compiled step's own memory_analysis. Serving services are
+    skipped — the engine's cost model rides compile_report instead of a
+    startup artifact. Existing env entries are never overwritten."""
+    from move2kube_tpu.apiresource.obs_wiring import plan_report_enabled
+
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None or getattr(acc, "serving", False):
+            continue
+        if not plan_report_enabled(svc.name):
+            continue
+        for container in svc.containers:
+            env = container.setdefault("env", [])
+            if "M2KT_PLAN_REPORT" not in {e.get("name") for e in env}:
+                env.append({"name": "M2KT_PLAN_REPORT", "value": "1"})
+    return ir
+
+
 OPTIMIZERS = [
     normalize_character_optimizer,
     ingress_optimizer,
@@ -290,6 +315,7 @@ OPTIMIZERS = [
     tpu_serving_optimizer,
     tpu_elastic_optimizer,
     tpu_observability_optimizer,
+    tpu_planreport_optimizer,
 ]
 
 

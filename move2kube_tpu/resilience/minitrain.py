@@ -92,6 +92,15 @@ def main() -> None:
     if tracer is not None:
         tracing.install_ring_flush()
 
+    # same telemetry surface as the emitted trainers when a port is set:
+    # /metrics then carries the cost-model gauges (m2kt_train_mfu et al.)
+    # the mfu-smoke CI target scrapes off this harness
+    from move2kube_tpu.obs import start_telemetry_server
+
+    server = start_telemetry_server()
+    if server is not None:
+        print(f"[m2kt] metrics on :{server.port}", flush=True)
+
     steps = int(os.environ.get("M2KT_STEPS", "8"))
     step_sleep = float(os.environ.get("M2KT_STEP_SLEEP_S", "0"))
     bpd = int(os.environ.get("M2KT_BATCH_PER_DEVICE", "4") or 4)
@@ -155,6 +164,7 @@ def main() -> None:
 
     preempted_at = None
     loss = None
+    costed = False
     try:
         for i in range(start + 1, steps + 1):
             faults.maybe_inject(i)
@@ -164,6 +174,26 @@ def main() -> None:
             if step_sleep:
                 time.sleep(step_sleep)
             t1 = time.perf_counter()
+            if not costed:
+                # compiled-program cost model (obs/costmodel.py): FLOPs /
+                # roofline / peak-HBM gauges off the executable that just
+                # compiled, MFU from this first measured step; also arms
+                # the OOM memory-snapshot sidecar for the flight recorder
+                costed = True
+                from move2kube_tpu.obs import costmodel
+
+                report = costmodel.analyze_step_fn(
+                    step_fn, state, make_batch(i + 1))
+                if report is not None:
+                    mfu = costmodel.export_train_gauges(
+                        report, step_seconds=t1 - t0)
+                    costmodel.install_memory_snapshot()
+                    ai = report.arithmetic_intensity
+                    print(f"[m2kt] costmodel: flops={report.flops} "
+                          f"intensity="
+                          f"{f'{ai:.2f}' if ai is not None else '-'} "
+                          f"mfu={f'{mfu:.3%}' if mfu is not None else '-'}",
+                          flush=True)
             if tracer is not None:
                 tracer.record(
                     "train.compile" if i == start + 1 else "train.step",

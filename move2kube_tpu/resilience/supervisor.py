@@ -358,6 +358,18 @@ class Supervisor:
                 ring = json.load(f)
         except (OSError, ValueError):
             pass
+        # OOM forensics (obs/costmodel.py): the child's <flight>.mem
+        # sidecar carries the memory_analysis of its last compiled step
+        # plus a live-buffer summary — exactly what a RESOURCE_EXHAUSTED
+        # or OOM-killed (137) postmortem needs. Absent for SIGKILL'd
+        # children that never flushed one.
+        memory: dict = {}
+        try:
+            with open(tracing.flight_path() + ".mem",
+                      encoding="utf-8") as f:
+                memory = json.load(f)
+        except (OSError, ValueError):
+            pass
         tail = self._attempts[-1].get("stderr_tail", "") \
             if self._attempts else ""
         flight = {
@@ -372,6 +384,7 @@ class Supervisor:
                      ("host", "slice_id", "pid", "written_unix",
                       "ring_seconds", "dropped")} if ring else {},
             "spans": ring.get("spans", []),
+            "memory": memory,
         }
         path = tracing.flight_path()
         try:

@@ -468,7 +468,7 @@ class ServingEngine:
         return len(set(os.listdir(self._cache_dir))
                    - self._cache_dir_before)
 
-    def compile_report(self) -> dict:
+    def compile_report(self, include_cost: bool = False) -> dict:
         def cache_size(fn) -> int:
             try:
                 return int(fn._cache_size())
@@ -486,7 +486,60 @@ class ServingEngine:
                 and report["decode_executables"] >= 0):
             report["total_executables"] = (report["prefill_executables"]
                                            + report["decode_executables"])
+        if include_cost:
+            # opt-in: lowering every bucket is seconds of work, too slow
+            # for the fast smokes that only count executables
+            report["cost"] = self.cost_report()
         return report
+
+    def cost_report(self, accelerator: str = "") -> dict:
+        """Roofline/MFU cost model of every bucketed executable
+        (obs/costmodel.py): each prefill bucket and the decode step are
+        AOT-lowered with zero-filled example args, their
+        ``cost_analysis``/``memory_analysis`` folded into per-executable
+        FLOPs / intensity / peak-HBM entries, and the serving gauges
+        (``m2kt_serve_roofline_bound{executable=...}`` etc.) set on this
+        engine's registry. Decode MFU uses the engine's own measured
+        per-step decode time when any decode has run. Best-effort: an
+        executable that fails to lower is simply absent."""
+        from move2kube_tpu.obs import costmodel
+
+        reports: dict = {}
+        bt_row = np.full((self.cache_cfg.max_pages_per_seq,), NULL_PAGE,
+                         np.int32)
+        for bucket in self.buckets:
+            compiled = costmodel.lower_and_compile(
+                self._prefill, self.variables, self._cache,
+                np.zeros((1, bucket), np.int32), bt_row,
+                np.int32(0), np.int32(1))
+            if compiled is not None:
+                reports[f"prefill_{bucket}"] = \
+                    costmodel.analyze_compiled(compiled)
+        compiled = costmodel.lower_and_compile(
+            self._decode, self.variables, self._cache,
+            np.zeros((self.config.max_batch,), np.int32),
+            np.zeros((self.config.max_batch,), bool))
+        if compiled is not None:
+            decode = costmodel.analyze_compiled(compiled)
+            reports["decode"] = decode
+            # decode is the steady-state resident: its memory analysis is
+            # what the OOM flight sidecar should carry for a serving pod
+            costmodel.note_memory_report(decode)
+        spec, _ = costmodel.chip_spec(accelerator)
+        decode_step = (self._decode_time / self._lat_hist.count
+                       if self._lat_hist.count else None)
+        costmodel.export_serving_gauges(
+            reports, self.registry, accelerator=accelerator,
+            decode_step_seconds=decode_step)
+        out = {}
+        for name, rep in reports.items():
+            entry = rep.to_dict()
+            entry["roofline"] = rep.roofline(spec)
+            out[name] = entry
+        if "decode" in out:
+            out["decode"]["achieved_mfu"] = reports["decode"].mfu(
+                decode_step, spec)
+        return out
 
     def stats(self) -> dict:
         # percentiles come from the fixed-bucket histogram (bucket-edge

@@ -1,0 +1,327 @@
+"""Compiled-program cost model (obs/costmodel.py): fallback tolerance,
+chip-spec resolution, roofline/MFU math, plan report, and OOM sidecar.
+
+The contract under test is graceful degradation: ``cost_analysis`` /
+``memory_analysis`` wrappers must survive every backend shape observed
+in the wild — dicts, one-per-device lists of dicts, attribute-carrying
+``CompiledMemoryStats`` objects, ``None``, raising methods, and missing
+keys — and produce a degraded-but-valid report, never an exception.
+"""
+
+import json
+import os
+
+import pytest
+
+from move2kube_tpu.obs import costmodel
+from move2kube_tpu.obs.metrics import Registry
+
+
+# ----------------------------------------------------------------------
+# fake compiled executables covering every observed backend shape
+# ----------------------------------------------------------------------
+
+
+class _Raises:
+    def cost_analysis(self):
+        raise RuntimeError("backend does not implement cost analysis")
+
+    def memory_analysis(self):
+        raise RuntimeError("backend does not implement memory analysis")
+
+
+class _ReturnsNone:
+    def cost_analysis(self):
+        return None
+
+    def memory_analysis(self):
+        return None
+
+
+class _Empty:
+    def cost_analysis(self):
+        return {}
+
+    def memory_analysis(self):
+        return {}
+
+
+class _MissingKeys:
+    # partial data: flops present, 'bytes accessed' absent; memory stats
+    # carry only the argument size
+    def cost_analysis(self):
+        return [{"flops": 123.0}]
+
+    def memory_analysis(self):
+        return {"argument_size_in_bytes": 64}
+
+
+class _MemStats:
+    generated_code_size_in_bytes = 10
+    argument_size_in_bytes = 100
+    output_size_in_bytes = 40
+    temp_size_in_bytes = 50
+    alias_size_in_bytes = 30
+
+
+class _CpuShaped:
+    """jax 0.4.x CPU backend: list-wrapped cost dict + attribute object."""
+
+    def cost_analysis(self):
+        return [{"flops": 1000.0, "bytes accessed": 100.0,
+                 "utilization0{}": 1.0, "junk": object()}]
+
+    def memory_analysis(self):
+        return _MemStats()
+
+
+@pytest.mark.parametrize("fake", [
+    _Raises(), _ReturnsNone(), _Empty(), object(), None])
+def test_wrappers_never_raise_on_degraded_backends(fake):
+    assert costmodel.cost_analysis(fake) == {}
+    assert costmodel.memory_analysis(fake) == {}
+    report = costmodel.analyze_compiled(fake)
+    assert report.flops is None
+    assert report.bytes_accessed is None
+    assert report.arithmetic_intensity is None
+    assert report.peak_hbm_bytes is None
+    spec, _ = costmodel.chip_spec("v5e")
+    assert report.roofline(spec) == "unknown"
+    assert report.mfu(1.0, spec) is None
+    assert report.mfu_ceiling(spec) is None
+
+
+def test_missing_keys_yield_partial_report():
+    report = costmodel.analyze_compiled(_MissingKeys())
+    assert report.flops == 123.0
+    assert report.bytes_accessed is None
+    assert report.arithmetic_intensity is None  # needs both halves
+    assert report.memory == {"args": 64}
+    spec, _ = costmodel.chip_spec("v5e")
+    # flops alone still give an MFU; intensity-derived answers degrade
+    assert report.mfu(1.0, spec) == pytest.approx(
+        123.0 / spec.peak_bf16_flops)
+    assert report.roofline(spec) == "unknown"
+
+
+def test_cpu_shaped_backend_full_report():
+    report = costmodel.analyze_compiled(_CpuShaped())
+    assert report.flops == 1000.0
+    assert report.bytes_accessed == 100.0
+    assert report.arithmetic_intensity == 10.0
+    assert report.memory == {"args": 100, "outputs": 40, "temps": 50,
+                             "generated_code": 10, "aliased": 30}
+    # donated (aliased) output bytes are not double-counted
+    assert report.peak_hbm_bytes == 100 + 40 + 50 + 10 - 30
+
+
+def test_roofline_classification_against_ridge():
+    spec, _ = costmodel.chip_spec("tpu-v5-lite-podslice")
+    low = costmodel.CostReport(flops=100.0, bytes_accessed=100.0)
+    assert low.roofline(spec) == "bandwidth"
+    assert low.mfu_ceiling(spec) < 1.0
+    high = costmodel.CostReport(
+        flops=spec.ridge_flops_per_byte * 10.0, bytes_accessed=1.0)
+    assert high.roofline(spec) == "compute"
+    assert high.mfu_ceiling(spec) == 1.0
+
+
+# ----------------------------------------------------------------------
+# chip specs + alias normalization
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("alias,canon", [
+    ("tpu-v5-lite-podslice", "tpu-v5-lite-podslice"),
+    ("v5e", "tpu-v5-lite-podslice"),
+    ("V5litepod-8", "tpu-v5-lite-podslice"),
+    ("tpu v5e", "tpu-v5-lite-podslice"),
+    ("v5p", "tpu-v5p-slice"),
+    ("tpu-v5p-slice", "tpu-v5p-slice"),
+    ("v4", "tpu-v4-podslice"),
+    ("v6e", "tpu-v6e-slice"),
+    ("trillium", "tpu-v6e-slice"),
+    ("", None),
+    ("nvidia-a100", None),
+])
+def test_normalize_accelerator(alias, canon):
+    assert costmodel.normalize_accelerator(alias) == canon
+
+
+def test_chip_spec_conservative_default_is_flagged():
+    spec, assumed = costmodel.chip_spec("completely-unknown")
+    assert assumed
+    assert spec.name == "v5e"  # smallest HBM: conservative fit verdicts
+    spec, assumed = costmodel.chip_spec("tpu-v5p-slice")
+    assert not assumed and spec.hbm_bytes == 95e9
+
+
+def test_hbm_table_agrees_with_memory_plan():
+    """CHIP_SPECS and parallel/memory.HBM_BYTES must tell one story."""
+    from move2kube_tpu.parallel.memory import HBM_BYTES
+
+    assert set(costmodel.CHIP_SPECS) == set(HBM_BYTES)
+    for key, spec in costmodel.CHIP_SPECS.items():
+        assert spec.hbm_bytes == HBM_BYTES[key]
+
+
+def test_memory_plan_fits_aliases_and_unknown():
+    """Satellite: fits() must normalize aliases and budget conservatively
+    on unknown strings instead of raising KeyError."""
+    from move2kube_tpu.parallel.memory import MemoryPlan
+
+    plan = MemoryPlan(params=10 ** 9)  # 4 GB total with grads+opt at 0
+    assert plan.fits("tpu-v5p-slice")
+    assert plan.fits("v5p")            # alias, used to KeyError
+    assert plan.fits("unknown-chip")   # conservative default, no raise
+    big = MemoryPlan(params=10 ** 12)
+    assert not big.fits("unknown-chip")
+
+
+# ----------------------------------------------------------------------
+# gauge export
+# ----------------------------------------------------------------------
+
+
+def test_export_train_gauges_always_emits_mfu_family():
+    reg = Registry()
+    report = costmodel.CostReport()  # fully degraded
+    mfu = costmodel.export_train_gauges(report, reg, accelerator="v5e")
+    assert mfu is None
+    text = reg.render()
+    assert "m2kt_train_mfu 0" in text          # present even when unknown
+    assert "m2kt_roofline_bound -1" in text    # unknown class
+    assert "m2kt_chip_hbm_bytes" in text
+
+
+def test_export_train_gauges_full():
+    reg = Registry()
+    report = costmodel.analyze_compiled(_CpuShaped())
+    mfu = costmodel.export_train_gauges(
+        report, reg, accelerator="tpu-v5p-slice", step_seconds=1.0)
+    assert mfu == pytest.approx(1000.0 / 459e12)
+    text = reg.render()
+    assert 'm2kt_hbm_peak_bytes{category="args"} 100' in text
+    assert 'm2kt_hbm_peak_bytes{category="total"} 170' in text
+    assert "m2kt_roofline_bound 0" in text  # intensity 10 << v5p ridge
+    assert "m2kt_chip_spec_assumed 0" in text
+
+
+def test_export_serving_gauges_labels_by_executable():
+    reg = Registry()
+    reports = {
+        "prefill_128": costmodel.analyze_compiled(_CpuShaped()),
+        "decode": costmodel.analyze_compiled(_CpuShaped()),
+    }
+    costmodel.export_serving_gauges(
+        reports, reg, accelerator="v5e", decode_step_seconds=0.01)
+    text = reg.render()
+    assert 'm2kt_serve_step_flops{executable="prefill_128"} 1000' in text
+    assert 'm2kt_serve_roofline_bound{executable="decode"} 0' in text
+    assert "m2kt_serve_mfu" in text
+
+
+def test_export_drift_gauge():
+    reg = Registry()
+    assert costmodel.export_drift_gauge(200.0, 100.0, reg) == 2.0
+    assert "m2kt_plan_hbm_drift_ratio 2" in reg.render()
+    assert costmodel.export_drift_gauge(None, 100.0, reg) is None
+    assert "m2kt_plan_hbm_drift_ratio 0" in reg.render()
+
+
+# ----------------------------------------------------------------------
+# plan report
+# ----------------------------------------------------------------------
+
+
+def _tiny_plan(total_gb: float):
+    from move2kube_tpu.parallel.memory import MemoryPlan
+
+    quarter = int(total_gb * 1e9 / 4)
+    return MemoryPlan(params=quarter, grads=quarter, opt_state=quarter,
+                      activations=quarter,
+                      breakdown=[("embed/kernel", quarter)])
+
+
+def test_plan_report_fit_verdict_and_drift(tmp_path):
+    plan = _tiny_plan(1.0)
+    cost = costmodel.analyze_compiled(_CpuShaped())
+    report = costmodel.build_plan_report(
+        plan, "v5e", n_devices=8, cost=cost, step_seconds=0.5)
+    assert report["verdict"] == "fit"
+    assert report["accelerator"]["resolved"] == "tpu-v5-lite-podslice"
+    assert report["predicted"]["total_bytes"] == plan.total
+    assert report["fit"]["fits"] is True
+    assert report["drift"]["measured_peak_hbm_bytes"] == 170
+    assert report["drift"]["predicted_over_measured"] == pytest.approx(
+        plan.total / 170)
+    assert report["estimated_mfu"]["achieved"] == pytest.approx(
+        1000.0 / 0.5 / 197e12)
+    paths = costmodel.write_plan_report(report, str(tmp_path))
+    assert paths is not None
+    doc = json.loads((tmp_path / "m2kt-plan-report.json").read_text())
+    assert doc["verdict"] == "fit"
+    md = (tmp_path / "m2kt-plan-report.md").read_text()
+    assert "verdict**: fit" in md
+
+
+def test_plan_report_over_budget_suggests_fsdp(tmp_path, capsys):
+    report = costmodel.build_plan_report(_tiny_plan(64.0), "v5e",
+                                         n_devices=16)
+    assert report["verdict"] == "over-budget"
+    sug = report["suggestion"]
+    assert sug["suggested_fsdp"] >= 1
+    # non-strict: the warning lands on stderr, files still written
+    paths = costmodel.write_plan_report(report, str(tmp_path), strict=False)
+    assert paths is not None
+    assert "exceeds" in capsys.readouterr().err
+    # strict: over-budget fails fast
+    with pytest.raises(SystemExit):
+        costmodel.write_plan_report(report, str(tmp_path), strict=True)
+
+
+def test_plan_report_dir_knob(monkeypatch):
+    monkeypatch.delenv(costmodel.PLAN_REPORT_ENV, raising=False)
+    assert costmodel.plan_report_dir() is None
+    monkeypatch.setenv(costmodel.PLAN_REPORT_ENV, "0")
+    assert costmodel.plan_report_dir() is None
+    monkeypatch.setenv(costmodel.PLAN_REPORT_ENV, "1")
+    monkeypatch.setenv("M2KT_METRICS_DIR", "/tmp/mdir")
+    assert costmodel.plan_report_dir() == "/tmp/mdir"
+    monkeypatch.setenv(costmodel.PLAN_REPORT_ENV, "/explicit/dir")
+    assert costmodel.plan_report_dir() == "/explicit/dir"
+
+
+# ----------------------------------------------------------------------
+# OOM forensics sidecar
+# ----------------------------------------------------------------------
+
+
+def test_memory_snapshot_sidecar_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("M2KT_FLIGHT_PATH", str(tmp_path / "m2kt-flight.json"))
+    assert costmodel.mem_snapshot_path() == str(
+        tmp_path / "m2kt-flight.json.mem")
+    costmodel.note_memory_report(costmodel.analyze_compiled(_CpuShaped()))
+    path = costmodel.write_memory_snapshot()
+    assert path is not None
+    doc = json.loads(open(path, encoding="utf-8").read())
+    assert doc["memory_analysis"]["args"] == 100
+    assert doc["peak_hbm_bytes"] == 170
+    assert "live_buffers" in doc
+    assert doc["pid"] == os.getpid()
+
+
+def test_supervisor_folds_memory_sidecar(tmp_path, monkeypatch):
+    """The flight recorder carries the child's memory snapshot under
+    ``memory`` — the OOM-postmortem half of the tentpole."""
+    from move2kube_tpu.resilience.supervisor import Supervisor
+
+    flight = tmp_path / "m2kt-flight.json"
+    monkeypatch.setenv("M2KT_FLIGHT_PATH", str(flight))
+    (tmp_path / "m2kt-flight.json.mem").write_text(json.dumps(
+        {"memory_analysis": {"args": 7}, "peak_hbm_bytes": 7}))
+    sup = Supervisor(["true"], max_retries=0)
+    sup._write_flight("FATAL", 137, 1, None)
+    doc = json.loads(flight.read_text())
+    assert doc["memory"]["peak_hbm_bytes"] == 7
+    assert doc["exit_class"] == "FATAL"

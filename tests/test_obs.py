@@ -760,11 +760,20 @@ def test_rules_emission_behind_qa_knob():
     [group] = pr["spec"]["groups"]
     alerts = {r["alert"]: r for r in group["rules"]}
     assert set(alerts) == {"M2KTGoodputLow", "M2KTStepTimeP95Regression",
-                           "M2KTRestartStorm"}  # trainer: no serving rule
+                           "M2KTRestartStorm", "M2KTMFULow",
+                           "M2KTHBMHeadroomLow"}  # trainer: no serving rule
     # k8s output bakes the literal defaults into the PromQL
     assert "< 0.5" in alerts["M2KTGoodputLow"]["expr"]
     assert "> 1.5 *" in alerts["M2KTStepTimeP95Regression"]["expr"]
     assert "> 3" in alerts["M2KTRestartStorm"]["expr"]
+    # PR 8 cost-model alerts: MFU floor guards against the unknown-MFU
+    # gauge value (0), headroom compares peak-HBM to the chip gauge
+    assert "< 0.05" in alerts["M2KTMFULow"]["expr"]
+    assert "m2kt_train_mfu" in alerts["M2KTMFULow"]["expr"]
+    assert "> 0" in alerts["M2KTMFULow"]["expr"]
+    assert "0.92 * m2kt_chip_hbm_bytes" in \
+        alerts["M2KTHBMHeadroomLow"]["expr"]
+    assert 'category="total"' in alerts["M2KTHBMHeadroomLow"]["expr"]
     # selector uses the relabeled (sanitized) pod label
     assert 'move2kube-tpu_io_service="trainer"' in \
         alerts["M2KTGoodputLow"]["expr"]
@@ -778,6 +787,8 @@ def test_rules_emission_behind_qa_knob():
     titles = {p["title"] for p in dash["panels"]}
     assert "Goodput fraction" in titles
     assert "Straggler score by host" in titles
+    assert "Achieved MFU" in titles
+    assert "Peak HBM by category" in titles
 
 
 def test_rules_gated_on_metrics_port():
