@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -126,6 +126,16 @@ mfu-smoke:
 	assert doc['verdict'] == 'fit', doc['verdict']; \
 	assert doc['drift']['within_tolerance'], doc['drift']; \
 	print('[mfu-smoke] drift %.2fx, mfu ceiling %s' % (doc['drift']['predicted_over_measured'], doc['estimated_mfu']['roofline_ceiling']))"
+
+# fleet serving in isolation (all CPU-mode): router affinity/failover/
+# hedging units, refcount+COW page-sharing invariants, prefix-hit and
+# disagg-handoff logit equivalence, per-role fleet manifest emission,
+# then the bench fleet phase (router + real engine replicas under a
+# zipfian multi-tenant replay; FAILS unless the prefix cache hits and
+# improves p95 TTFT over the uncached fleet)
+fleet-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_fleet.py -q
+	$(CPU_ENV) $(PY) bench.py --model fleet
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -67,7 +67,7 @@ WARM_FAST_S = float(os.environ.get("M2KT_BENCH_WARM_FAST_S", "3.0"))
 MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
 PHASES = ("resnet", "bert", "pallas", "llama", "translate", "goodput",
-          "scaling", "serving", "obs")
+          "scaling", "serving", "fleet", "obs")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
@@ -79,6 +79,7 @@ PHASE_METRICS = {
     "goodput": ("train_goodput_fraction_faulted", "fraction"),
     "scaling": ("multichip_scaling_efficiency_host8", "fraction"),
     "serving": ("decode_throughput_tokens_s", "tok/s"),
+    "fleet": ("fleet_p95_ttft_speedup_prefix_cache", "x"),
     "obs": ("telemetry_overhead_fraction", "fraction"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
@@ -984,6 +985,166 @@ def run_serving_probe() -> int:
     return 0
 
 
+def bench_fleet(n: int) -> dict:
+    """Fleet-serving phase on forced host devices: a zipfian multi-tenant
+    replay through the request router over real in-process engine
+    replicas, once with the refcounted prefix cache on and once with it
+    off. Reports the p95 TTFT speedup the cache buys on hits (the primary
+    number), plus tok/s and hit rate for both configurations. The phase
+    FAILS when the replay produces zero cache hits or the cached p95 TTFT
+    is not better — a prefix cache that doesn't pay for itself under a
+    skewed tenant mix is a regression, not a data point. Own subprocess
+    for the same reason as the serving phase: the probe must own jax's
+    platform env before import."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--fleet-probe"],
+        env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"fleet probe rc={res.returncode}: {res.stderr[-300:]}")
+    probe = json.loads(res.stdout.strip().splitlines()[-1])
+    dt = time.perf_counter() - t0
+    print(f"[bench] fleet x{probe['replicas']}: p95 TTFT "
+          f"{probe['p95_ttft_ms_cached']:.2f}ms cached vs "
+          f"{probe['p95_ttft_ms_uncached']:.2f}ms uncached "
+          f"(x{probe['p95_ttft_speedup']:.2f}, hit rate "
+          f"{probe['prefix_hit_rate']:.2f}), "
+          f"{probe['throughput_tok_s_cached']:.1f} vs "
+          f"{probe['throughput_tok_s_uncached']:.1f} tok/s in {dt:.1f}s",
+          file=sys.stderr)
+    metric, unit = PHASE_METRICS["fleet"]
+    return {"phase": "fleet", "metric": metric,
+            "value": probe["p95_ttft_speedup"], "unit": unit,
+            "vs_baseline": 0.0, "baseline": "none_published",
+            "replicas": probe["replicas"],
+            "requests": probe["requests"],
+            "tenants": probe["tenants"],
+            "prefix_hit_rate": probe["prefix_hit_rate"],
+            "p95_ttft_ms_cached": probe["p95_ttft_ms_cached"],
+            "p95_ttft_ms_uncached": probe["p95_ttft_ms_uncached"],
+            "p50_ttft_ms_cached": probe["p50_ttft_ms_cached"],
+            "p50_ttft_ms_uncached": probe["p50_ttft_ms_uncached"],
+            "throughput_tok_s_cached": probe["throughput_tok_s_cached"],
+            "throughput_tok_s_uncached": probe["throughput_tok_s_uncached"],
+            "affinity_hit_fraction": probe["affinity_hit_fraction"],
+            "wall_s": round(dt, 2)}
+
+
+def run_fleet_probe() -> int:
+    """In-process half of the fleet phase (spawned by bench_fleet with jax
+    forced onto host devices). Builds two router+replica fleets — prefix
+    cache on and off — replays the same zipfian multi-tenant stream
+    through each, and prints one JSON line."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.serving.engine import EngineConfig
+    from move2kube_tpu.serving.fleet.router import build_fleet
+
+    replicas = int(os.environ.get("M2KT_BENCH_FLEET_REPLICAS", "4"))
+    n_tenants = int(os.environ.get("M2KT_BENCH_FLEET_TENANTS", "8"))
+    n_requests = int(os.environ.get("M2KT_BENCH_FLEET_REQUESTS", "48"))
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+
+    rng = np.random.default_rng(7)
+    # tenant popularity is zipfian: a few hot system prompts dominate,
+    # the long tail barely repeats — the regime prefix caching targets.
+    # Prefixes are long (240 of a 256-token bucket) so prefill carries
+    # real compute; on host CPU a short-prompt prefill costs about the
+    # same as the 2-3 decode dispatches a hit pays, and the cache's win
+    # would drown in dispatch overhead.
+    prefixes = [rng.integers(1, cfg.vocab_size, size=240).tolist()
+                for _ in range(n_tenants)]
+    tenant_ids = np.minimum(rng.zipf(1.6, size=n_requests),
+                            n_tenants) - 1
+    prompts = [prefixes[t] + rng.integers(1, cfg.vocab_size,
+                                          size=2).tolist()
+               for t in tenant_ids]
+
+    def replay(prefix_cache: bool) -> dict:
+        # max_batch sizes the page pool (1 + max_batch * max_seq / bs):
+        # 4 slots leave room for the hot tenants' pages to stay resident
+        ecfg = EngineConfig(max_batch=4, max_seq=256, block_size=8,
+                            buckets=(256,), prefix_cache=prefix_cache)
+        router = build_fleet(model, variables, replicas,
+                             engine_config=ecfg)
+        try:
+            # warm pass: every replica compiles its own prefill/decode
+            # executables (a hedge or spill can land anywhere), then the
+            # full stream once to compile the hit/COW install path and
+            # pre-populate the cache — the timed pass measures steady
+            # state, not first-touch compilation
+            # max_new_tokens > 1 matters: a 1-token cold request finishes
+            # at prefill and never compiles the decode executable
+            for rep in router.replicas:
+                rep.generate(prompts[0][:10], max_new_tokens=8)
+            for p in prompts:
+                router.generate(list(p), max_new_tokens=8)
+            ttft_ms = []
+            for p in prompts:  # max_new_tokens=1: client latency IS TTFT
+                t = time.perf_counter()
+                router.generate(list(p), max_new_tokens=1)
+                ttft_ms.append((time.perf_counter() - t) * 1e3)
+            t = time.perf_counter()
+            toks = sum(len(router.generate(list(p), max_new_tokens=8)
+                           ["tokens"]) for p in prompts[:replicas * 4])
+            tput = toks / (time.perf_counter() - t)
+            hits = sum(r.engine.stats().get("prefix_hits", 0)
+                       for r in router.replicas)
+            misses = sum(r.engine.stats().get("prefix_misses", 0)
+                         for r in router.replicas)
+            return {"p50": float(np.percentile(ttft_ms, 50)),
+                    "p95": float(np.percentile(ttft_ms, 95)),
+                    "tput": tput,
+                    "hit_rate": hits / max(1, hits + misses),
+                    "affinity": router._affinity_hits.value}
+        finally:
+            for rep in router.replicas:
+                rep.close()
+
+    warm = replay(prefix_cache=True)
+    cold = replay(prefix_cache=False)
+    speedup = cold["p95"] / max(1e-9, warm["p95"])
+    assert warm["hit_rate"] > 0, "zipfian replay produced zero cache hits"
+    assert speedup > 1.0, (
+        f"prefix cache did not improve p95 TTFT: "
+        f"{warm['p95']:.2f}ms cached vs {cold['p95']:.2f}ms uncached")
+    total_routed = 2 * (2 * n_requests + replicas * 4)
+    print(json.dumps({
+        "replicas": replicas, "tenants": n_tenants,
+        "requests": n_requests,
+        "prefix_hit_rate": round(warm["hit_rate"], 3),
+        "p95_ttft_speedup": round(speedup, 3),
+        "p95_ttft_ms_cached": round(warm["p95"], 3),
+        "p95_ttft_ms_uncached": round(cold["p95"], 3),
+        "p50_ttft_ms_cached": round(warm["p50"], 3),
+        "p50_ttft_ms_uncached": round(cold["p50"], 3),
+        "throughput_tok_s_cached": round(warm["tput"], 1),
+        "throughput_tok_s_uncached": round(cold["tput"], 1),
+        "affinity_hit_fraction": round(
+            (warm["affinity"] + cold["affinity"]) / max(1, total_routed), 3),
+    }), flush=True)
+    return 0
+
+
 OBS_OVERHEAD_MAX = float(os.environ.get("M2KT_BENCH_OBS_OVERHEAD_MAX",
                                         "0.03"))
 
@@ -1205,7 +1366,7 @@ def run_child(phases: list[str]) -> int:
            "pallas": bench_pallas, "llama": bench_llama,
            "translate": bench_translate, "goodput": bench_goodput,
            "scaling": bench_scaling, "serving": bench_serving,
-           "obs": bench_obs}
+           "fleet": bench_fleet, "obs": bench_obs}
     ok = True
     for phase in phases:
         try:
@@ -1514,6 +1675,10 @@ def main() -> int:
     parser.add_argument("--serving-probe", action="store_true",
                         help="internal: continuous-batching decode "
                              "measurement (spawned by the serving phase)")
+    parser.add_argument("--fleet-probe", action="store_true",
+                        help="internal: router + prefix-cache zipfian "
+                             "replay measurement (spawned by the fleet "
+                             "phase)")
     parser.add_argument("--obs-probe", action="store_true",
                         help="internal: telemetry overhead + exposition "
                              "scrape measurement (spawned by the obs phase)")
@@ -1522,6 +1687,8 @@ def main() -> int:
         return run_scaling_probe()
     if args.serving_probe:
         return run_serving_probe()
+    if args.fleet_probe:
+        return run_fleet_probe()
     if args.obs_probe:
         return run_obs_probe()
     if args.child:

@@ -264,10 +264,19 @@ class DeploymentAPIResource(APIResource):
 
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         objs = []
+        from move2kube_tpu.apiresource import fleet_wiring
+
         for svc in ir.services.values():
             if svc.only_ingress or not svc.containers:
                 continue
-            objs.append(self._create_workload(svc, supported_kinds))
+            # fleet-mode serving fans out into per-role workloads
+            # (router / prefill / decode) instead of one Deployment;
+            # podmonitor/rules/coord objects ride along either way
+            fleet = fleet_wiring.maybe_fleet_objects(self, svc)
+            if fleet is not None:
+                objs.extend(fleet)
+            else:
+                objs.append(self._create_workload(svc, supported_kinds))
             pm = self._maybe_podmonitor(svc, ir)
             if pm:
                 objs.append(pm)

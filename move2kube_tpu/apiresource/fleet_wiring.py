@@ -1,0 +1,319 @@
+"""Fleet-serving emission wiring shared by the workload emitters.
+
+One serving IR service can fan out into a *fleet*: a CPU-only request
+router fronting N decode replicas (each with its refcounted prefix
+cache — serving/fleet/) and, optionally, dedicated prefill replicas for
+disaggregated long prompts. This module is the single owner of that
+fan-out so the Deployment path (``apiresource/deployment.py``) and the
+Knative path (``apiresource/knative.py``) emit the same roles, env
+contract, and autoscaling targets:
+
+- :func:`fleet_knobs` — the ``m2kt.services.<name>.serve.fleet.*`` QA
+  problems (env wins: ``M2KT_FLEET`` / ``M2KT_FLEET_ROUTERS`` /
+  ``M2KT_FLEET_PREFILL`` / ``M2KT_FLEET_DECODE`` /
+  ``M2KT_FLEET_AFFINITY_SALT``), asked once and cached so the optimizer
+  pass baking the pod env, the parameterizer lifting it into chart
+  values, and the emitters sizing the role workloads cannot disagree;
+- :func:`role_service` — clones the IR service into one role
+  (``router`` / ``prefill`` / ``decode``) with ``M2KT_FLEET_ROLE`` set
+  and the router stripped of TPU resources (it never touches a chip);
+- :func:`fleet_objects` — per-role Deployments, headless role Services
+  (the router enumerates backend *pod* IPs for session affinity — a
+  ClusterIP VIP would re-balance every request and destroy cache
+  locality), and autoscaling/v2 HPAs on the serving gauges: router and
+  prefill scale on ``m2kt_serve_queue_depth``, decode on
+  ``m2kt_serve_slot_occupancy``.
+
+The emitted serve template (assets/jax/serve_tpu.py) dispatches on
+``M2KT_FLEET_ROLE`` at runtime; the front k8s Service keeps selecting
+``SELECTOR_LABEL: <name>`` and only router pods carry that label, so
+external traffic enters through the router without any Service edits.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+
+from move2kube_tpu.apiresource.base import make_obj
+from move2kube_tpu.types.ir import Service
+from move2kube_tpu.utils.log import get_logger
+
+log = get_logger("apiresource.fleetwiring")
+
+ROLE_LABEL = "move2kube-tpu.io/role"
+ROUTER_ROLE = "router"
+PREFILL_ROLE = "prefill"
+DECODE_ROLE = "decode"
+
+# gauges exported by the serving engine (serving/engine.py) that the
+# per-role HPAs target; names asserted by tests/test_fleet.py
+QUEUE_DEPTH_METRIC = "m2kt_serve_queue_depth"
+SLOT_OCCUPANCY_METRIC = "m2kt_serve_slot_occupancy"
+
+
+def _int_env(var: str) -> int | None:
+    raw = os.environ.get(var, "")
+    if not raw:
+        return None
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        log.warning("bad %s=%r; ignoring", var, raw)
+        return None
+
+
+def fleet_knobs(svc_name: str) -> dict | None:
+    """Resolve the fleet topology for a serving service, or None when
+    fleet mode is off. Env wins (CI / one-off overrides); otherwise each
+    knob is a QA problem under ``m2kt.services.<name>.serve.fleet.*`` —
+    the SAME ids the fleet optimizer pass and both workload emitters
+    ask, so one cached answer keeps the baked pod env, the chart values,
+    and the role replica counts agreed."""
+    from move2kube_tpu import qa
+    from move2kube_tpu.utils import common
+
+    name = common.make_dns_label(svc_name)
+    raw = os.environ.get("M2KT_FLEET", "")
+    if raw in ("0", "1"):
+        enabled = raw == "1"
+    else:
+        enabled = qa.fetch_bool(
+            f"m2kt.services.{name}.serve.fleet",
+            f"Serve [{name}] as a fleet (router + replicated engines)?",
+            ["Emits one workload per role — a prefix-affine request "
+             "router fronting N decode replicas with refcounted prefix "
+             "caching, plus optional disaggregated prefill replicas; "
+             "override via M2KT_FLEET"],
+            False)
+    if not enabled:
+        return None
+    counts = {}
+    for key, env_var, qid, desc, default in (
+        ("routers", "M2KT_FLEET_ROUTERS", "serve.fleet.routers",
+         "Router replicas for [{name}]", "1"),
+        ("prefill", "M2KT_FLEET_PREFILL", "serve.fleet.prefill",
+         "Dedicated prefill replicas for [{name}] (0 = no "
+         "disaggregation)", "0"),
+        ("decode", "M2KT_FLEET_DECODE", "serve.fleet.decode",
+         "Decode engine replicas for [{name}]", "2"),
+    ):
+        value = _int_env(env_var)
+        if value is None:
+            answer = qa.fetch_input(
+                f"m2kt.services.{name}.{qid}", desc.format(name=name),
+                [f"override via {env_var}"], default)
+            try:
+                value = max(0, int(answer))
+            except (TypeError, ValueError):
+                log.warning("invalid %s answer %r for %s; using %s",
+                            qid, answer, name, default)
+                value = int(default)
+        counts[key] = value
+    counts["routers"] = max(1, counts["routers"])
+    counts["decode"] = max(1, counts["decode"])
+    salt = os.environ.get("M2KT_FLEET_AFFINITY_SALT", "")
+    if not salt:
+        salt = str(qa.fetch_input(
+            f"m2kt.services.{name}.serve.fleet.salt",
+            f"Affinity salt for [{name}]'s prefix-hash routing",
+            ["Mixed into the rendezvous hash so tenant->replica "
+             "placement reshuffles on demand; override via "
+             "M2KT_FLEET_AFFINITY_SALT"],
+            "") or "")
+    counts["salt"] = salt
+    return counts
+
+
+def _serving_port(svc: Service) -> int:
+    acc = svc.accelerator
+    port = getattr(acc, "serving_port", 0) or 0
+    if not port:
+        for c in svc.containers:
+            for p in c.get("ports", []) or []:
+                if p.get("name") != "metrics" and p.get("containerPort"):
+                    return int(p["containerPort"])
+    return int(port) or 8080
+
+
+def _set_env(container: dict, name: str, value: str) -> None:
+    env = container.setdefault("env", [])
+    for e in env:
+        if e.get("name") == name:
+            e["value"] = value
+            return
+    env.append({"name": name, "value": value})
+
+
+def role_service(svc: Service, role: str, knobs: dict) -> Service:
+    """Clone the IR service into one fleet role. The clone's name is
+    ``<name>-<role>``; its containers carry ``M2KT_FLEET_ROLE`` plus the
+    role's wiring env. The router clone drops the accelerator entirely —
+    it is a stdlib-HTTP process that must schedule on ordinary nodes,
+    so TPU requests, node selectors and tolerations all go."""
+    clone = copy.deepcopy(svc)
+    clone.name = f"{svc.name}-{role}"
+    clone.backend_service_name = ""
+    clone.subdomain = ""
+    port = _serving_port(svc)
+    for c in clone.containers:
+        _set_env(c, "M2KT_FLEET_ROLE", role)
+        if role == ROUTER_ROLE:
+            _set_env(c, "M2KT_ROUTER_BACKENDS",
+                     f"{svc.name}-{DECODE_ROLE}:{port}")
+            if knobs.get("prefill", 0) > 0:
+                _set_env(c, "M2KT_FLEET_PREFILL_SERVICE",
+                         f"{svc.name}-{PREFILL_ROLE}:{port}")
+            if knobs.get("salt"):
+                _set_env(c, "M2KT_FLEET_AFFINITY_SALT", str(knobs["salt"]))
+            c.get("resources", {}).get("limits", {}).pop(
+                "google.com/tpu", None)
+            c.get("resources", {}).get("requests", {}).pop(
+                "google.com/tpu", None)
+        elif role == DECODE_ROLE:
+            # decode replicas own the refcounted prefix cache; the
+            # router's session affinity only pays off if it is on
+            _set_env(c, "M2KT_SERVE_PREFIX_CACHE", "1")
+    if role == ROUTER_ROLE:
+        clone.accelerator = None
+        clone.node_selector = {
+            k: v for k, v in clone.node_selector.items()
+            if not k.startswith("cloud.google.com/gke-tpu")}
+        clone.tolerations = [
+            t for t in clone.tolerations
+            if t.get("key") != "google.com/tpu"]
+    replicas = {ROUTER_ROLE: knobs.get("routers", 1),
+                PREFILL_ROLE: knobs.get("prefill", 0),
+                DECODE_ROLE: knobs.get("decode", 2)}[role]
+    clone.replicas = max(1, int(replicas))
+    return clone
+
+
+def fleet_roles(knobs: dict) -> list[str]:
+    roles = [ROUTER_ROLE]
+    if knobs.get("prefill", 0) > 0:
+        roles.append(PREFILL_ROLE)
+    roles.append(DECODE_ROLE)
+    return roles
+
+
+def role_headless_service(svc: Service, role: str, selector_label: str,
+                          port: int) -> dict:
+    """Headless Service for a backend role: DNS on ``<name>-<role>``
+    answers with the *pod* IPs, which is what the router's rendezvous
+    hashing needs — a ClusterIP VIP would pick a random pod per request
+    and the prefix caches would never warm."""
+    name = f"{svc.name}-{role}"
+    obj = make_obj("Service", "v1", name, {selector_label: svc.name,
+                                           ROLE_LABEL: role})
+    obj["spec"] = {
+        "clusterIP": "None",
+        "selector": {selector_label: name},
+        "ports": [{"name": "serve", "port": port}],
+    }
+    return obj
+
+
+def role_hpa(svc: Service, role: str, replicas: int) -> dict:
+    """autoscaling/v2 HPA for one role. Router and prefill scale on the
+    queue building in front of them (``m2kt_serve_queue_depth``); decode
+    scales on batch-slot saturation (``m2kt_serve_slot_occupancy`` is
+    0..1, target 70%) — the gauges the engines already export through
+    the scraped registry, surfaced to the HPA by any prometheus-adapter
+    style metrics pipeline."""
+    name = f"{svc.name}-{role}"
+    if role == DECODE_ROLE:
+        metric, target = SLOT_OCCUPANCY_METRIC, "700m"
+    else:
+        metric, target = QUEUE_DEPTH_METRIC, "4"
+    obj = make_obj("HorizontalPodAutoscaler", "autoscaling/v2", name,
+                   {ROLE_LABEL: role})
+    obj["spec"] = {
+        "scaleTargetRef": {"apiVersion": "apps/v1", "kind": "Deployment",
+                           "name": name},
+        "minReplicas": max(1, int(replicas)),
+        "maxReplicas": max(2, int(replicas) * 4),
+        "metrics": [{
+            "type": "Pods",
+            "pods": {
+                "metric": {"name": metric},
+                "target": {"type": "AverageValue",
+                           "averageValue": target},
+            },
+        }],
+    }
+    return obj
+
+
+def knative_autoscaling_annotations(role: str, replicas: int) -> dict:
+    """Knative revision annotations for one role: the HPA autoscaler
+    class pointed at the same serving gauges as the Deployment path's
+    HPAs (the KPA only understands concurrency/RPS — the decode
+    engine's real saturation signal is its slot occupancy)."""
+    if role == DECODE_ROLE:
+        metric, target = SLOT_OCCUPANCY_METRIC, "0.7"
+    else:
+        metric, target = QUEUE_DEPTH_METRIC, "4"
+    return {
+        "autoscaling.knative.dev/class": "hpa.autoscaling.knative.dev",
+        "autoscaling.knative.dev/metric": metric,
+        "autoscaling.knative.dev/target": target,
+        "autoscaling.knative.dev/minScale": str(max(1, int(replicas))),
+    }
+
+
+def maybe_fleet_objects(deployer, svc: Service) -> list[dict] | None:
+    """The Deployment path's fleet fan-out: per-role Deployments (built
+    by the caller's ``_create_deployment`` so pod templates, probes and
+    scrape annotations stay single-owner), headless role Services for
+    the backend roles, and one HPA per role. Returns None when the
+    service is not a fleet-mode serving service — the caller then emits
+    its usual single workload."""
+    acc = svc.accelerator
+    if acc is None or not getattr(acc, "serving", False) or svc.job:
+        return None
+    knobs = fleet_knobs(svc.name)
+    if knobs is None:
+        return None
+    from move2kube_tpu.apiresource.deployment import (
+        DEPLOYMENT,
+        SELECTOR_LABEL,
+        _tpu_resources,
+    )
+
+    port = _serving_port(svc)
+    objs: list[dict] = []
+    for role in fleet_roles(knobs):
+        clone = role_service(svc, role, knobs)
+        if role != ROUTER_ROLE:
+            _tpu_resources(clone, DEPLOYMENT)
+            clone.subdomain = ""  # role DNS comes from the role Service
+        labels = {SELECTOR_LABEL: clone.name, ROLE_LABEL: role,
+                  **svc.labels}
+        if role == ROUTER_ROLE:
+            # the front Service selects SELECTOR_LABEL: <name>; only
+            # router pods may carry it or external traffic would skip
+            # the router and land on a random engine
+            labels[SELECTOR_LABEL] = svc.name
+        dep = deployer._create_deployment(clone, labels)
+        dep["spec"]["selector"] = {"matchLabels": {
+            SELECTOR_LABEL: labels[SELECTOR_LABEL], ROLE_LABEL: role}}
+        if role == ROUTER_ROLE:
+            # no telemetry-port /readyz here (that probe is serving-only
+            # and keyed on the accelerator); the router's own HTTP front
+            # serves /readyz on the traffic port, 503 until a backend is up
+            containers = dep["spec"]["template"]["spec"].get(
+                "containers", [])
+            if containers:
+                containers[0].setdefault("readinessProbe", {
+                    "httpGet": {"path": "/readyz", "port": port},
+                    "periodSeconds": 10,
+                })
+        objs.append(dep)
+        if role != ROUTER_ROLE:
+            objs.append(role_headless_service(
+                svc, role, SELECTOR_LABEL, port))
+        objs.append(role_hpa(svc, role, clone.replicas))
+    log.info("%s: fleet mode — %d objects across roles (%s)", svc.name,
+             len(objs), ", ".join(fleet_roles(knobs)))
+    return objs

@@ -108,68 +108,96 @@ class KnativeServiceAPIResource(APIResource):
     def create_new_resources(self, ir: IR, supported_kinds: set[str]) -> list[dict]:
         if not self.create:
             return []  # k8s output mode: conversion of cached objects only
+        from move2kube_tpu.apiresource import fleet_wiring, obs_wiring
+
         objs = []
         for svc in ir.services.values():
             if not svc.containers or svc.job:
                 continue  # knative serves long-running HTTP, not batch jobs
-            pod_spec = svc.pod_spec()
-            # knative revisions are restarted by the autoscaler; parity:
-            # knativeservice.go:46 pins RestartPolicy Always
-            pod_spec["restartPolicy"] = "Always"
-            # knative revision schema has no subdomain (that's the JobSet
-            # pod-DNS mechanism); drop it rather than fail validation
-            pod_spec.pop("subdomain", None)
-            # knative validates at most ONE containerPort (the traffic
-            # port); the named metrics port the obs optimizer added must
-            # not reach the revision — the scrape annotation carries the
-            # port number and Prometheus scrapes the pod IP directly
-            for c in pod_spec.get("containers", []) or []:
-                ports = c.get("ports") or []
-                kept = [p for p in ports if p.get("name") != "metrics"]
-                if len(kept) != len(ports):
-                    c["ports"] = kept
-            labels = {"app": svc.name, **svc.labels}
-            obj = make_obj("Service", f"{KNATIVE_GROUP}/v1", svc.name, labels)
-            if svc.annotations:
-                obj["metadata"]["annotations"] = dict(svc.annotations)
-            template: dict = {"spec": pod_spec}
-            tmpl_annotations: dict = {}
-            if svc.accelerator is not None:
-                # TPU serving service: chip requests + placement on the
-                # revision, and concurrency matched to the decode engine's
-                # max batch so the autoscaler scales on batch saturation
-                _tpu_pod_resources(svc, pod_spec)
-                concurrency = _serving_concurrency(svc)
-                pod_spec["containerConcurrency"] = concurrency
-                tmpl_annotations.update({
-                    "autoscaling.knative.dev/metric": "concurrency",
-                    "autoscaling.knative.dev/target": str(concurrency),
-                })
-            # telemetry-enabled revisions advertise the scrape target —
-            # Prometheus scrapes the pod IP directly, so the telemetry
-            # port needs no Knative routing (queue-proxy only fronts the
-            # serving port)
-            from move2kube_tpu.apiresource import obs_wiring
-
-            tmpl_annotations.update(obs_wiring.scrape_annotations(svc))
-            if obs_wiring.readiness_probe(svc) is not None:
-                # knative probes may only target the traffic port, not the
-                # telemetry port where /readyz lives — the serve template's
-                # own /healthz 503s until the engine is warm, which is the
-                # same gate the Deployment path reads from /readyz
-                for c in pod_spec.get("containers", []) or []:
-                    c.setdefault("readinessProbe",
-                                 {"httpGet": {"path": "/healthz"}})
-                    break
-            if tmpl_annotations:
-                template["metadata"] = {"annotations": tmpl_annotations}
-            obj["spec"] = {"template": template}
-            objs.append(obj)
+            acc = svc.accelerator
+            knobs = (fleet_wiring.fleet_knobs(svc.name)
+                     if acc is not None and getattr(acc, "serving", False)
+                     else None)
+            if knobs is not None:
+                # fleet mode: one knative Service (= one revision line)
+                # per role, each pinned to the HPA autoscaler class so
+                # it scales on the engine gauges instead of concurrency
+                for role in fleet_wiring.fleet_roles(knobs):
+                    clone = fleet_wiring.role_service(svc, role, knobs)
+                    objs.append(self._knative_service(
+                        clone,
+                        fleet_wiring.knative_autoscaling_annotations(
+                            role, clone.replicas)))
+            else:
+                objs.append(self._knative_service(svc, None))
             # alert rules + dashboard ride along with the knative Service
             # too (same QA knob); revision pod labels carry "app", so the
             # PromQL selector keys off that instead of the JobSet label
             objs.extend(obs_wiring.maybe_rules_objects(svc, ir, "app"))
         return objs
+
+    @staticmethod
+    def _knative_service(svc, autoscale_annotations: dict | None) -> dict:
+        """One knative Service from one IR service (or fleet-role
+        clone). ``autoscale_annotations`` overrides the default
+        concurrency-based KPA annotations — fleet roles pass the
+        hpa-class annotations targeting the serving gauges."""
+        from move2kube_tpu.apiresource import obs_wiring
+
+        pod_spec = svc.pod_spec()
+        # knative revisions are restarted by the autoscaler; parity:
+        # knativeservice.go:46 pins RestartPolicy Always
+        pod_spec["restartPolicy"] = "Always"
+        # knative revision schema has no subdomain (that's the JobSet
+        # pod-DNS mechanism); drop it rather than fail validation
+        pod_spec.pop("subdomain", None)
+        # knative validates at most ONE containerPort (the traffic
+        # port); the named metrics port the obs optimizer added must
+        # not reach the revision — the scrape annotation carries the
+        # port number and Prometheus scrapes the pod IP directly
+        for c in pod_spec.get("containers", []) or []:
+            ports = c.get("ports") or []
+            kept = [p for p in ports if p.get("name") != "metrics"]
+            if len(kept) != len(ports):
+                c["ports"] = kept
+        labels = {"app": svc.name, **svc.labels}
+        obj = make_obj("Service", f"{KNATIVE_GROUP}/v1", svc.name, labels)
+        if svc.annotations:
+            obj["metadata"]["annotations"] = dict(svc.annotations)
+        template: dict = {"spec": pod_spec}
+        tmpl_annotations: dict = {}
+        if svc.accelerator is not None:
+            # TPU serving service: chip requests + placement on the
+            # revision, and concurrency matched to the decode engine's
+            # max batch so the autoscaler scales on batch saturation
+            _tpu_pod_resources(svc, pod_spec)
+            concurrency = _serving_concurrency(svc)
+            pod_spec["containerConcurrency"] = concurrency
+            tmpl_annotations.update({
+                "autoscaling.knative.dev/metric": "concurrency",
+                "autoscaling.knative.dev/target": str(concurrency),
+            })
+        if autoscale_annotations:
+            tmpl_annotations.update(autoscale_annotations)
+        # telemetry-enabled revisions advertise the scrape target —
+        # Prometheus scrapes the pod IP directly, so the telemetry
+        # port needs no Knative routing (queue-proxy only fronts the
+        # serving port)
+        tmpl_annotations.update(obs_wiring.scrape_annotations(svc))
+        if (obs_wiring.readiness_probe(svc) is not None
+                or autoscale_annotations is not None):
+            # knative probes may only target the traffic port, not the
+            # telemetry port where /readyz lives — the serve template's
+            # own /healthz 503s until the engine is warm, which is the
+            # same gate the Deployment path reads from /readyz
+            for c in pod_spec.get("containers", []) or []:
+                c.setdefault("readinessProbe",
+                             {"httpGet": {"path": "/healthz"}})
+                break
+        if tmpl_annotations:
+            template["metadata"] = {"annotations": tmpl_annotations}
+        obj["spec"] = {"template": template}
+        return obj
 
     def _supported_on(self, cluster) -> set[str]:
         if not cluster.api_kind_version_map:

@@ -40,8 +40,10 @@ _ASSETS = os.path.join(_PKG_ROOT, "assets", "jax")
 # preemption/supervisor/goodput stack the image's entrypoint runs under.
 # "obs" is the stdlib-only telemetry plane (Prometheus exposition +
 # /profile endpoint) both entrypoints serve on M2KT_METRICS_PORT.
+# "serving/fleet" rides along explicitly — the vendoring walk below is a
+# flat listdir per entry, not recursive.
 VENDORED_SUBPACKAGES = ("models", "parallel", "ops", "native", "resilience",
-                        "serving", "obs")
+                        "serving", "serving/fleet", "obs")
 
 REQUIREMENTS = """jax[tpu]>=0.4.35
 flax

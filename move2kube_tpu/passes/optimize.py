@@ -209,6 +209,42 @@ def tpu_serving_optimizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_fleet_optimizer(ir: IR) -> IR:
+    """Bake the fleet-serving knobs into accelerated serving services'
+    pod env. Delegates to ``apiresource.fleet_wiring.fleet_knobs`` — the
+    SAME QA ids (``m2kt.services.<name>.serve.fleet`` / ``.routers`` /
+    ``.prefill`` / ``.decode`` / ``.salt``) the per-role workload
+    emitters ask, answered once and cached, so the pod env, the chart
+    values, and the role replica counts cannot disagree. Also turns the
+    prefix cache on (``M2KT_SERVE_PREFIX_CACHE``): the router's session
+    affinity only pays off when the engines keep their caches."""
+    from move2kube_tpu.apiresource.fleet_wiring import fleet_knobs
+
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None or not getattr(acc, "serving", False):
+            continue
+        knobs = fleet_knobs(svc.name)
+        if knobs is None:
+            continue
+        entries = [
+            ("M2KT_FLEET", "1"),
+            ("M2KT_FLEET_ROUTERS", str(knobs["routers"])),
+            ("M2KT_FLEET_PREFILL", str(knobs["prefill"])),
+            ("M2KT_FLEET_DECODE", str(knobs["decode"])),
+            ("M2KT_SERVE_PREFIX_CACHE", "1"),
+        ]
+        if knobs.get("salt"):
+            entries.append(("M2KT_FLEET_AFFINITY_SALT", str(knobs["salt"])))
+        for container in svc.containers:
+            env = container.setdefault("env", [])
+            existing = {e.get("name") for e in env}
+            for env_name, value in entries:
+                if env_name not in existing:
+                    env.append({"name": env_name, "value": value})
+    return ir
+
+
 def tpu_elastic_optimizer(ir: IR) -> IR:
     """Bake the elastic-restart knobs into multislice training services'
     pod env (``M2KT_ELASTIC`` / ``M2KT_ELASTIC_MIN_SLICES``).
@@ -313,6 +349,7 @@ OPTIMIZERS = [
     port_merge_optimizer,
     tpu_training_optimizer,
     tpu_serving_optimizer,
+    tpu_fleet_optimizer,
     tpu_elastic_optimizer,
     tpu_observability_optimizer,
     tpu_planreport_optimizer,

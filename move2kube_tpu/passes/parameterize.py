@@ -100,6 +100,34 @@ def tpu_serving_parameterizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_fleet_parameterizer(ir: IR) -> IR:
+    """Lift the fleet-serving knobs the fleet optimizer injected
+    (``M2KT_FLEET`` / role replica counts / affinity salt) into chart
+    values, so a Helm install resizes the fleet or reshuffles the
+    tenant->replica placement per environment
+    (``--set tpufleetdecode=8 --set tpufleetsalt=blue``) without
+    touching the manifests. Same first-service-seeds-defaults shape as
+    the serving parameterizer."""
+    lifted = {"M2KT_FLEET": "tpufleet",
+              "M2KT_FLEET_ROUTERS": "tpufleetrouters",
+              "M2KT_FLEET_PREFILL": "tpufleetprefill",
+              "M2KT_FLEET_DECODE": "tpufleetdecode",
+              "M2KT_FLEET_AFFINITY_SALT": "tpufleetsalt"}
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None or not getattr(acc, "serving", False):
+            continue
+        for container in svc.containers:
+            for env in container.get("env", []) or []:
+                key = lifted.get(env.get("name"))
+                value = env.get("value")
+                if not key or value is None or "{{" in str(value):
+                    continue
+                ir.values.global_variables.setdefault(key, str(value))
+                env["value"] = f"{{{{ .Values.{key} }}}}"
+    return ir
+
+
 def tpu_elastic_parameterizer(ir: IR) -> IR:
     """Lift the elastic-restart knobs the elastic optimizer / JobSet
     emitter injected (``M2KT_ELASTIC`` / ``M2KT_ELASTIC_MIN_SLICES``)
@@ -181,7 +209,8 @@ def tpu_rules_parameterizer(ir: IR) -> IR:
 
 PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
                   storage_class_parameterizer, tpu_training_parameterizer,
-                  tpu_serving_parameterizer, tpu_elastic_parameterizer,
+                  tpu_serving_parameterizer, tpu_fleet_parameterizer,
+                  tpu_elastic_parameterizer,
                   tpu_obs_parameterizer, tpu_rules_parameterizer]
 
 

@@ -10,7 +10,10 @@ the translated decoder LMs fast to serve:
   device-resident;
 - :mod:`move2kube_tpu.serving.engine` — continuous batching: admit and
   finish sequences mid-flight, interleave prefill with decode, bucket
-  prompt lengths so the compiled-executable count stays bounded.
+  prompt lengths so the compiled-executable count stays bounded;
+- :mod:`move2kube_tpu.serving.fleet` — the layer above one engine:
+  request router with prefix-hash session affinity, refcounted
+  copy-on-write prefix cache, and disaggregated prefill/decode.
 
 Vendored into emitted serving images alongside ``models``/``ops`` —
 keep it free of imports on the QA/YAML half of the repo.

@@ -16,6 +16,17 @@ executables; the serve smoke asserts ``<= num_buckets + 2`` through the
 persistent compile cache (models/compile_cache.py) to leave headroom for
 one backend-initiated recompile.
 
+Prefix-cache hits (serving/fleet/prefixcache.py, ``prefix_cache=True``)
+skip the bucketed prefill entirely: the shared prefix's pages are
+installed into the slot's block table by table surgery (one jitted
+install + at most one copy-on-write page copy), and the prompt's
+*suffix* tokens are force-fed one per decode step — argmax outputs are
+discarded while forced tokens remain, so the first generated token
+comes from exactly the same logits the uncached path would have
+computed. Hits are only taken when the suffix is short (default
+``2 * block_size`` tokens); longer misses prefill cold and donate their
+prompt pages to the cache for the next request.
+
 Env knobs (docs/USAGE.md):
 
 - ``M2KT_SERVE_MAX_BATCH``  concurrent decode slots   (default 8)
@@ -23,6 +34,12 @@ Env knobs (docs/USAGE.md):
 - ``M2KT_KV_BLOCK_SIZE``    tokens per KV-cache page  (default 16)
 - ``M2KT_SERVE_BUCKETS``    prefill buckets, comma-sep (default: powers
   of two from 32 up to max_seq)
+- ``M2KT_SERVE_ADMIT_BURST`` admissions per step; <= 0 = all free
+  slots (default 1)
+- ``M2KT_SERVE_PREFIX_CACHE`` enable cross-request prefix sharing
+  (default off)
+- ``M2KT_PREFIX_MAX_SUFFIX`` longest un-cached suffix a hit may
+  decode-feed before falling back to cold prefill (default 2 pages)
 """
 
 from __future__ import annotations
@@ -40,10 +57,13 @@ import numpy as np
 from move2kube_tpu.obs import tracing
 from move2kube_tpu.obs.metrics import Registry
 from move2kube_tpu.serving import kvcache
+from move2kube_tpu.serving.fleet.prefixcache import PrefixCache, PrefixHit
 from move2kube_tpu.serving.kvcache import (
     NULL_PAGE,
     PageAllocator,
+    copy_page,
     init_cache,
+    install_block_table,
     pages_for,
     scatter_prefill,
     spec_for_model,
@@ -73,6 +93,9 @@ class EngineConfig:
     buckets: tuple[int, ...] = ()
     max_new_tokens: int = 32   # per-request default
     eos_id: int | None = None
+    admit_burst: int = 1       # admissions per step; <= 0 = all free slots
+    prefix_cache: bool = False
+    prefix_max_suffix: int = 0  # 0 -> 2 * block_size
 
     def resolved_buckets(self) -> tuple[int, ...]:
         buckets = self.buckets or _default_buckets(self.max_seq)
@@ -101,6 +124,11 @@ class EngineConfig:
             max_seq=_int("M2KT_SERVE_MAX_SEQ", cls.max_seq),
             block_size=_int("M2KT_KV_BLOCK_SIZE", cls.block_size),
             buckets=buckets,
+            admit_burst=_int("M2KT_SERVE_ADMIT_BURST", cls.admit_burst),
+            prefix_cache=os.environ.get(
+                "M2KT_SERVE_PREFIX_CACHE", "").lower() in ("1", "true", "on"),
+            prefix_max_suffix=_int("M2KT_PREFIX_MAX_SUFFIX",
+                                   cls.prefix_max_suffix),
         )
         cfg.update(overrides)
         return cls(**cfg)
@@ -128,6 +156,10 @@ class _Slot:
     tokens: list[int]
     last_token: int
     max_new: int
+    # prompt suffix a prefix-cache hit still owes the cache: fed one
+    # token per decode step; argmax output is discarded until empty
+    pending: list[int] = dataclasses.field(default_factory=list)
+    prefix_hit: bool = False
 
 
 class ServingEngine:
@@ -155,6 +187,15 @@ class ServingEngine:
         self._pending: deque[Request] = deque()
         self._prefill = self._make_prefill()
         self._decode = self._make_decode()
+        self._install, self._copy, self._install_kv = self._make_table_ops()
+        self._prefix: PrefixCache | None = None
+        if self.config.prefix_cache:
+            self._prefix = PrefixCache(self.cache_cfg.block_size,
+                                       self._allocator)
+        # opt-in logit capture for the equivalence gates: per-rid rows of
+        # the logits each *generated* token was argmaxed from
+        self.capture_logits = False
+        self.logit_log: dict[str, list[np.ndarray]] = {}
         # decode stats for the bench phase (tokens/s, p50/p95 per token)
         self._decode_time = 0.0
         self._decode_tokens = 0
@@ -206,6 +247,21 @@ class ServingEngine:
             "m2kt_serve_decode_steps_total", "Decode steps executed")
         self._tokens_total = reg.counter(
             "m2kt_serve_decode_tokens_total", "Tokens generated")
+        self._prefix_hits = reg.counter(
+            "m2kt_serve_prefix_hits_total",
+            "Admissions served from the prefix cache (no prefill)")
+        self._prefix_misses = reg.counter(
+            "m2kt_serve_prefix_misses_total",
+            "Admissions that ran a cold prefill")
+        self._prefix_hit_tokens = reg.counter(
+            "m2kt_serve_prefix_hit_tokens_total",
+            "Prompt tokens whose K/V came from shared pages")
+        self._cow_copies = reg.counter(
+            "m2kt_serve_cow_copies_total",
+            "Shared pages copy-on-written before a slot's first write")
+        self._prefix_pages = reg.gauge(
+            "m2kt_serve_prefix_cache_pages",
+            "KV pages currently pinned by the prefix cache")
         self._total_pages = max(1, self.cache_cfg.num_pages - 1)  # page 0 reserved
         self._update_occupancy()
 
@@ -216,6 +272,8 @@ class ServingEngine:
         self._slot_occupancy.set(active / max(1, self.config.max_batch))
         self._page_util.set(
             1.0 - self._allocator.available / self._total_pages)
+        if self._prefix is not None:
+            self._prefix_pages.set(self._prefix.total_pages)
 
     # ------------------------------------------------------------------
     # jitted device steps (the ONLY code that runs on the accelerator)
@@ -258,6 +316,28 @@ class ServingEngine:
 
         return decode
 
+    def _make_table_ops(self):
+        """Three small donated steps for admissions that skip prefill:
+        block-table install (prefix hit), copy-on-write page copy, and
+        the disagg-side K/V scatter. They compile lazily — an engine
+        that never shares pages never builds them."""
+        block_size = self.cache_cfg.block_size
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def install(cache, slot, bt_row, seq_len):
+            return install_block_table(cache, slot, bt_row, seq_len)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def copy(cache, src, dst):
+            return copy_page(cache, src, dst)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def install_kv(cache, kvs, bt_row, slot, prompt_len):
+            return scatter_prefill(cache, kvs, slot, bt_row, prompt_len,
+                                   block_size)
+
+        return install, copy, install_kv
+
     # ------------------------------------------------------------------
     # host-side continuous batching
     # ------------------------------------------------------------------
@@ -292,31 +372,55 @@ class ServingEngine:
             s is not None for s in self._slots)
 
     def step(self) -> list[Completion]:
-        """One engine iteration: admit at most one pending request
-        (bucketed prefill), then run one decode step for every active
-        slot. Returns the sequences that finished this iteration."""
-        finished = self._admit_one()
+        """One engine iteration: admit pending requests into free slots
+        (up to ``admit_burst``; bucketed prefill, or block-table install
+        on a prefix-cache hit), then run one decode step for every
+        active slot. Returns the sequences that finished this
+        iteration."""
+        finished = self._admit_pending()
         active_mask = np.array([s is not None for s in self._slots])
         if not active_mask.any():
             return finished
         tokens = np.array(
             [s.last_token if s else 0 for s in self._slots], np.int32)
         t0 = time.perf_counter()
-        _, next_tokens, cache = self._decode(
+        logits, next_tokens, cache = self._decode(
             self.variables, self._cache, tokens, active_mask)
         next_tokens = np.asarray(next_tokens)  # blocks until ready
         dt = time.perf_counter() - t0
         self._cache = cache
-        produced = int(active_mask.sum())
+        # slots still force-feeding a cached prompt's suffix consume the
+        # step but produce nothing: their argmax is discarded below
+        produced = sum(1 for s in self._slots
+                       if s is not None and not s.pending)
         self._decode_time += dt
         self._decode_tokens += produced
         self._lat_hist.observe(dt)
         self._decode_steps_total.inc()
         self._tokens_total.inc(produced)
+        logits_np = np.asarray(logits) if self.capture_logits else None
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
+            if slot.pending:
+                # the cache covered positions < seq_len; the next prompt
+                # token is ground truth, not the model's to choose
+                slot.last_token = slot.pending.pop(0)
+                continue
             tok = int(next_tokens[i])
+            if slot.prefix_hit and not slot.tokens:
+                # first generated token of a hit: TTFT closes here (the
+                # cold path closes it at prefill)
+                submit_ts = self._submit_ts.pop(slot.req.rid, None)
+                if submit_ts is not None:
+                    ttft = t0 + dt - submit_ts
+                    self._ttft_hist.observe(ttft)
+                    root = self._req_spans.get(slot.req.rid)
+                    if root is not None:
+                        root.attrs["ttft_s"] = ttft
+            if logits_np is not None:
+                self.logit_log.setdefault(slot.req.rid, []).append(
+                    logits_np[i].copy())
             slot.tokens.append(tok)
             slot.last_token = tok
             if self.tracer is not None:
@@ -379,21 +483,133 @@ class ServingEngine:
                 return b
         raise ValueError(f"no bucket fits prompt length {plen}")
 
-    def _admit_one(self) -> list[Completion]:
+    def _admit_pending(self) -> list[Completion]:
+        """Admit queued requests into free slots, up to ``admit_burst``
+        per step (<= 0 means every free slot — an admission burst after
+        a bulk release no longer drains one slot per decode step)."""
+        burst = self.config.admit_burst
+        limit = self.config.max_batch if burst <= 0 else burst
+        finished: list[Completion] = []
+        for _ in range(limit):
+            admitted, done = self._admit_one()
+            finished.extend(done)
+            if not admitted:
+                break
+        return finished
+
+    def _admit_one(self) -> tuple[bool, list[Completion]]:
         if not self._pending:
-            return []
+            return False, []
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free:
-            return []
+            return False, []
         req = self._pending[0]
         plen = len(req.prompt)
         max_new = req.max_new_tokens or self.config.max_new_tokens
-        pages = self._allocator.alloc(
-            pages_for(plen + max_new, self.cache_cfg.block_size))
-        if pages is None:
-            return []  # wait for running sequences to free pages
+        hit = self._try_prefix_hit(req, plen)
+        if hit is not None:
+            return self._admit_hit(req, free[0], hit, plen, max_new)
+        return self._admit_cold(req, free[0], plen, max_new)
+
+    def _alloc_with_evict(self, n: int) -> list[int] | None:
+        pages = self._allocator.alloc(n)
+        if pages is None and self._prefix is not None and len(self._prefix):
+            # admission beats retention: shed cold prefix-cache entries
+            self._prefix.evict(n - self._allocator.available)
+            pages = self._allocator.alloc(n)
+        return pages
+
+    def _try_prefix_hit(self, req: Request, plen: int) -> PrefixHit | None:
+        """A cached-prefix hit worth taking, or None (refs dropped).
+        Coverage is capped at ``plen - 1`` so at least one prompt token
+        always runs through decode and yields the first token's logits;
+        hits whose un-cached suffix would take longer to decode-feed
+        than a cold prefill are declined."""
+        if self._prefix is None:
+            return None
+        hit = self._prefix.lookup(req.prompt)
+        if hit is None:
+            return None
+        bs = self.cache_cfg.block_size
+        c = min(hit.covered, plen - 1)
+        max_suffix = self.config.prefix_max_suffix or 2 * bs
+        if c < bs or plen - c > max_suffix:
+            self._allocator.free(hit.pages)
+            return None
+        return PrefixHit(pages=hit.pages, covered=c)
+
+    def _admit_hit(self, req: Request, slot_idx: int, hit: PrefixHit,
+                   plen: int, max_new: int) -> tuple[bool, list[Completion]]:
+        bs = self.cache_cfg.block_size
+        c = hit.covered
+        w = c // bs  # page index position c (the first write) lands in
+        n_total = pages_for(plen + max_new, bs)
+        priv = self._alloc_with_evict(n_total - w)
+        if priv is None:
+            self._allocator.free(hit.pages)
+            return False, []
         self._pending.popleft()
-        slot_idx = free[0]
+        bt_row = np.full((self.cache_cfg.max_pages_per_seq,), NULL_PAGE,
+                         np.int32)
+        bt_row[:w] = hit.pages[:w]
+        bt_row[w:n_total] = priv
+        t0 = time.perf_counter()
+        cache = self._install(self._cache, np.int32(slot_idx), bt_row,
+                              np.int32(c))
+        cow = w < len(hit.pages)
+        if cow:
+            # position c lands inside a shared page (partial boundary,
+            # or a fully-covered prompt re-feeding its final token):
+            # write into a private copy, never the shared original
+            cache = self._copy(cache, np.int32(hit.pages[w]),
+                               np.int32(int(bt_row[w])))
+            self._cow_copies.inc()
+        self._cache = cache
+        if hit.pages[w:]:
+            self._allocator.free(hit.pages[w:])  # refs not kept past copy
+        slot = _Slot(req=req, pages=list(hit.pages[:w]) + priv, tokens=[],
+                     last_token=int(req.prompt[c]), max_new=max_new,
+                     pending=[int(t) for t in req.prompt[c + 1:]],
+                     prefix_hit=True)
+        self._slots[slot_idx] = slot
+        self._admitted.inc()
+        self._prefix_hits.inc()
+        self._prefix_hit_tokens.inc(c)
+        submit_ts = self._submit_ts.get(req.rid)
+        root = self._req_spans.get(req.rid)
+        if self.tracer is not None and root is not None \
+                and submit_ts is not None:
+            now = time.perf_counter()
+            self.tracer.record(
+                "serve.queue_wait", submit_ts, t0,
+                trace_id=root.trace_id, parent_id=root.span_id)
+            self.tracer.record(
+                "serve.prefix_install", t0, now,
+                attrs={"covered": c, "suffix": plen - c, "cow": int(cow)},
+                trace_id=root.trace_id, parent_id=root.span_id)
+        self._update_occupancy()
+        return True, []
+
+    def _admit_cold(self, req: Request, slot_idx: int, plen: int,
+                    max_new: int) -> tuple[bool, list[Completion]]:
+        bs = self.cache_cfg.block_size
+        n_pages = pages_for(plen + max_new, bs)
+        # a page-unaligned prompt that will be donated to the prefix
+        # cache needs one spare page: the boundary page becomes shared
+        # at insert, and this slot's own generation copy-on-writes it
+        want_partial = (self._prefix is not None and plen >= bs
+                        and plen % bs != 0)
+        spare: list[int] | None = None
+        pages = None
+        if want_partial:
+            got = self._alloc_with_evict(n_pages + 1)
+            if got is not None:
+                pages, spare = got[:n_pages], got[n_pages:]
+        if pages is None:
+            pages = self._alloc_with_evict(n_pages)
+        if pages is None:
+            return False, []  # wait for running sequences to free pages
+        self._pending.popleft()
         bucket = self._bucket_for(plen)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :plen] = req.prompt
@@ -401,12 +617,14 @@ class ServingEngine:
                          np.int32)
         bt_row[:len(pages)] = pages
         t_prefill = time.perf_counter()
-        first, _, cache = self._prefill(
+        first, logits0, cache = self._prefill(
             self.variables, self._cache, ids, bt_row,
             np.int32(slot_idx), np.int32(plen))
         self._cache = cache
         self._prefill_count += 1
         self._admitted.inc()
+        if self._prefix is not None:
+            self._prefix_misses.inc()
         submit_ts = self._submit_ts.pop(req.rid, None)
         if submit_ts is not None:
             # ONE clock reading closes both the histogram sample and the
@@ -426,13 +644,104 @@ class ServingEngine:
                     trace_id=root.trace_id, parent_id=root.span_id)
                 root.attrs["ttft_s"] = now - submit_ts
         tok = int(first)
+        if self.capture_logits:
+            self.logit_log.setdefault(req.rid, []).append(
+                np.asarray(logits0[plen - 1]).copy())
         slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
                      max_new=max_new)
         self._slots[slot_idx] = slot
+        self._insert_prefix(slot_idx, slot, bt_row, plen, spare)
         done = self._finish_reason(slot, tok)
         if done:
-            return [self._release(slot_idx, done)]
-        return []
+            return True, [self._release(slot_idx, done)]
+        return True, []
+
+    def _insert_prefix(self, slot_idx: int, slot: _Slot, bt_row: np.ndarray,
+                       plen: int, spare: list[int] | None) -> None:
+        """Donate a cold prompt's pages to the prefix cache. Prompts
+        shorter than one page can never clear the hit gate, so they are
+        not worth indexing."""
+        bs = self.cache_cfg.block_size
+        if self._prefix is None or plen < bs:
+            if spare:
+                self._allocator.free(spare)
+            return
+        m = pages_for(plen, bs)
+        f = plen % bs
+        if f and spare is None:
+            # no spare to copy-on-write the boundary into: share the
+            # full pages only
+            self._prefix.insert(slot.req.prompt[:plen - f],
+                                slot.pages[:m - 1])
+            return
+        self._prefix.insert(slot.req.prompt[:plen], slot.pages[:m])
+        if not f:
+            return
+        boundary = slot.pages[m - 1]
+        if not self._allocator.is_shared(boundary):
+            # an equivalent boundary page was already cached; ours
+            # stayed private and the spare goes back
+            self._allocator.free(spare)
+            return
+        # the cache adopted the boundary page, and this slot writes
+        # position plen into it next step -> move the slot to a copy
+        new = int(spare[0])
+        bt_row = bt_row.copy()
+        bt_row[m - 1] = new
+        cache = self._install(self._cache, np.int32(slot_idx), bt_row,
+                              np.int32(plen))
+        self._cache = self._copy(cache, np.int32(boundary), np.int32(new))
+        self._cow_copies.inc()
+        slot.pages[m - 1] = new
+        self._allocator.free([boundary])  # slot's ref; the cache keeps its
+
+    def install_prefilled(self, req: Request, kvs, first_token: int,
+                          prompt_len: int) -> tuple[bool, list[Completion]]:
+        """Admit a request whose prefill ran on another replica
+        (serving/fleet/disagg.py): allocate pages, scatter the
+        handed-off per-layer K/V into them, and seat the slot with the
+        prefill's first token — no local prefill executable runs.
+        ``kvs`` is the prefill's ``return_kv`` output, per layer
+        ``(k, v)`` shaped ``[1, bucket, kv_heads, head_dim]`` (host or
+        device arrays). Returns ``(installed, completions)``;
+        not-installed means no free slot or pages right now — retry
+        after a :meth:`step`."""
+        plen = int(prompt_len)
+        max_new = req.max_new_tokens or self.config.max_new_tokens
+        bucket = int(kvs[0][0].shape[1])
+        if plen < 1 or plen + max_new > self.cache_cfg.max_seq:
+            self._rejected.inc()
+            raise ValueError(f"{req.rid}: handoff of {plen} prompt + "
+                             f"{max_new} new tokens does not fit max_seq "
+                             f"{self.cache_cfg.max_seq}")
+        if bucket > self.cache_cfg.max_seq:
+            self._rejected.inc()
+            raise ValueError(f"{req.rid}: handoff bucket {bucket} exceeds "
+                             f"max_seq {self.cache_cfg.max_seq}")
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            return False, []
+        pages = self._alloc_with_evict(
+            pages_for(plen + max_new, self.cache_cfg.block_size))
+        if pages is None:
+            return False, []
+        slot_idx = free[0]
+        bt_row = np.full((self.cache_cfg.max_pages_per_seq,), NULL_PAGE,
+                         np.int32)
+        bt_row[:len(pages)] = pages
+        kvs = [(jnp.asarray(k), jnp.asarray(v)) for k, v in kvs]
+        self._cache = self._install_kv(self._cache, kvs, bt_row,
+                                       np.int32(slot_idx), np.int32(plen))
+        self._admitted.inc()
+        tok = int(first_token)
+        slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
+                     max_new=max_new)
+        self._slots[slot_idx] = slot
+        self._update_occupancy()
+        done = self._finish_reason(slot, tok)
+        if done:
+            return True, [self._release(slot_idx, done)]
+        return True, []
 
     # ------------------------------------------------------------------
     # verification + stats
@@ -546,7 +855,7 @@ class ServingEngine:
         # interpolation), NOT a per-step latency list: a server decoding
         # for weeks must not grow host memory with every step. Keys are
         # unchanged — /stats consumers and the bench phase still parse.
-        return {
+        out = {
             "decode_steps": int(self._lat_hist.count),
             "decode_tokens": self._decode_tokens,
             "prefills": self._prefill_count,
@@ -555,4 +864,20 @@ class ServingEngine:
                 if self._decode_time else 0.0),
             "decode_p50_latency_ms": self._lat_hist.quantile(0.50) * 1e3,
             "decode_p95_latency_ms": self._lat_hist.quantile(0.95) * 1e3,
+            # the router's least-loaded fallback reads these two
+            "queue_depth": len(self._pending),
+            "active_slots": sum(1 for s in self._slots if s is not None),
+            "ttft_p50_ms": self._ttft_hist.quantile(0.50) * 1e3,
+            "ttft_p95_ms": self._ttft_hist.quantile(0.95) * 1e3,
         }
+        if self._prefix is not None:
+            hits = self._prefix_hits.value
+            misses = self._prefix_misses.value
+            out["prefix_hits"] = int(hits)
+            out["prefix_misses"] = int(misses)
+            out["prefix_hit_rate"] = (hits / (hits + misses)
+                                      if hits + misses else 0.0)
+            out["prefix_hit_tokens"] = int(self._prefix_hit_tokens.value)
+            out["prefix_cache_pages"] = self._prefix.total_pages
+            out["cow_copies"] = int(self._cow_copies.value)
+        return out

@@ -1,0 +1,9 @@
+"""Fleet-scale serving: request router, refcounted prefix cache, and
+disaggregated prefill/decode over the continuous-batching engine.
+
+Layering (no cycles): ``prefixcache`` depends only on the paged KV
+allocator; the engine (serving/engine.py) consumes it. ``router`` and
+``disagg`` sit *above* the engine and import it. This ``__init__``
+stays import-free so ``engine -> fleet.prefixcache`` never drags the
+router's HTTP machinery into the decode hot path.
+"""

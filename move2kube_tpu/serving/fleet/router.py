@@ -1,0 +1,544 @@
+"""Fleet request router: prefix-affine load balancing over N engine
+replicas.
+
+Placement is the fleet's cache policy: the prefix cache
+(fleet/prefixcache.py) lives *inside* each replica, so a request only
+hits if earlier requests with the same prefix landed on the same
+replica. The router therefore routes by **session affinity on the
+prefix hash** — rendezvous (highest-random-weight) hashing of the
+first ``affinity_tokens`` prompt tokens plus a salt, which keeps the
+tenant->replica mapping stable as replicas come and go (only keys
+owned by a dead replica move). When the affine replica is unhealthy or
+its queue is deep, the router spills to the least-loaded healthy
+replica; failures mark the replica down and retry elsewhere (bounded),
+and an optional hedge fires a duplicate to the runner-up when the
+primary sits on a request too long.
+
+Everything observable exports as ``m2kt_router_*`` through the PR-5
+registry; the HTTP front serves ``/generate`` plus the standard
+``/healthz``/``/readyz``/``/metrics`` trio so the emitted router pods
+scrape and gate exactly like engine pods.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from move2kube_tpu.obs.metrics import Registry
+from move2kube_tpu.serving.engine import EngineConfig, Request, ServingEngine
+
+
+def prefix_hash(tokens, salt: str = "", k: int = 16) -> int:
+    """Stable across processes (the Helm-lifted salt is the only input
+    besides the tokens): hash of the first ``k`` prompt tokens."""
+    h = hashlib.sha256(salt.encode())
+    for t in list(tokens)[:k]:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return int.from_bytes(h.digest()[:8], "little")
+
+
+def _rendezvous_score(key: int, name: str) -> int:
+    h = hashlib.sha256(f"{key}:{name}".encode())
+    return int.from_bytes(h.digest()[:8], "little")
+
+
+class ReplicaHandle:
+    """One engine replica as the router sees it."""
+
+    name: str = "replica"
+
+    def generate(self, prompt, max_new_tokens: int | None = None,
+                 rid: str | None = None) -> dict:
+        raise NotImplementedError
+
+    def queue_depth(self) -> float:
+        raise NotImplementedError
+
+    def healthy(self) -> bool:
+        raise NotImplementedError
+
+
+class InProcessReplica(ReplicaHandle):
+    """A ServingEngine plus its worker thread, wired like the emitted
+    serve template's server loop — used by tests and ``fleet-smoke``
+    to stand up a whole fleet in one CPU process. ``fail_next`` makes
+    the next N calls raise, for failover/hedging drills."""
+
+    def __init__(self, name: str, engine: ServingEngine):
+        self.name = name
+        self.engine = engine
+        self.fail_next = 0
+        self.hold_s = 0.0  # artificial service delay, for hedging drills
+        self._lock = threading.Lock()
+        self._waiters: dict[str, tuple[threading.Event, list]] = {}
+        self._seq = 0
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self._up = True
+
+    def start(self) -> "InProcessReplica":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"replica-{self.name}", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop:
+            with self._lock:
+                work = self.engine.has_work()
+                done = self.engine.step() if work else []
+            for comp in done:
+                waiter = self._waiters.pop(comp.rid, None)
+                if waiter is not None:
+                    event, box = waiter
+                    box.append(comp)
+                    event.set()
+            if not work:
+                time.sleep(0.002)
+
+    def set_healthy(self, up: bool) -> None:
+        self._up = up
+
+    def healthy(self) -> bool:
+        return self._up and not self._stop
+
+    def queue_depth(self) -> float:
+        stats = self.engine.stats()
+        return float(stats["queue_depth"] + stats["active_slots"])
+
+    def generate(self, prompt, max_new_tokens=None, rid=None) -> dict:
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError(f"{self.name}: injected failure")
+        if self.hold_s:
+            time.sleep(self.hold_s)
+        self.start()
+        with self._lock:
+            self._seq += 1
+            rid = rid or f"{self.name}-{self._seq}"
+            event, box = threading.Event(), []
+            self._waiters[rid] = (event, box)
+            self.engine.submit(Request(rid=rid, prompt=list(prompt),
+                                       max_new_tokens=max_new_tokens))
+        if not event.wait(timeout=120):
+            self._waiters.pop(rid, None)
+            raise TimeoutError(f"{self.name}: request {rid} timed out")
+        comp = box[0]
+        return {"rid": comp.rid, "replica": self.name,
+                "prompt_len": comp.prompt_len, "tokens": comp.tokens,
+                "finish_reason": comp.finish_reason}
+
+    def install(self, handoff_bytes: bytes) -> dict:
+        """Seat a disagg KV handoff and decode it to completion."""
+        from move2kube_tpu.serving.fleet.disagg import KVHandoff
+
+        h = KVHandoff.from_bytes(handoff_bytes)
+        event, box = threading.Event(), []
+        self.start()
+        installed = False
+        while not installed:
+            with self._lock:
+                ok, done = self.engine.install_prefilled(
+                    h.request(), h.kv, h.first_token, h.prompt_len)
+                if ok:
+                    installed = True
+                    if done:
+                        box.extend(done)
+                        event.set()
+                    else:
+                        self._waiters[h.rid] = (event, box)
+            if not installed:
+                time.sleep(0.002)  # engine full: let the loop drain a step
+        if not event.wait(timeout=120):
+            self._waiters.pop(h.rid, None)
+            raise TimeoutError(f"{self.name}: handoff {h.rid} timed out")
+        comp = box[0]
+        return {"rid": comp.rid, "replica": self.name,
+                "prompt_len": comp.prompt_len, "tokens": comp.tokens,
+                "finish_reason": comp.finish_reason}
+
+
+class HttpReplica(ReplicaHandle):
+    """A remote engine pod: ``/generate`` (and ``/install`` for disagg)
+    on the serving port, ``/readyz`` + ``/stats`` on the telemetry
+    port (obs/server.py)."""
+
+    def __init__(self, name: str, base_url: str,
+                 health_url: str | None = None, timeout_s: float = 120.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.health_url = (health_url or base_url).rstrip("/")
+        self.timeout_s = timeout_s
+
+    def generate(self, prompt, max_new_tokens=None, rid=None) -> dict:
+        body = json.dumps({"prompt": list(prompt),
+                           "max_new_tokens": max_new_tokens,
+                           "rid": rid}).encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read().decode())
+
+    def install(self, handoff_bytes: bytes) -> dict:
+        req = urllib.request.Request(
+            f"{self.base_url}/install", data=handoff_bytes,
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read().decode())
+
+    def prefill(self, request):
+        """Disagg prefill over HTTP: POST the prompt, get back the
+        serialized KV handoff (``KVHandoff.to_bytes`` wire format)."""
+        from move2kube_tpu.serving.fleet.disagg import KVHandoff
+
+        body = json.dumps({"prompt": list(request.prompt),
+                           "max_new_tokens": request.max_new_tokens,
+                           "rid": request.rid}).encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/prefill", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return KVHandoff.from_bytes(resp.read())
+
+    def queue_depth(self) -> float:
+        try:
+            with urllib.request.urlopen(f"{self.health_url}/stats",
+                                        timeout=2) as resp:
+                stats = json.loads(resp.read().decode())
+            return float(stats.get("queue_depth", 0)
+                         + stats.get("active_slots", 0))
+        except (OSError, ValueError):
+            return float("inf")
+
+    def healthy(self) -> bool:
+        try:
+            with urllib.request.urlopen(f"{self.health_url}/readyz",
+                                        timeout=2) as resp:
+                return resp.status == 200
+        except (OSError, ValueError):
+            return False
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    affinity_tokens: int = 16   # prompt prefix length hashed for affinity
+    salt: str = ""              # M2KT_FLEET_AFFINITY_SALT (Helm-lifted)
+    max_retries: int = 2        # additional replicas tried on failure
+    spill_queue_depth: float = 8.0  # affine queue deeper than this spills
+    hedge_after_s: float | None = None  # None = hedging off
+    disagg_threshold: int = 0   # prompt length that routes via prefill; 0=off
+
+    @classmethod
+    def from_env(cls, **overrides) -> "RouterConfig":
+        import os
+
+        def _num(name, default, cast):
+            try:
+                return cast(os.environ.get(name, "") or default)
+            except ValueError:
+                return default
+
+        hedge = _num("M2KT_ROUTER_HEDGE_MS", 0.0, float)
+        cfg = dict(
+            affinity_tokens=_num("M2KT_ROUTER_AFFINITY_TOKENS",
+                                 cls.affinity_tokens, int),
+            salt=os.environ.get("M2KT_FLEET_AFFINITY_SALT", cls.salt),
+            max_retries=_num("M2KT_ROUTER_RETRIES", cls.max_retries, int),
+            spill_queue_depth=_num("M2KT_ROUTER_SPILL_DEPTH",
+                                   cls.spill_queue_depth, float),
+            hedge_after_s=(hedge / 1e3) if hedge > 0 else None,
+            disagg_threshold=_num("M2KT_FLEET_DISAGG_THRESHOLD", 0, int),
+        )
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+class Router:
+    def __init__(self, replicas, config: RouterConfig | None = None,
+                 prefill_replicas=(), registry: Registry | None = None):
+        self.replicas = list(replicas)
+        self.prefill_replicas = list(prefill_replicas)
+        self.config = config or RouterConfig()
+        self.registry = registry if registry is not None else Registry()
+        # last-known health, refreshed by probe(); a failed call marks
+        # the replica down immediately without waiting for a probe
+        self._up: dict[str, bool] = {r.name: True for r in self.replicas}
+        self._rr = 0  # round-robin cursor over prefill replicas
+        reg = self.registry
+        self._requests = reg.counter(
+            "m2kt_router_requests_total", "Routed requests by outcome",
+            labels=("outcome",))
+        self._retries = reg.counter(
+            "m2kt_router_retries_total", "Requests retried on another "
+            "replica after a failure")
+        self._hedges = reg.counter(
+            "m2kt_router_hedges_total", "Duplicate requests fired at the "
+            "runner-up after the hedge deadline")
+        self._affinity_hits = reg.counter(
+            "m2kt_router_affinity_hits_total",
+            "Requests routed to their prefix-affine replica")
+        self._spills = reg.counter(
+            "m2kt_router_spills_total",
+            "Requests spilled to the least-loaded replica (affine replica "
+            "down or queue too deep)")
+        self._replica_up = reg.gauge(
+            "m2kt_router_replica_up", "1 if the replica passed its last "
+            "health check", labels=("replica",))
+        self._replica_queue = reg.gauge(
+            "m2kt_router_replica_queue_depth",
+            "Queued + active requests on the replica at last poll",
+            labels=("replica",))
+        self._inflight = reg.gauge(
+            "m2kt_router_inflight", "Requests currently being routed")
+        self._disagg = reg.counter(
+            "m2kt_router_disagg_total",
+            "Requests served via prefill->decode handoff")
+        for r in self.replicas:
+            self._replica_up.labels(replica=r.name).set(1.0)
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+
+    def probe(self) -> dict:
+        """Poll every replica's health endpoint and refresh the up/queue
+        gauges. Recovered replicas rejoin the affinity ring here."""
+        out = {}
+        for r in self.replicas:
+            up = bool(r.healthy())
+            self._up[r.name] = up
+            self._replica_up.labels(replica=r.name).set(1.0 if up else 0.0)
+            if up:
+                self._replica_queue.labels(replica=r.name).set(
+                    r.queue_depth())
+            out[r.name] = up
+        return out
+
+    def _healthy(self):
+        return [r for r in self.replicas if self._up.get(r.name, True)]
+
+    def pick(self, prompt, exclude=()) -> ReplicaHandle | None:
+        """Affine replica by rendezvous hash of the prompt prefix,
+        spilling to least-loaded when it is excluded, down, or
+        backlogged. Pure placement — no side effects beyond metrics."""
+        excluded = {r.name for r in exclude}
+        healthy = [r for r in self._healthy() if r.name not in excluded]
+        if not healthy:
+            return None
+        key = prefix_hash(prompt, self.config.salt,
+                          self.config.affinity_tokens)
+        affine = max(healthy,
+                     key=lambda r: _rendezvous_score(key, r.name))
+        if affine.queue_depth() <= self.config.spill_queue_depth:
+            self._affinity_hits.inc()
+            return affine
+        self._spills.inc()
+        return min(healthy, key=lambda r: r.queue_depth())
+
+    def _mark_down(self, replica: ReplicaHandle) -> None:
+        self._up[replica.name] = False
+        self._replica_up.labels(replica=replica.name).set(0.0)
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+
+    def generate(self, prompt, max_new_tokens: int | None = None,
+                 rid: str | None = None) -> dict:
+        prompt = list(prompt)
+        self._inflight.inc()
+        try:
+            if (self.config.disagg_threshold
+                    and len(prompt) >= self.config.disagg_threshold
+                    and self.prefill_replicas):
+                try:
+                    out = self._generate_disagg(prompt, max_new_tokens, rid)
+                    self._requests.labels(outcome="ok").inc()
+                    return out
+                except Exception:  # noqa: BLE001 - fall back to direct path
+                    pass
+            out = self._generate_direct(prompt, max_new_tokens, rid)
+            self._requests.labels(outcome="ok").inc()
+            return out
+        except Exception:
+            self._requests.labels(outcome="error").inc()
+            raise
+        finally:
+            self._inflight.dec()
+
+    def _generate_direct(self, prompt, max_new_tokens, rid) -> dict:
+        tried: list[ReplicaHandle] = []
+        last_err: Exception | None = None
+        for attempt in range(self.config.max_retries + 1):
+            replica = self.pick(prompt, exclude=tried)
+            if replica is None:
+                break
+            if attempt:
+                self._retries.inc()
+            tried.append(replica)
+            try:
+                if self.config.hedge_after_s is not None:
+                    return self._call_hedged(replica, prompt,
+                                             max_new_tokens, rid, tried)
+                return replica.generate(prompt, max_new_tokens, rid)
+            except Exception as err:  # noqa: BLE001 - any failure fails over
+                last_err = err
+                self._mark_down(replica)
+        if last_err is not None:
+            raise last_err
+        raise RuntimeError("router: no healthy replica available")
+
+    def _call_hedged(self, primary, prompt, max_new_tokens, rid,
+                     tried) -> dict:
+        """Fire ``primary``; if it has not answered within the hedge
+        deadline, fire the runner-up too and take whichever finishes
+        first. The loser's work is wasted by design — hedging trades
+        duplicate decode for tail latency."""
+        done = threading.Event()
+        results: list[dict] = []
+        errors: list[Exception] = []
+
+        def call(replica):
+            try:
+                results.append(replica.generate(prompt, max_new_tokens, rid))
+                done.set()
+            except Exception as err:  # noqa: BLE001 - collected below
+                errors.append(err)
+                if len(errors) >= len(threads):
+                    done.set()
+
+        threads = [threading.Thread(target=call, args=(primary,),
+                                    daemon=True)]
+        threads[0].start()
+        if not done.wait(self.config.hedge_after_s):
+            backup = self.pick(prompt, exclude=tried)
+            if backup is not None:
+                self._hedges.inc()
+                tried.append(backup)
+                threads.append(threading.Thread(target=call, args=(backup,),
+                                                daemon=True))
+                threads[1].start()
+        done.wait()
+        while not results and any(t.is_alive() for t in threads):
+            time.sleep(0.005)
+        if results:
+            return results[0]
+        raise errors[0] if errors else RuntimeError("hedge: no result")
+
+    def _generate_disagg(self, prompt, max_new_tokens, rid) -> dict:
+        """Long prompts route prefill->decode: round-robin a prefill
+        replica for the KV handoff, then seat it on the prefix-affine
+        decode replica (same placement as the direct path, so the
+        decode side's cache locality is preserved)."""
+        prefill = self.prefill_replicas[self._rr
+                                        % len(self.prefill_replicas)]
+        self._rr += 1
+        handoff = prefill.prefill(Request(
+            rid=rid or f"disagg-{self._rr}", prompt=list(prompt),
+            max_new_tokens=max_new_tokens))
+        decode = self.pick(prompt)
+        if decode is None:
+            raise RuntimeError("router: no healthy decode replica")
+        out = decode.install(handoff.to_bytes())
+        self._disagg.inc()
+        return out
+
+
+class RouterHTTPServer:
+    """stdlib-HTTP front for the router role (assets/jax/serve_tpu.py
+    runs this when ``M2KT_FLEET_ROLE=router``). ``/readyz`` reports
+    serving once any backend replica is healthy, so the router pod's
+    readiness gate composes with the engines' own gates."""
+
+    def __init__(self, router: Router, port: int = 8000,
+                 default_max_new: int | None = None):
+        self.router = router
+        self.default_max_new = default_max_new
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, b'{"status":"ok"}')
+                elif self.path == "/readyz":
+                    up = outer.router.probe()
+                    ready = any(up.values())
+                    body = json.dumps({"ready": ready,
+                                       "replicas": up}).encode()
+                    self._send(200 if ready else 503, body)
+                elif self.path == "/metrics":
+                    self._send(200, outer.router.registry.render().encode(),
+                               "text/plain; version=0.0.4")
+                else:
+                    self._send(404, b'{"error":"not found"}')
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._send(404, b'{"error":"not found"}')
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n).decode())
+                    out = outer.router.generate(
+                        payload["prompt"],
+                        payload.get("max_new_tokens",
+                                    outer.default_max_new),
+                        payload.get("rid"))
+                    self._send(200, json.dumps(out).encode())
+                except Exception as err:  # noqa: BLE001 - surface as 500
+                    self._send(500, json.dumps(
+                        {"error": str(err)}).encode())
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="m2kt-router",
+            daemon=True)
+
+    def start(self) -> "RouterHTTPServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def build_fleet(model, variables, n_replicas: int,
+                engine_config: EngineConfig | None = None,
+                router_config: RouterConfig | None = None,
+                registry: Registry | None = None) -> Router:
+    """An in-process fleet: N engine replicas behind a router. The
+    CPU-mode stand-in for the emitted per-role pods, used by
+    ``fleet-smoke`` and the bench fleet phase."""
+    cfg = engine_config or EngineConfig.from_env()
+    replicas = [
+        InProcessReplica(f"replica-{i}",
+                         ServingEngine(model, variables, cfg)).start()
+        for i in range(n_replicas)]
+    return Router(replicas, config=router_config, registry=registry)

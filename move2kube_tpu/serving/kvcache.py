@@ -115,16 +115,51 @@ def scatter_prefill(cache: dict, kvs, slot, bt_row, prompt_len,
     return out
 
 
+def copy_page(cache: dict, src, dst) -> dict:
+    """Copy one page's K/V across every layer (jit-safe). The device
+    half of copy-on-write: a slot about to write into a *shared* page
+    (refcount > 1 in :class:`PageAllocator`) first duplicates it into a
+    private page, then points its block-table entry at the copy — the
+    shared original stays immutable for every other holder."""
+    out = dict(cache)
+    out["k"] = [k.at[dst].set(k[src]) for k in cache["k"]]
+    out["v"] = [v.at[dst].set(v[src]) for v in cache["v"]]
+    return out
+
+
+def install_block_table(cache: dict, slot, bt_row, seq_len) -> dict:
+    """Point a decode slot at an existing page run (jit-safe). A
+    prefix-cache hit admits by table surgery alone — the shared pages'
+    K/V are already resident, so no prefill executable runs."""
+    out = dict(cache)
+    out["block_tables"] = cache["block_tables"].at[slot].set(bt_row)
+    out["seq_lens"] = cache["seq_lens"].at[slot].set(seq_len)
+    return out
+
+
 class PageAllocator:
-    """Host-side free list over the page pool. Page 0 never leaves the
-    reserve. Allocation is all-or-nothing: a request that cannot get
-    every page it needs gets none (the engine keeps it queued instead of
-    deadlocking half-admitted)."""
+    """Host-side refcounted free list over the page pool. Page 0 never
+    leaves the reserve. Allocation is all-or-nothing: a request that
+    cannot get every page it needs gets none (the engine keeps it queued
+    instead of deadlocking half-admitted).
+
+    Pages carry a reference count so the prefix cache (serving/fleet/
+    prefixcache.py) can hold a sequence's prompt pages after the
+    sequence releases them: ``alloc`` hands out pages at refcount 1,
+    ``incref`` adds holders, and ``free`` is a decref that only returns
+    a page to the free list when the last holder drops it. A page with
+    refcount > 1 is *shared* — holders must never write it in place;
+    the engine copy-on-writes (:func:`copy_page`) before the first
+    write. The LIFO free order is kept (freshly released pages are the
+    warmest), with a shadow set making release bursts O(1) per page
+    instead of the old O(n) list-membership scan."""
 
     def __init__(self, num_pages: int) -> None:
         if num_pages < 2:
             raise ValueError("need at least 2 pages (page 0 is reserved)")
         self._free = list(range(num_pages - 1, 0, -1))
+        self._free_set = set(self._free)
+        self._refs: dict[int, int] = {}
 
     @property
     def available(self) -> int:
@@ -133,15 +168,47 @@ class PageAllocator:
     def alloc(self, n: int) -> list[int] | None:
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        pages = []
+        for _ in range(n):
+            p = self._free.pop()
+            self._free_set.remove(p)
+            self._refs[p] = 1
+            pages.append(p)
+        return pages
 
-    def free(self, pages) -> None:
+    def incref(self, pages) -> None:
+        """Add a holder to already-allocated pages (prefix-cache shares)."""
         for p in pages:
             if p == NULL_PAGE:
                 raise ValueError("page 0 is reserved and never allocated")
-            if p in self._free:
+            if p not in self._refs:
+                raise ValueError(f"incref of unallocated page {p}")
+            self._refs[p] += 1
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
+
+    def is_shared(self, page: int) -> bool:
+        """More than one holder — writes must copy-on-write first."""
+        return self._refs.get(int(page), 0) > 1
+
+    def free(self, pages) -> None:
+        """Drop one reference per page; a page returns to the free list
+        only when its last holder releases it."""
+        for p in pages:
+            if p == NULL_PAGE:
+                raise ValueError("page 0 is reserved and never allocated")
+            if p in self._free_set:
                 raise ValueError(f"double free of page {p}")
-            self._free.append(p)
+            n = self._refs.get(p, 0)
+            if n <= 0:
+                raise ValueError(f"double free of page {p}")
+            if n == 1:
+                del self._refs[p]
+                self._free.append(p)
+                self._free_set.add(p)
+            else:
+                self._refs[p] = n - 1
 
 
 def assert_cache_donated(step_fn, *args, num_layers: int,

@@ -1,0 +1,631 @@
+"""Fleet serving tests: router, refcounted prefix cache + COW, disagg.
+
+The load-bearing property mirrors test_serving.py's: a prefix-cache hit
+must produce logits equal (fp32 tolerance) to the uncached path — the
+cache installs shared KV pages instead of re-running prefill, and any
+bookkeeping slip (refcount, COW, suffix force-feed) shows up as a logit
+diff. Around that core: allocator refcount/COW invariants, router
+placement/failover/hedging units, the disagg KV handoff wire format,
+and the emitted per-role fleet manifests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from move2kube_tpu.models.llama import Llama, llama_tiny
+from move2kube_tpu.serving.engine import EngineConfig, Request, ServingEngine
+from move2kube_tpu.serving.fleet.disagg import (
+    DisaggPair,
+    InProcessTransport,
+    KVHandoff,
+    PrefillReplica,
+)
+from move2kube_tpu.serving.fleet.prefixcache import PrefixCache
+from move2kube_tpu.serving.fleet.router import (
+    ReplicaHandle,
+    Router,
+    RouterConfig,
+    RouterHTTPServer,
+    build_fleet,
+    prefix_hash,
+)
+from move2kube_tpu.serving.kvcache import (
+    NULL_PAGE,
+    PageAllocator,
+)
+
+
+@pytest.fixture(scope="module")
+def llama_parts():
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, **over) -> ServingEngine:
+    cfg = EngineConfig(**{**dict(max_batch=2, max_seq=64, block_size=8,
+                                 buckets=(16, 32)), **over})
+    return ServingEngine(model, variables, cfg)
+
+
+# ----------------------------------------------------------------------
+# refcounted allocator
+# ----------------------------------------------------------------------
+
+def test_allocator_refcounts_and_release_to_zero():
+    alloc = PageAllocator(9)
+    pages = alloc.alloc(3)
+    assert all(alloc.refcount(p) == 1 for p in pages)
+    alloc.incref(pages)
+    assert all(alloc.refcount(p) == 2 for p in pages)
+    assert all(alloc.is_shared(p) for p in pages)
+    alloc.free(pages)  # decref: still held once, NOT back in the pool
+    assert alloc.available == 5
+    assert all(alloc.refcount(p) == 1 for p in pages)
+    assert not any(alloc.is_shared(p) for p in pages)
+    alloc.free(pages)  # release to zero: pages return to the pool
+    assert alloc.available == 8
+    with pytest.raises(ValueError):
+        alloc.free(pages)  # double free detected even after reuse-free
+    with pytest.raises(ValueError):
+        alloc.incref([NULL_PAGE])
+    with pytest.raises(ValueError):
+        alloc.incref([7])  # never allocated
+
+
+def test_allocator_free_keeps_lifo_order():
+    """The O(n^2) list-scan free is gone; the set-backed free list must
+    keep the allocator's LIFO behavior (freshly freed pages are handed
+    out first — warmest pages stay warm) and stay correct at size."""
+    alloc = PageAllocator(1025)
+    a = alloc.alloc(512)
+    b = alloc.alloc(512)
+    assert alloc.alloc(1) is None
+    alloc.free(b)
+    # LIFO: the most recently freed pages come back first
+    assert alloc.alloc(512) == list(reversed(b))
+    alloc.free(a)
+    got = alloc.alloc(3)
+    assert got == a[-1:-4:-1]  # freed [..., x, y, z] -> alloc [z, y, x]
+
+
+# ----------------------------------------------------------------------
+# prefix-cache trie (host-side, no model)
+# ----------------------------------------------------------------------
+
+def test_prefix_trie_lookup_insert_evict():
+    alloc = PageAllocator(33)
+    cache = PrefixCache(4, alloc)
+    toks = list(range(100, 110))  # 10 tokens, bs=4: 2 full pages + 2 tail
+    pages = alloc.alloc(3)
+    assert cache.insert(toks, pages) == 3
+    # the cache took one ref per adopted page on top of the donor's
+    assert all(alloc.refcount(p) == 2 for p in pages)
+    alloc.free(pages)  # donor slot releases; cache keeps them alive
+    assert all(alloc.refcount(p) == 1 for p in pages)
+
+    hit = cache.lookup(toks)
+    assert hit is not None
+    assert hit.covered == 10 and hit.pages == list(pages)
+    assert all(alloc.refcount(p) == 2 for p in pages)  # caller holds refs
+    alloc.free(hit.pages)
+
+    # shared-prefix lookup: full pages match, foreign tail does not
+    hit = cache.lookup(toks[:8] + [999, 998])
+    assert hit is not None and hit.covered == 8
+    assert hit.pages == list(pages[:2])
+    alloc.free(hit.pages)
+
+    # a shorter *partial* prefix of the tail page does not match (the
+    # cached partial chunk must be a prefix of the remainder, not vice
+    # versa — the page holds K/V for positions the query never covers)
+    hit = cache.lookup(toks[:9])
+    assert hit is not None and hit.covered == 8
+    alloc.free(hit.pages)
+
+    before = alloc.available
+    assert cache.evict(1) >= 1
+    assert alloc.available > before
+    cache.clear()
+    assert len(cache) == 0
+    assert alloc.available == 32
+
+
+def test_prefix_trie_dedups_existing_chunks():
+    alloc = PageAllocator(17)
+    cache = PrefixCache(4, alloc)
+    toks = list(range(1, 9))
+    first = alloc.alloc(2)
+    assert cache.insert(toks, first) == 2
+    dup = alloc.alloc(2)
+    # same tokens again: existing nodes keep their pages; nothing adopted
+    assert cache.insert(toks, dup) == 0
+    assert cache.total_pages == 2
+    alloc.free(dup)
+    assert alloc.available == 16 - 2  # only `first` pages remain out
+    alloc.free(first)  # donor drops its refs; cache alone keeps them alive
+    assert alloc.available == 16 - 2
+    assert all(alloc.refcount(p) == 1 for p in first)
+
+
+# ----------------------------------------------------------------------
+# prefix-cache hit path: logit equivalence + COW invariants
+# ----------------------------------------------------------------------
+
+def _run_capture(eng, requests):
+    eng.capture_logits = True
+    comps = {c.rid: c for c in eng.run(requests)}
+    return comps, eng.logit_log
+
+
+def test_prefix_hit_logit_equivalence(llama_parts):
+    """The acceptance bar: rerunning a cached prompt (full-cover hit)
+    and a shared-prefix-different-tail prompt (partial hit) must emit
+    the same tokens AND the same logits as an engine with the cache
+    off. The hit path installs shared pages + COW instead of prefill,
+    so any aliasing bug surfaces here."""
+    model, variables = llama_parts
+    rng = np.random.default_rng(21)
+    shared = rng.integers(1, 200, size=12).tolist()
+    reqs = [
+        Request("cold", list(shared), 4),
+        Request("rerun", list(shared), 4),           # full-cover hit
+        Request("fork", shared[:12] + [7, 9], 4),    # partial hit
+    ]
+    cached = _engine(model, variables, prefix_cache=True)
+    plain = _engine(model, variables, prefix_cache=False)
+    got, got_log = _run_capture(cached, [Request(r.rid, list(r.prompt),
+                                                 r.max_new_tokens)
+                                         for r in reqs])
+    want, want_log = _run_capture(plain, reqs)
+    stats = cached.stats()
+    assert stats["prefix_hits"] >= 2
+    assert stats["prefix_hit_rate"] > 0
+    assert stats["prefix_hit_tokens"] > 0
+    for r in reqs:
+        assert got[r.rid].tokens == want[r.rid].tokens, r.rid
+        assert len(got_log[r.rid]) == len(want_log[r.rid])
+        for i, (a, b) in enumerate(zip(got_log[r.rid], want_log[r.rid])):
+            np.testing.assert_allclose(
+                a, b, atol=1e-5, rtol=1e-5,
+                err_msg=f"{r.rid} generated token {i}")
+
+
+def test_shared_pages_are_never_mutated_in_place(llama_parts):
+    """Pages the cache shares out are immutable: a borrowing request
+    that generates past the shared prefix must COW, not write. Byte
+    snapshot of the shared pages before/after a borrowing generation."""
+    model, variables = llama_parts
+    rng = np.random.default_rng(22)
+    shared = rng.integers(1, 200, size=12).tolist()
+    eng = _engine(model, variables, prefix_cache=True)
+    eng.run([Request("seed", list(shared), 2)])
+
+    hit = eng._prefix.lookup(shared)
+    assert hit is not None and hit.pages
+    snap = [(np.asarray(eng._cache["k"][0][p]).copy(),
+             np.asarray(eng._cache["v"][0][p]).copy()) for p in hit.pages]
+    eng._allocator.free(hit.pages)
+
+    eng.run([Request("borrow", shared[:12] + [3, 5], 6)])
+    assert eng.stats()["cow_copies"] >= 1
+    hit2 = eng._prefix.lookup(shared)
+    assert hit2 is not None and hit2.pages == hit.pages
+    for p, (k0, v0) in zip(hit2.pages, snap):
+        np.testing.assert_array_equal(
+            np.asarray(eng._cache["k"][0][p]), k0,
+            err_msg=f"shared page {p} K mutated")
+        np.testing.assert_array_equal(
+            np.asarray(eng._cache["v"][0][p]), v0,
+            err_msg=f"shared page {p} V mutated")
+    eng._allocator.free(hit2.pages)
+
+    # release-to-zero: dropping the cache returns every page
+    eng._prefix.clear()
+    assert eng._allocator.available == eng.cache_cfg.num_pages - 1
+
+
+def test_admit_burst_fills_all_free_slots(llama_parts):
+    """M2KT_SERVE_ADMIT_BURST regression: burst<=0 admits every free
+    slot in one step; the default (1) keeps the one-admission-per-step
+    pacing."""
+    model, variables = llama_parts
+    rng = np.random.default_rng(23)
+    reqs = [Request(f"r{i}", rng.integers(1, 200, size=6).tolist(), 8)
+            for i in range(4)]
+
+    burst = _engine(model, variables, max_batch=4, admit_burst=0)
+    for r in reqs:
+        burst.submit(Request(r.rid, list(r.prompt), r.max_new_tokens))
+    burst.step()
+    assert sum(s is not None for s in burst._slots) == 4
+
+    paced = _engine(model, variables, max_batch=4)  # admit_burst=1
+    for r in reqs:
+        paced.submit(Request(r.rid, list(r.prompt), r.max_new_tokens))
+    paced.step()
+    assert sum(s is not None for s in paced._slots) == 1
+    # both drain to the same completions regardless of admission pacing
+    done_b = {c.rid: c.tokens for c in burst.run([])}
+    done_p = {c.rid: c.tokens for c in paced.run([])}
+    assert done_b == done_p and set(done_b) == {r.rid for r in reqs}
+
+
+# ----------------------------------------------------------------------
+# disaggregated prefill/decode
+# ----------------------------------------------------------------------
+
+def test_kv_handoff_wire_roundtrip():
+    rng = np.random.default_rng(5)
+    kv = [(rng.standard_normal((1, 16, 2, 8)).astype(np.float32),
+           rng.standard_normal((1, 16, 2, 8)).astype(np.float32))
+          for _ in range(3)]
+    h = KVHandoff(rid="x", prompt=[1, 2, 3], prompt_len=3, bucket=16,
+                  first_token=42, kv=kv, max_new_tokens=7)
+    h2 = KVHandoff.from_bytes(h.to_bytes())
+    assert (h2.rid, h2.prompt, h2.prompt_len, h2.bucket, h2.first_token,
+            h2.max_new_tokens) == ("x", [1, 2, 3], 3, 16, 42, 7)
+    assert len(h2.kv) == 3
+    for (k, v), (k2, v2) in zip(kv, h2.kv):
+        np.testing.assert_array_equal(k, k2)
+        np.testing.assert_array_equal(v, v2)
+
+    # future wire versions must be rejected, not mis-parsed
+    blob = h.to_bytes()
+    import io
+    import zipfile
+
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        names = z.namelist()
+    assert "meta.npy" in names
+    bad = dataclasses.replace(h)
+    bad_bytes = bad.to_bytes().replace(b'"v": 1', b'"v": 9')
+    # savez compresses, so flip the version through the dataclass instead
+    import move2kube_tpu.serving.fleet.disagg as disagg
+
+    old = disagg._WIRE_VERSION
+    try:
+        disagg._WIRE_VERSION = 9
+        blob9 = h.to_bytes()
+    finally:
+        disagg._WIRE_VERSION = old
+    with pytest.raises(ValueError):
+        KVHandoff.from_bytes(blob9)
+    del bad_bytes
+
+
+def test_disagg_handoff_equivalence(llama_parts):
+    """Prefill-on-replica-A + install-on-engine-B must decode the same
+    tokens as the engine doing its own prefill."""
+    model, variables = llama_parts
+    rng = np.random.default_rng(31)
+    reqs = [Request(f"d{i}", rng.integers(1, 200, size=n).tolist(), 4)
+            for i, n in enumerate((6, 12, 9))]
+
+    plain = _engine(model, variables)
+    want = {c.rid: c.tokens for c in plain.run(
+        [Request(r.rid, list(r.prompt), r.max_new_tokens) for r in reqs])}
+
+    prefill = PrefillReplica(model, variables,
+                             EngineConfig(max_batch=2, max_seq=64,
+                                          block_size=8, buckets=(16, 32)))
+    decode = _engine(model, variables)
+    pair = DisaggPair(prefill, decode, InProcessTransport())
+    got = {c.rid: c.tokens for c in pair.run(reqs)}
+    assert got == want
+
+
+# ----------------------------------------------------------------------
+# router placement / failover / hedging
+# ----------------------------------------------------------------------
+
+class FakeReplica(ReplicaHandle):
+    def __init__(self, name, depth=0.0):
+        self.name = name
+        self.depth = depth
+        self.calls = 0
+        self.fail_next = 0
+        self.hold_s = 0.0
+        self.up = True
+
+    def generate(self, prompt, max_new_tokens=None, rid=None):
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError(f"{self.name}: injected failure")
+        if self.hold_s:
+            time.sleep(self.hold_s)
+        self.calls += 1
+        return {"rid": rid or "r", "replica": self.name,
+                "prompt_len": len(prompt), "tokens": [1, 2],
+                "finish_reason": "length"}
+
+    def queue_depth(self):
+        return self.depth
+
+    def healthy(self):
+        return self.up
+
+
+def _fake_router(n=3, **cfg):
+    replicas = [FakeReplica(f"rep-{i}") for i in range(n)]
+    return Router(replicas, config=RouterConfig(**cfg)), replicas
+
+
+def test_router_affinity_is_stable():
+    router, replicas = _fake_router()
+    prompt = list(range(50, 70))
+    first = router.generate(prompt)["replica"]
+    for _ in range(5):
+        assert router.generate(prompt)["replica"] == first
+    assert router._affinity_hits.value >= 6
+    # a different salt may remap the tenant; the hash must at least move
+    assert prefix_hash(prompt, "a") != prefix_hash(prompt, "b")
+    # only keys owned by a removed replica move (rendezvous property)
+    survivors = [r for r in replicas if r.name != first]
+    rerouted = Router(survivors, config=RouterConfig())
+    other_prompt = None
+    for seed in range(100):
+        p = list(range(seed, seed + 8))
+        owner = router.pick(p).name
+        if owner != first:
+            other_prompt = (p, owner)
+            break
+    assert other_prompt is not None
+    p, owner = other_prompt
+    assert rerouted.pick(p).name == owner
+
+
+def test_router_failover_marks_down_and_probe_recovers():
+    router, replicas = _fake_router()
+    prompt = list(range(10))
+    affine = router.pick(prompt)
+    affine.fail_next = 1
+    out = router.generate(prompt)
+    assert out["replica"] != affine.name
+    assert router._retries.value == 1
+    assert router._up[affine.name] is False
+    # the replica answers its health check again -> probe() readmits it
+    router.probe()
+    assert router._up[affine.name] is True
+    assert router.generate(prompt)["replica"] == affine.name
+
+
+def test_router_spills_on_deep_queue():
+    router, replicas = _fake_router(spill_queue_depth=2.0)
+    prompt = list(range(30, 40))
+    affine = router.pick(prompt)
+    affine.depth = 10.0
+    others = [r for r in replicas if r.name is not affine.name]
+    others[0].depth = 1.0
+    picked = router.pick(prompt)
+    assert picked.name != affine.name
+    assert router._spills.value >= 1
+
+
+def test_router_hedging_fires_and_first_wins():
+    router, replicas = _fake_router(hedge_after_s=0.05)
+    prompt = list(range(5))
+    affine = router.pick(prompt)
+    affine.hold_s = 0.5
+    t0 = time.perf_counter()
+    out = router.generate(prompt)
+    dt = time.perf_counter() - t0
+    assert out["replica"] != affine.name  # the hedge won
+    assert router._hedges.value == 1
+    assert dt < 0.5  # did not wait out the slow primary
+
+
+def test_router_all_down_raises():
+    router, replicas = _fake_router(max_retries=1)
+    for r in replicas:
+        r.fail_next = 5
+    with pytest.raises(RuntimeError):
+        router.generate([1, 2, 3])
+    assert router._requests.labels(outcome="error").value == 1
+
+
+def test_router_http_front():
+    router, replicas = _fake_router()
+    srv = RouterHTTPServer(router, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_new_tokens": 2}).encode()
+        req = urllib.request.Request(
+            f"{base}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read().decode())
+        assert out["tokens"] == [1, 2]
+        with urllib.request.urlopen(f"{base}/readyz", timeout=10) as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "m2kt_router_requests_total" in text
+        assert "m2kt_router_replica_up" in text
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_in_process_fleet_end_to_end(llama_parts):
+    """Two real engine replicas behind the router: same-tenant requests
+    stick to one replica and the second one hits its prefix cache."""
+    model, variables = llama_parts
+    cfg = EngineConfig(max_batch=2, max_seq=64, block_size=8,
+                       buckets=(16, 32), prefix_cache=True)
+    router = build_fleet(model, variables, 2, engine_config=cfg)
+    try:
+        rng = np.random.default_rng(41)
+        tenant = rng.integers(1, 200, size=12).tolist()
+        outs = [router.generate(list(tenant), 3) for _ in range(3)]
+        assert len({o["replica"] for o in outs}) == 1
+        hits = sum(r.engine.stats().get("prefix_hits", 0)
+                   for r in router.replicas)
+        assert hits >= 2
+        tok0 = outs[0]["tokens"]
+        assert all(o["tokens"] == tok0 for o in outs)
+    finally:
+        for r in router.replicas:
+            r.close()
+
+
+# ----------------------------------------------------------------------
+# emission: per-role manifests, HPAs, Helm lift
+# ----------------------------------------------------------------------
+
+def _serving_ir():
+    from move2kube_tpu.types.ir import IR, Service
+    from move2kube_tpu.types.plan import AcceleratorInfo
+
+    svc = Service(
+        name="llm",
+        containers=[{
+            "name": "llm", "image": "llm:latest",
+            "ports": [{"containerPort": 8080},
+                      {"name": "metrics", "containerPort": 9090}],
+            "env": [{"name": "M2KT_METRICS_PORT", "value": "9090"},
+                    {"name": "M2KT_SERVE_MAX_BATCH", "value": "8"}],
+        }],
+        accelerator=AcceleratorInfo(serving=True, serving_port=8080,
+                                    tpu_accelerator="tpu-v5-lite-podslice",
+                                    tpu_topology="2x2"),
+    )
+    return IR(services={"llm": svc}), svc
+
+
+def _fleet_env(monkeypatch, prefill="1"):
+    monkeypatch.setenv("M2KT_FLEET", "1")
+    monkeypatch.setenv("M2KT_FLEET_ROUTERS", "1")
+    monkeypatch.setenv("M2KT_FLEET_PREFILL", prefill)
+    monkeypatch.setenv("M2KT_FLEET_DECODE", "3")
+    monkeypatch.setenv("M2KT_FLEET_AFFINITY_SALT", "blue")
+
+
+def test_fleet_deployment_emission(monkeypatch):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    _fleet_env(monkeypatch)
+    ir, svc = _serving_ir()
+    objs = DeploymentAPIResource().create_new_resources(
+        ir, {"Deployment", "JobSet"})
+    by = {(o["kind"], o["metadata"]["name"]): o for o in objs}
+    assert set(by) == {
+        ("Deployment", "llm-router"), ("Deployment", "llm-prefill"),
+        ("Deployment", "llm-decode"),
+        ("HorizontalPodAutoscaler", "llm-router"),
+        ("HorizontalPodAutoscaler", "llm-prefill"),
+        ("HorizontalPodAutoscaler", "llm-decode"),
+        ("Service", "llm-prefill"), ("Service", "llm-decode"),
+    }
+    # router pods keep the front Service's selector label; engines don't
+    router = by[("Deployment", "llm-router")]
+    assert router["spec"]["selector"]["matchLabels"][
+        "move2kube-tpu.io/service"] == "llm"
+    decode = by[("Deployment", "llm-decode")]
+    assert decode["spec"]["selector"]["matchLabels"][
+        "move2kube-tpu.io/service"] == "llm-decode"
+    assert decode["spec"]["replicas"] == 3
+    rc = router["spec"]["template"]["spec"]["containers"][0]
+    renv = {e["name"]: e.get("value") for e in rc["env"]}
+    assert renv["M2KT_FLEET_ROLE"] == "router"
+    assert renv["M2KT_ROUTER_BACKENDS"] == "llm-decode:8080"
+    assert renv["M2KT_FLEET_PREFILL_SERVICE"] == "llm-prefill:8080"
+    assert renv["M2KT_FLEET_AFFINITY_SALT"] == "blue"
+    assert "google.com/tpu" not in rc.get("resources", {}).get("limits", {})
+    assert rc["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    dc = decode["spec"]["template"]["spec"]["containers"][0]
+    denv = {e["name"]: e.get("value") for e in dc["env"]}
+    assert denv["M2KT_FLEET_ROLE"] == "decode"
+    assert denv["M2KT_SERVE_PREFIX_CACHE"] == "1"
+    assert dc["resources"]["limits"]["google.com/tpu"] == 4
+    # HPA targets: queue depth for router/prefill, slot occupancy decode
+    assert by[("HorizontalPodAutoscaler", "llm-router")]["spec"][
+        "metrics"][0]["pods"]["metric"]["name"] == "m2kt_serve_queue_depth"
+    assert by[("HorizontalPodAutoscaler", "llm-decode")]["spec"][
+        "metrics"][0]["pods"]["metric"]["name"] == \
+        "m2kt_serve_slot_occupancy"
+    # backend role Services are headless (router needs pod IPs)
+    assert by[("Service", "llm-decode")]["spec"]["clusterIP"] == "None"
+    assert by[("Service", "llm-decode")]["spec"]["selector"][
+        "move2kube-tpu.io/service"] == "llm-decode"
+
+
+def test_fleet_off_keeps_single_workload(monkeypatch):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    monkeypatch.setenv("M2KT_FLEET", "0")
+    ir, svc = _serving_ir()
+    objs = DeploymentAPIResource().create_new_resources(
+        ir, {"Deployment", "JobSet"})
+    kinds = [(o["kind"], o["metadata"]["name"]) for o in objs]
+    assert ("Deployment", "llm") in kinds
+    assert not any("router" in n for _, n in kinds)
+
+
+def test_fleet_knative_emission(monkeypatch):
+    from move2kube_tpu.apiresource.knative import KnativeServiceAPIResource
+
+    _fleet_env(monkeypatch)
+    ir, svc = _serving_ir()
+    objs = KnativeServiceAPIResource(create=True).create_new_resources(
+        ir, {"Service"})
+    kn = {o["metadata"]["name"]: o for o in objs if o["kind"] == "Service"}
+    assert set(kn) == {"llm-router", "llm-prefill", "llm-decode"}
+    ann = kn["llm-decode"]["spec"]["template"]["metadata"]["annotations"]
+    assert ann["autoscaling.knative.dev/class"] == \
+        "hpa.autoscaling.knative.dev"
+    assert ann["autoscaling.knative.dev/metric"] == \
+        "m2kt_serve_slot_occupancy"
+    rann = kn["llm-router"]["spec"]["template"]["metadata"]["annotations"]
+    assert rann["autoscaling.knative.dev/metric"] == "m2kt_serve_queue_depth"
+    assert rann["autoscaling.knative.dev/minScale"] == "1"
+
+
+def test_fleet_optimizer_and_helm_lift(monkeypatch):
+    from move2kube_tpu.passes.optimize import tpu_fleet_optimizer
+    from move2kube_tpu.passes.parameterize import tpu_fleet_parameterizer
+
+    _fleet_env(monkeypatch)
+    ir, svc = _serving_ir()
+    ir = tpu_fleet_optimizer(ir)
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_FLEET"] == "1"
+    assert env["M2KT_FLEET_DECODE"] == "3"
+    assert env["M2KT_SERVE_PREFIX_CACHE"] == "1"
+    ir = tpu_fleet_parameterizer(ir)
+    gv = ir.values.global_variables
+    assert gv["tpufleet"] == "1"
+    assert gv["tpufleetrouters"] == "1"
+    assert gv["tpufleetprefill"] == "1"
+    assert gv["tpufleetdecode"] == "3"
+    assert gv["tpufleetsalt"] == "blue"
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_FLEET_DECODE"] == "{{ .Values.tpufleetdecode }}"
+    assert env["M2KT_FLEET_AFFINITY_SALT"] == "{{ .Values.tpufleetsalt }}"
+    # idempotent: already-lifted refs are not double-wrapped
+    ir = tpu_fleet_parameterizer(ir)
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_FLEET_DECODE"] == "{{ .Values.tpufleetdecode }}"
+
+
+def test_fleet_package_is_vendored():
+    from move2kube_tpu.containerizer.jax_emit import _vendor_package
+    from move2kube_tpu.types.ir import Container
+
+    c = Container()
+    _vendor_package(c)
+    for mod in ("__init__", "router", "prefixcache", "disagg"):
+        assert f"move2kube_tpu/serving/fleet/{mod}.py" in c.new_files
