@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -136,6 +136,16 @@ mfu-smoke:
 fleet-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_fleet.py -q
 	$(CPU_ENV) $(PY) bench.py --model fleet
+
+# low-precision serving in isolation (all CPU-mode): quant policy +
+# int8 weight/KV round-trips, tiered logit gates, spec-decode greedy
+# exactness + acceptance, executable-bound and donation under
+# quantization, then the bench quant phase (fp32 vs int8 vs int8-kv vs
+# spec-decode decode tok/s; FAILS unless int8 beats fp32, the logit
+# gate holds, params shrink, and spec matches greedy exactly)
+quant-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_quant.py -q
+	$(CPU_ENV) $(PY) bench.py --model quant
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

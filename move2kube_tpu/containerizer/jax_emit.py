@@ -258,6 +258,27 @@ def _ask_serving_knobs(name: str) -> dict:
             log.warning("invalid %s answer %r for %s; using %s",
                         qid, raw, name, default)
             knobs[key] = int(default)
+    # low-precision policy is a select (three valid spellings, not a
+    # number); spec_k rides the numeric loop's conventions but allows 0
+    raw = qa.fetch_select(
+        f"m2kt.services.{name}.serve.quant",
+        f"Select the serving quantization policy for [{name}]",
+        ["int8 halves weight (and optionally KV-cache) HBM traffic — "
+         "decode is bandwidth-bound, so bytes are tokens/s"],
+        "off", ["off", "int8", "int8-kv"])
+    knobs["quant"] = raw if raw in ("off", "int8", "int8-kv") else "off"
+    raw = qa.fetch_input(
+        f"m2kt.services.{name}.serve.speck",
+        f"Enter the speculative-decoding proposal length for [{name}]",
+        ["tokens the draft model proposes per verify step; 0 disables "
+         "speculative decoding"],
+        "0")
+    try:
+        knobs["spec_k"] = max(0, int(raw))
+    except (TypeError, ValueError):
+        log.warning("invalid serve.speck answer %r for %s; using 0",
+                    raw, name)
+        knobs["spec_k"] = 0
     return knobs
 
 
@@ -407,6 +428,8 @@ def emit_container(service: PlanService, plan=None) -> Container:
                     "serve_max_batch": serve_knobs["max_batch"],
                     "serve_max_seq": serve_knobs["max_seq"],
                     "serve_kv_block": serve_knobs["kv_block"],
+                    "serve_quant": serve_knobs["quant"],
+                    "spec_k": serve_knobs["spec_k"],
                     "compile_cache_dir": "/app/.jax-cache",
                     "metrics_port": metrics_port,
                 }))

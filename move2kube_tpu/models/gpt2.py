@@ -86,19 +86,34 @@ class GPT2Block(nn.Module):
             # token's K/V into its page, attend over the block table
             # (models/llama.py LlamaBlock carries the same path; GPT-2 is
             # MHA, so the kernel's GQA batching degenerates to rep=1)
-            from move2kube_tpu.ops.attention import paged_decode_attention
+            from move2kube_tpu.ops.attention import (
+                paged_decode_attention, quantize_kv_rows)
 
             k_pages, v_pages = cache["k"], cache["v"]
             block_size = k_pages.shape[1]
             pos = cache["positions"]
             blk = cache["block_tables"][jnp.arange(b), pos // block_size]
             off = pos % block_size
-            k_pages = k_pages.at[blk, off].set(k[:, 0])
-            v_pages = v_pages.at[blk, off].set(v[:, 0])
+            k_scale = cache.get("k_scale")
+            v_scale = cache.get("v_scale")
+            if k_scale is not None:
+                # int8 cache: quantized rows + per-(token, kv-head) scales
+                qk, sk = quantize_kv_rows(k[:, 0])
+                qv, sv = quantize_kv_rows(v[:, 0])
+                k_pages = k_pages.at[blk, off].set(qk)
+                v_pages = v_pages.at[blk, off].set(qv)
+                k_scale = k_scale.at[blk, off].set(sk)
+                v_scale = v_scale.at[blk, off].set(sv)
+            else:
+                k_pages = k_pages.at[blk, off].set(
+                    k[:, 0].astype(k_pages.dtype))
+                v_pages = v_pages.at[blk, off].set(
+                    v[:, 0].astype(v_pages.dtype))
             o = paged_decode_attention(
                 q[:, 0], k_pages, v_pages, cache["block_tables"],
-                cache["seq_lens"]).reshape(b, 1, d)
-            new_kv = (k_pages, v_pages)
+                cache["seq_lens"], k_scale=k_scale,
+                v_scale=v_scale).reshape(b, 1, d)
+            new_kv = (k_pages, v_pages, k_scale, v_scale)
         elif cfg.attn_impl in ("ring", "ulysses"):
             # shared dispatcher with the Llama stack (ring/ulysses run
             # under shard_map on the mesh's seq axis, degrading to flash
@@ -140,7 +155,8 @@ class GPT2(nn.Module):
                        name="wpe")
         if cache is not None:
             x = wte(input_ids[:, None]) + wpe(positions[:, None])
-            new_k, new_v = [], []
+            quantized = "k_scale" in cache
+            new_k, new_v, new_ks, new_vs = [], [], [], []
             for i in range(cfg.num_layers):
                 layer_cache = {
                     "k": cache["k"][i], "v": cache["v"][i],
@@ -148,10 +164,15 @@ class GPT2(nn.Module):
                     "seq_lens": cache["seq_lens"],
                     "positions": positions,
                 }
-                x, (kp, vp) = GPT2Block(cfg, name=f"h_{i}")(
+                if quantized:
+                    layer_cache["k_scale"] = cache["k_scale"][i]
+                    layer_cache["v_scale"] = cache["v_scale"][i]
+                x, (kp, vp, ksp, vsp) = GPT2Block(cfg, name=f"h_{i}")(
                     x, cache=layer_cache)
                 new_k.append(kp)
                 new_v.append(vp)
+                new_ks.append(ksp)
+                new_vs.append(vsp)
             x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
                              name="ln_f")(x)
             logits = (x.astype(jnp.float32)
@@ -159,6 +180,9 @@ class GPT2(nn.Module):
             out_cache = dict(cache)
             out_cache["k"] = type(cache["k"])(new_k)
             out_cache["v"] = type(cache["v"])(new_v)
+            if quantized:
+                out_cache["k_scale"] = type(cache["k_scale"])(new_ks)
+                out_cache["v_scale"] = type(cache["v_scale"])(new_vs)
             return logits[:, 0], out_cache
         b, s = input_ids.shape
         if positions is None:

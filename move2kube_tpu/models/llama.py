@@ -163,7 +163,8 @@ class LlamaBlock(nn.Module):
             # write this token's K/V into its page, then attend over the
             # pages named by the block table. No GQA repeat here — the
             # paged kernel batches query heads per KV head itself.
-            from move2kube_tpu.ops.attention import paged_decode_attention
+            from move2kube_tpu.ops.attention import (
+                paged_decode_attention, quantize_kv_rows)
 
             k_pages, v_pages = cache["k"], cache["v"]
             block_size = k_pages.shape[1]
@@ -171,12 +172,27 @@ class LlamaBlock(nn.Module):
             slot = jnp.arange(b)
             blk = cache["block_tables"][slot, pos // block_size]
             off = pos % block_size
-            k_pages = k_pages.at[blk, off].set(k[:, 0])
-            v_pages = v_pages.at[blk, off].set(v[:, 0])
+            k_scale = cache.get("k_scale")
+            v_scale = cache.get("v_scale")
+            if k_scale is not None:
+                # int8 cache: quantize this token's rows and write the
+                # per-(token, kv-head) scales alongside the pages
+                qk, sk = quantize_kv_rows(k[:, 0])
+                qv, sv = quantize_kv_rows(v[:, 0])
+                k_pages = k_pages.at[blk, off].set(qk)
+                v_pages = v_pages.at[blk, off].set(qv)
+                k_scale = k_scale.at[blk, off].set(sk)
+                v_scale = v_scale.at[blk, off].set(sv)
+            else:
+                k_pages = k_pages.at[blk, off].set(
+                    k[:, 0].astype(k_pages.dtype))
+                v_pages = v_pages.at[blk, off].set(
+                    v[:, 0].astype(v_pages.dtype))
             o = paged_decode_attention(
                 q[:, 0], k_pages, v_pages, cache["block_tables"],
-                cache["seq_lens"]).reshape(b, 1, q_size)
-            new_kv = (k_pages, v_pages)
+                cache["seq_lens"], k_scale=k_scale,
+                v_scale=v_scale).reshape(b, 1, q_size)
+            new_kv = (k_pages, v_pages, k_scale, v_scale)
         else:
             # GQA: repeat KV heads up to the query head count
             rep = cfg.num_heads // cfg.num_kv_heads
@@ -237,17 +253,23 @@ class Llama(nn.Module):
             x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                          name="embed")(input_ids[:, None])
             pos2d = positions[:, None]
-            new_k, new_v = [], []
+            quantized = "k_scale" in cache
+            new_k, new_v, new_ks, new_vs = [], [], [], []
             for i in range(cfg.num_layers):
                 layer_cache = {
                     "k": cache["k"][i], "v": cache["v"][i],
                     "block_tables": cache["block_tables"],
                     "seq_lens": cache["seq_lens"],
                 }
-                x, (kp, vp) = LlamaBlock(cfg, name=f"layer_{i}")(
+                if quantized:
+                    layer_cache["k_scale"] = cache["k_scale"][i]
+                    layer_cache["v_scale"] = cache["v_scale"][i]
+                x, (kp, vp, ksp, vsp) = LlamaBlock(cfg, name=f"layer_{i}")(
                     x, pos2d, None, cache=layer_cache)
                 new_k.append(kp)
                 new_v.append(vp)
+                new_ks.append(ksp)
+                new_vs.append(vsp)
             x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
             logits = nn.Dense(cfg.vocab_size, use_bias=False,
                               dtype=jnp.float32,
@@ -255,6 +277,9 @@ class Llama(nn.Module):
             out_cache = dict(cache)
             out_cache["k"] = type(cache["k"])(new_k)
             out_cache["v"] = type(cache["v"])(new_v)
+            if quantized:
+                out_cache["k_scale"] = type(cache["k_scale"])(new_ks)
+                out_cache["v_scale"] = type(cache["v_scale"])(new_vs)
             return logits[:, 0], out_cache
         b, s = input_ids.shape
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,

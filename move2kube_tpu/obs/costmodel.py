@@ -256,12 +256,17 @@ class CostReport:
         return ("compute" if ai >= spec.ridge_flops_per_byte
                 else "bandwidth")
 
-    def mfu(self, step_seconds: float | None, spec: ChipSpec) -> float | None:
+    def mfu(self, step_seconds: float | None, spec: ChipSpec,
+            int8: bool = False) -> float | None:
         """Achieved model-FLOP utilization of one chip for a measured
-        step wall time; None when either half is unknown."""
+        step wall time; None when either half is unknown. ``int8``
+        divides by the chip's int8 peak instead of bf16 — quantized
+        serving must be judged against the throughput the quantization
+        unlocked, or its MFU reads dishonestly high."""
         if not self.flops or not step_seconds or step_seconds <= 0:
             return None
-        return (self.flops / step_seconds) / spec.peak_bf16_flops
+        peak = spec.peak_int8_flops if int8 else spec.peak_bf16_flops
+        return (self.flops / step_seconds) / peak
 
     def mfu_ceiling(self, spec: ChipSpec) -> float | None:
         """Roofline MFU ceiling: a bandwidth-bound program cannot exceed
@@ -386,15 +391,21 @@ def export_train_gauges(report: CostReport, registry=None, *,
 
 def export_serving_gauges(reports: dict, registry=None, *,
                           accelerator: str = "",
-                          decode_step_seconds: float | None = None) -> None:
+                          decode_step_seconds: float | None = None,
+                          quant: str = "off") -> None:
     """Per-executable serving gauges from ``{name: CostReport}`` (the
-    engine's bucketed prefills + the decode step): roofline class and
-    step FLOPs labeled by executable, peak-HBM by (executable, category),
-    and an achieved decode MFU when the engine has timing."""
+    engine's bucketed prefills + the decode/verify steps): roofline class
+    and step FLOPs labeled by executable, peak-HBM by (executable,
+    category), and an achieved decode MFU when the engine has timing.
+    ``quant`` names the serving quant policy: the cost reports already
+    reflect the quantized buffers (memory_analysis sees the int8
+    executables), and MFU is judged against the chip's int8 peak when
+    weights are quantized."""
     from move2kube_tpu.obs.metrics import default_registry
 
     reg = registry if registry is not None else default_registry()
     spec, _ = chip_spec(accelerator)
+    int8 = quant != "off"
     bound = reg.gauge(
         "m2kt_serve_roofline_bound",
         "Roofline class per serving executable (1 compute, 0 bandwidth, "
@@ -416,12 +427,13 @@ def export_serving_gauges(reports: dict, registry=None, *,
         total = report.peak_hbm_bytes
         if total is not None:
             hbm.labels(executable=name, category="total").set(total)
-    decode = reports.get("decode")
+    # with spec decoding on, verify IS the steady-state decode executable
+    decode = reports.get("verify") or reports.get("decode")
     if decode is not None:
         reg.gauge(
             "m2kt_serve_mfu",
             "Achieved decode-step MFU per chip (0 = unknown)",
-        ).set(decode.mfu(decode_step_seconds, spec) or 0.0)
+        ).set(decode.mfu(decode_step_seconds, spec, int8=int8) or 0.0)
 
 
 def export_drift_gauge(predicted_total: float | None,

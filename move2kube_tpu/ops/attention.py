@@ -596,12 +596,52 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None
 # --------------------------------------------------------------------------
 
 
+def quantize_kv_rows(x):
+    """Symmetric per-(token, kv-head) int8 quantization of K/V rows.
+
+    ``x``: [..., kv_heads, head_dim] floating K or V. Returns
+    ``(q, scale)`` — ``q`` int8 with the same shape, ``scale`` fp32
+    shaped [..., kv_heads] such that ``q * scale[..., None]``
+    reconstructs ``x``. One scale per written row keeps decode appends
+    O(1): a new token never re-quantizes tokens already resident in its
+    page (a per-page amax would clip or force a rewrite)."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _dequant_pages(pages, scales):
+    """[num_pages, block_size, kvh, d] int8 + [num_pages, block_size, kvh]
+    fp32 -> fp32 pages."""
+    return pages.astype(jnp.float32) * scales[..., None]
+
+
 def _paged_decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
-                            scale: float):
+                            scale: float, k_scale=None, v_scale=None):
     b, h, d = q.shape
     _, block_size, kvh, _ = k_pages.shape
     mb = block_tables.shape[1]
-    # gather each sequence's pages into a contiguous context
+    # gather each sequence's pages into a contiguous context; int8 caches
+    # gather the quantized pages + their row scales and dequantize only
+    # the gathered context (never the whole pool)
+    if k_scale is not None:
+        k = (k_pages[block_tables].astype(jnp.float32)
+             * k_scale[block_tables][..., None])
+        v = (v_pages[block_tables].astype(jnp.float32)
+             * v_scale[block_tables][..., None])
+        k = k.reshape(b, mb * block_size, kvh, d)
+        v = v.reshape(b, mb * block_size, kvh, d)
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32), k) * scale
+        valid = (jnp.arange(mb * block_size)[None, None, :]
+                 < seq_lens[:, None, None])
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhs,bshd->bhd", p, v).astype(q.dtype)
     k = k_pages[block_tables].reshape(b, mb * block_size, kvh, d)
     v = v_pages[block_tables].reshape(b, mb * block_size, kvh, d)
     rep = h // kvh
@@ -718,7 +758,8 @@ def _paged_decode_tpu(q, k_pages, v_pages, block_tables, seq_lens,
 
 
 def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
-                           scale: float | None = None):
+                           scale: float | None = None,
+                           k_scale=None, v_scale=None):
     """Decode-step attention against a paged KV cache. GQA-aware.
 
     - ``q``: [batch, heads, head_dim] — ONE new query token per slot
@@ -727,13 +768,24 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
       (unused entries must point at page 0, reserved by the allocator)
     - ``seq_lens``: [batch] int32 valid-token counts, INCLUDING the token
       being decoded (its K/V must already be written to the cache)
+    - ``k_scale``/``v_scale``: optional [num_pages, block_size, kv_heads]
+      fp32 row scales for int8 page pools (serving/kvcache.py quantized
+      caches); dequantization happens here, on the gathered context only
 
     TPU with a lane-aligned head_dim takes the Pallas kernel; anything
-    else (CPU tests, odd shapes) the jnp gather fallback.
+    else (CPU tests, odd shapes) the jnp gather fallback. Quantized
+    caches always take the jnp path: int8 operands need (32, 128) tiles
+    (pallas_guide.md) and the serving block sizes (8/16 tokens) under-
+    fill the sublane dimension — the gather + row-scale dequant is left
+    to XLA until a 32-token-page int8 kernel is worth carrying.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     d = q.shape[-1]
     block_size = k_pages.shape[1]
+    if k_scale is not None:
+        return _paged_decode_reference(q, k_pages, v_pages, block_tables,
+                                       seq_lens, scale, k_scale=k_scale,
+                                       v_scale=v_scale)
     if (jax.default_backend() == "tpu" and d % 128 == 0
             and block_size % 8 == 0):
         try:

@@ -200,6 +200,27 @@ def tpu_serving_optimizer(ir: IR) -> IR:
                 knobs[env_name] = str(max(1, int(raw)))
             except (TypeError, ValueError):
                 knobs[env_name] = default
+        # low-precision + speculative-decoding knobs (same QA ids as the
+        # jax-xla emitter's _ask_serving_knobs, so the baked template
+        # defaults and this env never disagree)
+        raw = qa.fetch_select(
+            f"m2kt.services.{name}.serve.quant",
+            f"Select the serving quantization policy for [{name}]",
+            ["int8 halves weight (and optionally KV-cache) HBM traffic — "
+             "decode is bandwidth-bound, so bytes are tokens/s"],
+            "off", ["off", "int8", "int8-kv"])
+        knobs["M2KT_SERVE_QUANT"] = (
+            raw if raw in ("off", "int8", "int8-kv") else "off")
+        raw = qa.fetch_input(
+            f"m2kt.services.{name}.serve.speck",
+            f"Enter the speculative-decoding proposal length for [{name}]",
+            ["tokens the draft model proposes per verify step; 0 disables "
+             "speculative decoding"],
+            "0")
+        try:
+            knobs["M2KT_SPEC_K"] = str(max(0, int(raw)))
+        except (TypeError, ValueError):
+            knobs["M2KT_SPEC_K"] = "0"
         for container in svc.containers:
             env = container.setdefault("env", [])
             existing = {e.get("name") for e in env}

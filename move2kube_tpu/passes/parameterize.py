@@ -78,13 +78,18 @@ def tpu_training_parameterizer(ir: IR) -> IR:
 def tpu_serving_parameterizer(ir: IR) -> IR:
     """Lift the serving capacity knobs the serving optimizer injected
     (``M2KT_SERVE_MAX_BATCH`` / ``M2KT_SERVE_MAX_SEQ`` /
-    ``M2KT_KV_BLOCK_SIZE``) into chart values, so a Helm install resizes
-    the decode batch, context length, and KV page size per environment
-    (``--set tpuservemaxbatch=16``) without touching the manifests. Same
-    first-service-seeds-defaults shape as the training parameterizer."""
+    ``M2KT_KV_BLOCK_SIZE`` / ``M2KT_SERVE_QUANT`` / ``M2KT_SPEC_K``)
+    into chart values, so a Helm install resizes the decode batch,
+    context length, and KV page size — or flips quantization and
+    speculative decoding — per environment
+    (``--set tpuservemaxbatch=16 --set tpuservequant=int8-kv``) without
+    touching the manifests. Same first-service-seeds-defaults shape as
+    the training parameterizer."""
     lifted = {"M2KT_SERVE_MAX_BATCH": "tpuservemaxbatch",
               "M2KT_SERVE_MAX_SEQ": "tpuservemaxseq",
-              "M2KT_KV_BLOCK_SIZE": "tpukvblocksize"}
+              "M2KT_KV_BLOCK_SIZE": "tpukvblocksize",
+              "M2KT_SERVE_QUANT": "tpuservequant",
+              "M2KT_SPEC_K": "tpuspeck"}
     for svc in ir.services.values():
         acc = getattr(svc, "accelerator", None)
         if acc is None or not getattr(acc, "serving", False):

@@ -40,6 +40,28 @@ Env knobs (docs/USAGE.md):
   (default off)
 - ``M2KT_PREFIX_MAX_SUFFIX`` longest un-cached suffix a hit may
   decode-feed before falling back to cold prefill (default 2 pages)
+- ``M2KT_SERVE_QUANT``      serving quant policy off|int8|int8-kv
+  (serving/quant.py; default off)
+- ``M2KT_SPEC_K``           speculative-decoding proposal length; 0
+  disables (default 0)
+
+Low-precision serving (``quant``): weights are quantized ONCE at engine
+construction (per-output-channel int8, serving/quant.py) and dequantized
+inside the jitted steps, so every compiled executable carries int8
+parameter buffers; ``int8-kv`` additionally stores the paged KV cache in
+int8 with per-row scale pools that ride every page operation COW does.
+
+Speculative decoding (``spec_k`` > 0): a shrunk same-family draft model
+(first half of the target's layers, sharing its embeddings and head)
+proposes ``k`` tokens per step with ``k + 1`` reuses of ONE fixed-shape
+draft decode executable, and the target verifies the whole window in ONE
+fixed-shape verify executable (``k + 1`` decode passes unrolled inside a
+single jit). The verify step REPLACES the plain decode step in the
+engine loop, so the target-model executable count stays
+``num_buckets + 1``; the draft adds at most ``num_buckets + 1`` more
+small-model executables, reported separately by ``compile_report``.
+Acceptance is greedy-exact: emitted tokens are always the target's own
+argmax choices, so spec-on and spec-off decode the same token stream.
 """
 
 from __future__ import annotations
@@ -57,9 +79,11 @@ import numpy as np
 from move2kube_tpu.obs import tracing
 from move2kube_tpu.obs.metrics import Registry
 from move2kube_tpu.serving import kvcache
+from move2kube_tpu.serving import quant as quantlib
 from move2kube_tpu.serving.fleet.prefixcache import PrefixCache, PrefixHit
 from move2kube_tpu.serving.kvcache import (
     NULL_PAGE,
+    PAGE_KEYS,
     PageAllocator,
     copy_page,
     init_cache,
@@ -96,6 +120,11 @@ class EngineConfig:
     admit_burst: int = 1       # admissions per step; <= 0 = all free slots
     prefix_cache: bool = False
     prefix_max_suffix: int = 0  # 0 -> 2 * block_size
+    quant: str = "off"         # off | int8 | int8-kv (serving/quant.py)
+    spec_k: int = 0            # draft proposals per step; 0 = no spec decode
+    # draft depth divisor: num_layers // factor layers (1 = full-depth
+    # draft — acceptance 1.0, useful as a correctness anchor)
+    spec_draft_factor: int = 2
 
     def resolved_buckets(self) -> tuple[int, ...]:
         buckets = self.buckets or _default_buckets(self.max_seq)
@@ -129,6 +158,9 @@ class EngineConfig:
                 "M2KT_SERVE_PREFIX_CACHE", "").lower() in ("1", "true", "on"),
             prefix_max_suffix=_int("M2KT_PREFIX_MAX_SUFFIX",
                                    cls.prefix_max_suffix),
+            quant=(lambda q: q if q in quantlib.QUANT_OPTIONS else "off")(
+                os.environ.get("M2KT_SERVE_QUANT", "") or cls.quant),
+            spec_k=max(0, _int("M2KT_SPEC_K", cls.spec_k)),
         )
         cfg.update(overrides)
         return cls(**cfg)
@@ -175,12 +207,21 @@ class ServingEngine:
                  registry: Registry | None = None,
                  tracer: "tracing.SpanRecorder | None" = None):
         self.model = model
-        self.variables = variables
         self.config = config or EngineConfig.from_env()
+        self.quant = quantlib.policy(self.config.quant)
+        if self.quant.quantize_weights:
+            # once, at construction: the jitted steps dequantize INSIDE
+            # the compiled program, so the executables' parameter buffers
+            # are the int8 tensors
+            variables = quantlib.quantize_variables(variables)
+        self.variables = variables
+        self._dq = (quantlib.dequantize_variables
+                    if self.quant.quantize_weights else (lambda v: v))
         self.buckets = self.config.resolved_buckets()
         self.cache_cfg = spec_for_model(
             model.cfg, block_size=self.config.block_size,
-            max_batch=self.config.max_batch, max_seq=self.config.max_seq)
+            max_batch=self.config.max_batch, max_seq=self.config.max_seq,
+            cache_dtype=self.quant.cache_dtype)
         self._cache = init_cache(self.cache_cfg)
         self._allocator = PageAllocator(self.cache_cfg.num_pages)
         self._slots: list[_Slot | None] = [None] * self.config.max_batch
@@ -188,6 +229,24 @@ class ServingEngine:
         self._prefill = self._make_prefill()
         self._decode = self._make_decode()
         self._install, self._copy, self._install_kv = self._make_table_ops()
+        # speculative decoding: draft model (shrunk same-family config
+        # sharing the target's embeddings/head) + its own paged cache with
+        # IDENTICAL page geometry, so page indices map 1:1 and every
+        # allocator/prefix-cache decision covers both caches
+        self.spec_k = max(0, self.config.spec_k)
+        self._spec_slack = self.spec_k  # scratch positions a verify window
+        self._draft_cache = None        # may write past the sequence end
+        if self.spec_k:
+            draft_cfg = quantlib.draft_config(
+                model.cfg, self.config.spec_draft_factor)
+            self._draft_model = type(model)(draft_cfg)
+            self.draft_variables = quantlib.draft_variables_from(
+                self.variables, draft_cfg)
+            self._draft_cache = init_cache(dataclasses.replace(
+                self.cache_cfg, num_layers=draft_cfg.num_layers))
+            self._draft_prefill = self._make_prefill(self._draft_model)
+            self._draft_decode = self._make_decode(self._draft_model)
+            self._verify = self._make_verify()
         self._prefix: PrefixCache | None = None
         if self.config.prefix_cache:
             self._prefix = PrefixCache(self.cache_cfg.block_size,
@@ -262,6 +321,19 @@ class ServingEngine:
         self._prefix_pages = reg.gauge(
             "m2kt_serve_prefix_cache_pages",
             "KV pages currently pinned by the prefix cache")
+        self._spec_proposed = reg.counter(
+            "m2kt_serve_spec_proposed_total",
+            "Draft tokens proposed to the verify step")
+        self._spec_accepted = reg.counter(
+            "m2kt_serve_spec_accepted_total",
+            "Draft tokens accepted by the verify step")
+        self._spec_acceptance = reg.gauge(
+            "m2kt_serve_spec_acceptance_rate",
+            "Accepted / proposed draft tokens (cumulative)")
+        self._quant_mode = reg.gauge(
+            "m2kt_serve_quant_mode",
+            "Serving quant policy (0=off, 1=int8, 2=int8-kv)")
+        self._quant_mode.set(quantlib.QUANT_OPTIONS.index(self.quant.name))
         self._total_pages = max(1, self.cache_cfg.num_pages - 1)  # page 0 reserved
         self._update_occupancy()
 
@@ -279,12 +351,13 @@ class ServingEngine:
     # jitted device steps (the ONLY code that runs on the accelerator)
     # ------------------------------------------------------------------
 
-    def _make_prefill(self):
-        model, block_size = self.model, self.cache_cfg.block_size
+    def _make_prefill(self, model=None):
+        model = model or self.model
+        block_size, dq = self.cache_cfg.block_size, self._dq
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def prefill(variables, cache, ids, bt_row, slot, prompt_len):
-            logits, kvs = model.apply(variables, ids, return_kv=True)
+            logits, kvs = model.apply(dq(variables), ids, return_kv=True)
             cache = scatter_prefill(cache, kvs, slot, bt_row, prompt_len,
                                     block_size)
             first = jnp.argmax(logits[0, prompt_len - 1]).astype(jnp.int32)
@@ -292,8 +365,8 @@ class ServingEngine:
 
         return prefill
 
-    def _make_decode(self):
-        model = self.model
+    def _make_decode(self, model=None):
+        model, dq = model or self.model, self._dq
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def decode(variables, cache, tokens, active):
@@ -302,19 +375,53 @@ class ServingEngine:
             # redirect them to the reserved null page
             bt = jnp.where(active[:, None], cache["block_tables"], NULL_PAGE)
             pos = jnp.where(active, cache["seq_lens"], 0)
-            model_cache = {"k": cache["k"], "v": cache["v"],
-                           "block_tables": bt, "seq_lens": pos + 1}
+            model_cache = {k: cache[k] for k in PAGE_KEYS if k in cache}
+            model_cache["block_tables"] = bt
+            model_cache["seq_lens"] = pos + 1
             logits, model_cache = model.apply(
-                variables, tokens, positions=pos, cache=model_cache)
-            new_cache = {
-                "k": model_cache["k"], "v": model_cache["v"],
-                "block_tables": cache["block_tables"],
-                "seq_lens": cache["seq_lens"] + active.astype(jnp.int32),
-            }
+                dq(variables), tokens, positions=pos, cache=model_cache)
+            new_cache = {k: model_cache[k] for k in PAGE_KEYS if k in cache}
+            new_cache["block_tables"] = cache["block_tables"]
+            new_cache["seq_lens"] = (cache["seq_lens"]
+                                     + active.astype(jnp.int32))
             next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return logits, next_tokens, new_cache
 
         return decode
+
+    def _make_verify(self):
+        """The spec-decode verify step: ``spec_k + 1`` single-token decode
+        passes unrolled inside ONE jit — one fixed-shape executable
+        regardless of how the window's tokens split between forced
+        prompt-suffix tokens and draft proposals. ``tokens`` is
+        ``[max_batch, spec_k + 1]`` (the slot's last token followed by
+        the window); returns the target logits after each consumed token
+        ``[max_batch, spec_k + 1, vocab]``. ``seq_lens`` is NOT advanced
+        here — the host sets it to the accepted length, which only
+        acceptance (a host decision) can know."""
+        model, dq, W = self.model, self._dq, self.spec_k + 1
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def verify(variables, cache, tokens, active):
+            params = dq(variables)
+            bt = jnp.where(active[:, None], cache["block_tables"], NULL_PAGE)
+            base = jnp.where(active, cache["seq_lens"], 0)
+            pages = {k: cache[k] for k in PAGE_KEYS if k in cache}
+            all_logits = []
+            for j in range(W):
+                pos = base + j
+                model_cache = dict(pages)
+                model_cache["block_tables"] = bt
+                model_cache["seq_lens"] = pos + 1
+                logits, model_cache = model.apply(
+                    params, tokens[:, j], positions=pos, cache=model_cache)
+                pages = {k: model_cache[k] for k in pages}
+                all_logits.append(logits)
+            new_cache = dict(cache)
+            new_cache.update(pages)
+            return jnp.stack(all_logits, axis=1), new_cache
+
+        return verify
 
     def _make_table_ops(self):
         """Three small donated steps for admissions that skip prefill:
@@ -352,10 +459,12 @@ class ServingEngine:
                 raise ValueError(
                     f"{req.rid}: prompt length {plen} exceeds the largest "
                     f"prefill bucket {self.buckets[-1]}")
-            if plen + max_new > self.cache_cfg.max_seq:
+            if plen + max_new + self._spec_slack > self.cache_cfg.max_seq:
+                slack = (f" + spec_k {self._spec_slack}"
+                         if self._spec_slack else "")
                 raise ValueError(
-                    f"{req.rid}: prompt + max_new_tokens = {plen + max_new} "
-                    f"exceeds max_seq {self.cache_cfg.max_seq}")
+                    f"{req.rid}: prompt + max_new_tokens = {plen + max_new}"
+                    f"{slack} exceeds max_seq {self.cache_cfg.max_seq}")
         except ValueError:
             self._rejected.inc()
             raise
@@ -378,6 +487,8 @@ class ServingEngine:
         active slot. Returns the sequences that finished this
         iteration."""
         finished = self._admit_pending()
+        if self.spec_k:
+            return self._spec_step(finished)
         active_mask = np.array([s is not None for s in self._slots])
         if not active_mask.any():
             return finished
@@ -435,6 +546,117 @@ class ServingEngine:
             done = self._finish_reason(slot, tok)
             if done:
                 finished.append(self._release(i, done))
+        self._update_occupancy()
+        return finished
+
+    def _spec_step(self, finished: list[Completion]) -> list[Completion]:
+        """One speculative engine iteration. Window layout per slot:
+        ``X = [last_token, w_1 .. w_k]`` where the first
+        ``f = min(len(pending), k)`` window tokens are forced ground
+        truth (a prefix-hit's prompt suffix) and the rest are draft
+        proposals. The draft runs ``k + 1`` micro-steps of its one
+        fixed-shape decode executable (micro-step j writes ``X[j]``'s
+        draft KV and proposes ``X[j + 1]``; the last proposal is
+        discarded), then ONE verify executable scores the whole window.
+
+        Greedy-exact acceptance: proposal ``X[f+1+i]`` is accepted iff it
+        equals the target's argmax after consuming ``X[0..f+i]``, and the
+        first miss is replaced by that argmax (the bonus token) — so
+        every emitted token is the target's own greedy choice, and the
+        worst case (0 accepted) still emits 1 token like plain decode.
+        KV written past the accepted length is stale-by-construction:
+        ``seq_lens`` is rolled back to the accepted length, masking it
+        until later steps overwrite it."""
+        k = self.spec_k
+        active_mask = np.array([s is not None for s in self._slots])
+        if not active_mask.any():
+            return finished
+        base = np.asarray(self._cache["seq_lens"]).copy()
+        X = np.zeros((self.config.max_batch, k + 1), np.int32)
+        X[:, 0] = [s.last_token if s else 0 for s in self._slots]
+        forced = np.zeros((self.config.max_batch,), np.int64)
+        for i, s in enumerate(self._slots):
+            if s is not None and s.pending:
+                f = min(len(s.pending), k)
+                X[i, 1:1 + f] = s.pending[:f]
+                forced[i] = f
+        t0 = time.perf_counter()
+        draft_cache = self._draft_cache
+        for j in range(k + 1):
+            _, nxt, draft_cache = self._draft_decode(
+                self.draft_variables, draft_cache, X[:, j].copy(),
+                active_mask)
+            if j < k:
+                proposals = np.asarray(nxt)
+                fill = forced <= j  # rows whose slot j+1 is not forced
+                X[fill, j + 1] = proposals[fill]
+        logits, cache = self._verify(
+            self.variables, self._cache, X, active_mask)
+        logits_np = np.asarray(logits)  # [max_batch, k+1, vocab]; blocks
+        dt = time.perf_counter() - t0
+        targets = np.argmax(logits_np, axis=-1).astype(np.int32)
+        produced = 0
+        new_lens = base.copy()
+        for i, slot in enumerate(list(self._slots)):
+            if slot is None:
+                continue
+            f = int(forced[i])
+            del slot.pending[:f]
+            a = 0
+            while f + 1 + a <= k and X[i, f + 1 + a] == targets[i, f + a]:
+                a += 1
+            self._spec_proposed.inc(k - f)
+            self._spec_accepted.inc(a)
+            if slot.pending:
+                # suffix longer than the window: every input was ground
+                # truth (f == k), nothing is emitted this step
+                slot.last_token = int(X[i, k])
+                new_lens[i] = base[i] + k + 1
+                continue
+            emitted = [int(targets[i, f + j]) for j in range(a + 1)]
+            new_lens[i] = base[i] + f + a + 1
+            slot.last_token = emitted[-1]
+            if slot.prefix_hit and not slot.tokens:
+                submit_ts = self._submit_ts.pop(slot.req.rid, None)
+                if submit_ts is not None:
+                    ttft = t0 + dt - submit_ts
+                    self._ttft_hist.observe(ttft)
+                    root = self._req_spans.get(slot.req.rid)
+                    if root is not None:
+                        root.attrs["ttft_s"] = ttft
+            done = None
+            for m, tok in enumerate(emitted):
+                if self.capture_logits:
+                    self.logit_log.setdefault(slot.req.rid, []).append(
+                        logits_np[i, f + m].copy())
+                slot.tokens.append(tok)
+                produced += 1
+                done = self._finish_reason(slot, tok)
+                if done:
+                    slot.last_token = tok
+                    break
+            if self.tracer is not None:
+                root = self._req_spans.get(slot.req.rid)
+                if root is not None:
+                    self.tracer.record(
+                        "serve.spec_step", t0, t0 + dt,
+                        attrs={"proposed": k - f, "accepted": a,
+                               "emitted": len(slot.tokens)},
+                        trace_id=root.trace_id, parent_id=root.span_id)
+            if done:
+                finished.append(self._release(i, done))
+        cache["seq_lens"] = jnp.asarray(new_lens, jnp.int32)
+        draft_cache["seq_lens"] = jnp.asarray(new_lens, jnp.int32)
+        self._cache = cache
+        self._draft_cache = draft_cache
+        self._decode_time += dt
+        self._decode_tokens += produced
+        self._lat_hist.observe(dt)
+        self._decode_steps_total.inc()
+        self._tokens_total.inc(produced)
+        prop = self._spec_proposed.value
+        if prop:
+            self._spec_acceptance.set(self._spec_accepted.value / prop)
         self._update_occupancy()
         return finished
 
@@ -543,7 +765,7 @@ class ServingEngine:
         bs = self.cache_cfg.block_size
         c = hit.covered
         w = c // bs  # page index position c (the first write) lands in
-        n_total = pages_for(plen + max_new, bs)
+        n_total = pages_for(plen + max_new + self._spec_slack, bs)
         priv = self._alloc_with_evict(n_total - w)
         if priv is None:
             self._allocator.free(hit.pages)
@@ -565,6 +787,16 @@ class ServingEngine:
                                np.int32(int(bt_row[w])))
             self._cow_copies.inc()
         self._cache = cache
+        if self._draft_cache is not None:
+            # pages map 1:1, so the shared pages' DRAFT K/V (written when
+            # the prefix first prefilled cold) is hit for free — mirror
+            # the table surgery, including the COW copy
+            dc = self._install(self._draft_cache, np.int32(slot_idx),
+                               bt_row, np.int32(c))
+            if cow:
+                dc = self._copy(dc, np.int32(hit.pages[w]),
+                                np.int32(int(bt_row[w])))
+            self._draft_cache = dc
         if hit.pages[w:]:
             self._allocator.free(hit.pages[w:])  # refs not kept past copy
         slot = _Slot(req=req, pages=list(hit.pages[:w]) + priv, tokens=[],
@@ -593,7 +825,7 @@ class ServingEngine:
     def _admit_cold(self, req: Request, slot_idx: int, plen: int,
                     max_new: int) -> tuple[bool, list[Completion]]:
         bs = self.cache_cfg.block_size
-        n_pages = pages_for(plen + max_new, bs)
+        n_pages = pages_for(plen + max_new + self._spec_slack, bs)
         # a page-unaligned prompt that will be donated to the prefix
         # cache needs one spare page: the boundary page becomes shared
         # at insert, and this slot's own generation copy-on-writes it
@@ -621,6 +853,13 @@ class ServingEngine:
             self.variables, self._cache, ids, bt_row,
             np.int32(slot_idx), np.int32(plen))
         self._cache = cache
+        if self._draft_cache is not None:
+            # same ids, same pages: the draft's K/V for this prompt lands
+            # in the SAME page indices the target owns
+            _, _, dc = self._draft_prefill(
+                self.draft_variables, self._draft_cache, ids, bt_row,
+                np.int32(slot_idx), np.int32(plen))
+            self._draft_cache = dc
         self._prefill_count += 1
         self._admitted.inc()
         if self._prefix is not None:
@@ -692,6 +931,11 @@ class ServingEngine:
                               np.int32(plen))
         self._cache = self._copy(cache, np.int32(boundary), np.int32(new))
         self._cow_copies.inc()
+        if self._draft_cache is not None:
+            dc = self._install(self._draft_cache, np.int32(slot_idx),
+                               bt_row, np.int32(plen))
+            self._draft_cache = self._copy(dc, np.int32(boundary),
+                                           np.int32(new))
         slot.pages[m - 1] = new
         self._allocator.free([boundary])  # slot's ref; the cache keeps its
 
@@ -709,7 +953,8 @@ class ServingEngine:
         plen = int(prompt_len)
         max_new = req.max_new_tokens or self.config.max_new_tokens
         bucket = int(kvs[0][0].shape[1])
-        if plen < 1 or plen + max_new > self.cache_cfg.max_seq:
+        if (plen < 1
+                or plen + max_new + self._spec_slack > self.cache_cfg.max_seq):
             self._rejected.inc()
             raise ValueError(f"{req.rid}: handoff of {plen} prompt + "
                              f"{max_new} new tokens does not fit max_seq "
@@ -721,8 +966,8 @@ class ServingEngine:
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free:
             return False, []
-        pages = self._alloc_with_evict(
-            pages_for(plen + max_new, self.cache_cfg.block_size))
+        pages = self._alloc_with_evict(pages_for(
+            plen + max_new + self._spec_slack, self.cache_cfg.block_size))
         if pages is None:
             return False, []
         slot_idx = free[0]
@@ -732,6 +977,16 @@ class ServingEngine:
         kvs = [(jnp.asarray(k), jnp.asarray(v)) for k, v in kvs]
         self._cache = self._install_kv(self._cache, kvs, bt_row,
                                        np.int32(slot_idx), np.int32(plen))
+        if self._draft_cache is not None:
+            # the handoff carries only the TARGET model's K/V; the draft's
+            # comes from a local draft prefill over the same prompt — a
+            # small-model forward, still no target prefill on this replica
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :plen] = req.prompt[:plen]
+            _, _, dc = self._draft_prefill(
+                self.draft_variables, self._draft_cache, ids, bt_row,
+                np.int32(slot_idx), np.int32(plen))
+            self._draft_cache = dc
         self._admitted.inc()
         tok = int(first_token)
         slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
@@ -791,10 +1046,21 @@ class ServingEngine:
             "persistent_cache_new_entries":
                 self.persistent_cache_new_entries(),
         }
-        if (report["prefill_executables"] >= 0
-                and report["decode_executables"] >= 0):
-            report["total_executables"] = (report["prefill_executables"]
-                                           + report["decode_executables"])
+        counted = [report["prefill_executables"],
+                   report["decode_executables"]]
+        if self.spec_k:
+            # the verify step REPLACES decode in the engine loop, so the
+            # target-model total stays <= num_buckets + 1; the draft's
+            # small-model executables are reported but not counted — the
+            # bound is about the big-model programs device memory holds
+            report["verify_executables"] = cache_size(self._verify)
+            report["draft_prefill_executables"] = \
+                cache_size(self._draft_prefill)
+            report["draft_decode_executables"] = \
+                cache_size(self._draft_decode)
+            counted.append(report["verify_executables"])
+        if all(c >= 0 for c in counted):
+            report["total_executables"] = sum(counted)
         if include_cost:
             # opt-in: lowering every bucket is seconds of work, too slow
             # for the fast smokes that only count executables
@@ -834,20 +1100,33 @@ class ServingEngine:
             # decode is the steady-state resident: its memory analysis is
             # what the OOM flight sidecar should carry for a serving pod
             costmodel.note_memory_report(decode)
+        if self.spec_k:
+            compiled = costmodel.lower_and_compile(
+                self._verify, self.variables, self._cache,
+                np.zeros((self.config.max_batch, self.spec_k + 1), np.int32),
+                np.zeros((self.config.max_batch,), bool))
+            if compiled is not None:
+                verify = costmodel.analyze_compiled(compiled)
+                reports["verify"] = verify
+                # with spec on, verify (not decode) is the steady-state
+                # resident the OOM sidecar should describe
+                costmodel.note_memory_report(verify)
         spec, _ = costmodel.chip_spec(accelerator)
         decode_step = (self._decode_time / self._lat_hist.count
                        if self._lat_hist.count else None)
         costmodel.export_serving_gauges(
             reports, self.registry, accelerator=accelerator,
-            decode_step_seconds=decode_step)
+            decode_step_seconds=decode_step, quant=self.quant.name)
         out = {}
         for name, rep in reports.items():
             entry = rep.to_dict()
             entry["roofline"] = rep.roofline(spec)
             out[name] = entry
-        if "decode" in out:
-            out["decode"]["achieved_mfu"] = reports["decode"].mfu(
-                decode_step, spec)
+        int8 = self.quant.name != "off"
+        for name in ("decode", "verify"):
+            if name in out:
+                out[name]["achieved_mfu"] = reports[name].mfu(
+                    decode_step, spec, int8=int8)
         return out
 
     def stats(self) -> dict:
@@ -880,4 +1159,16 @@ class ServingEngine:
             out["prefix_hit_tokens"] = int(self._prefix_hit_tokens.value)
             out["prefix_cache_pages"] = self._prefix.total_pages
             out["cow_copies"] = int(self._cow_copies.value)
+        if self.spec_k:
+            prop = self._spec_proposed.value
+            acc = self._spec_accepted.value
+            out["spec_proposed"] = int(prop)
+            out["spec_accepted"] = int(acc)
+            out["spec_acceptance_rate"] = acc / prop if prop else 0.0
+            # tokens landed per verify step: the spec-decode payoff —
+            # 1.0 means plain decode, > 1 means freed bandwidth became
+            # accepted tokens
+            steps = int(self._lat_hist.count)
+            out["spec_tokens_per_step"] = (
+                self._decode_tokens / steps if steps else 0.0)
         return out

@@ -95,9 +95,20 @@ class PrefillReplica:
 
     def __init__(self, model, variables, config: EngineConfig | None = None,
                  registry: Registry | None = None):
+        from move2kube_tpu.serving import quant as quantlib
+
         self.model = model
-        self.variables = variables
         self.config = config or EngineConfig.from_env()
+        # same weight policy as the decode engine: the prefill executable
+        # carries int8 parameter buffers and dequantizes inside the jit
+        # (the handoff K/V stays full precision — the decode side's
+        # scatter quantizes it into its own cache layout)
+        policy = quantlib.policy(self.config.quant)
+        if policy.quantize_weights:
+            variables = quantlib.quantize_variables(variables)
+        dq = (quantlib.dequantize_variables if policy.quantize_weights
+              else (lambda v: v))
+        self.variables = variables
         self.buckets = self.config.resolved_buckets()
         self.registry = registry if registry is not None else Registry()
         self._prefills = self.registry.counter(
@@ -108,7 +119,7 @@ class PrefillReplica:
 
         @functools.partial(jax.jit, static_argnums=())
         def prefill(variables, ids, prompt_len):
-            logits, kvs = model.apply(variables, ids, return_kv=True)
+            logits, kvs = model.apply(dq(variables), ids, return_kv=True)
             first = jnp.argmax(logits[0, prompt_len - 1]).astype(jnp.int32)
             return first, kvs
 

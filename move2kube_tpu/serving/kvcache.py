@@ -30,6 +30,11 @@ import jax.numpy as jnp
 
 NULL_PAGE = 0
 
+# every per-page pool a cache pytree may carry; copy_page and the engine's
+# model-cache assembly iterate this instead of hardcoding k/v, so the int8
+# scale pools ride every page operation the fp pools do
+PAGE_KEYS = ("k", "v", "k_scale", "v_scale")
+
 
 @dataclasses.dataclass(frozen=True)
 class KVCacheConfig:
@@ -40,19 +45,34 @@ class KVCacheConfig:
     num_pages: int = 65         # pool size, INCLUDING the reserved page 0
     max_batch: int = 8          # concurrent decode slots
     max_pages_per_seq: int = 16  # block-table row length
+    # dtype the K/V pages are STORED in — fp32/bf16 caches store compute-
+    # dtype rows, int8 caches store quantized rows plus per-row fp32
+    # scales ([num_pages, block_size, kv_heads] per layer, one scale per
+    # written token row per kv head). One config field drives one shared
+    # code path; nothing downstream re-derives the dtype from the model.
     dtype: Any = jnp.float32
+    scale_dtype: Any = jnp.float32
 
     @property
     def max_seq(self) -> int:
         return self.max_pages_per_seq * self.block_size
 
+    @property
+    def quantized(self) -> bool:
+        """True when pages store int8 rows and the cache carries the
+        ``k_scale``/``v_scale`` per-row scale pools."""
+        return jnp.dtype(self.dtype) == jnp.dtype(jnp.int8)
+
 
 def spec_for_model(model_cfg, *, block_size: int = 16, max_batch: int = 8,
                    max_seq: int | None = None,
-                   num_pages: int | None = None) -> KVCacheConfig:
+                   num_pages: int | None = None,
+                   cache_dtype: Any = None) -> KVCacheConfig:
     """Cache geometry for a model config (LlamaConfig or GPT2Config,
     duck-typed: MHA models have no ``num_kv_heads``). ``num_pages``
-    defaults to one full-length context per slot plus the null page."""
+    defaults to one full-length context per slot plus the null page.
+    ``cache_dtype`` overrides the page storage dtype (int8 enables the
+    quantized layout); default is the model's compute dtype."""
     num_kv_heads = getattr(model_cfg, "num_kv_heads", model_cfg.num_heads)
     head_dim = model_cfg.d_model // model_cfg.num_heads
     if max_seq is None:
@@ -65,7 +85,7 @@ def spec_for_model(model_cfg, *, block_size: int = 16, max_batch: int = 8,
         num_layers=model_cfg.num_layers, num_kv_heads=num_kv_heads,
         head_dim=head_dim, block_size=block_size, num_pages=num_pages,
         max_batch=max_batch, max_pages_per_seq=max_pages,
-        dtype=model_cfg.dtype)
+        dtype=model_cfg.dtype if cache_dtype is None else cache_dtype)
 
 
 def pages_for(n_tokens: int, block_size: int) -> int:
@@ -79,13 +99,20 @@ def init_cache(cfg: KVCacheConfig) -> dict:
     (a single stacked array would leave aliasing of the per-layer
     dynamic-update-slices to XLA's discretion)."""
     shape = (cfg.num_pages, cfg.block_size, cfg.num_kv_heads, cfg.head_dim)
-    return {
+    cache = {
         "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.num_layers)],
         "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.num_layers)],
         "block_tables": jnp.zeros((cfg.max_batch, cfg.max_pages_per_seq),
                                   jnp.int32),
         "seq_lens": jnp.zeros((cfg.max_batch,), jnp.int32),
     }
+    if cfg.quantized:
+        sshape = (cfg.num_pages, cfg.block_size, cfg.num_kv_heads)
+        cache["k_scale"] = [jnp.zeros(sshape, cfg.scale_dtype)
+                            for _ in range(cfg.num_layers)]
+        cache["v_scale"] = [jnp.zeros(sshape, cfg.scale_dtype)
+                            for _ in range(cfg.num_layers)]
+    return cache
 
 
 def scatter_prefill(cache: dict, kvs, slot, bt_row, prompt_len,
@@ -104,11 +131,28 @@ def scatter_prefill(cache: dict, kvs, slot, bt_row, prompt_len,
     pos = jnp.arange(bucket)
     blk = jnp.where(pos < prompt_len, bt_row[pos // block_size], NULL_PAGE)
     off = pos % block_size
-    new_k, new_v = [], []
-    for layer, (k, v) in enumerate(kvs):
-        new_k.append(cache["k"][layer].at[blk, off].set(k[0]))
-        new_v.append(cache["v"][layer].at[blk, off].set(v[0]))
+    quantized = "k_scale" in cache
     out = dict(cache)
+    if quantized:
+        from move2kube_tpu.ops.attention import quantize_kv_rows
+
+        new_k, new_v, new_ks, new_vs = [], [], [], []
+        for layer, (k, v) in enumerate(kvs):
+            qk, sk = quantize_kv_rows(k[0])
+            qv, sv = quantize_kv_rows(v[0])
+            new_k.append(cache["k"][layer].at[blk, off].set(qk))
+            new_v.append(cache["v"][layer].at[blk, off].set(qv))
+            new_ks.append(cache["k_scale"][layer].at[blk, off].set(sk))
+            new_vs.append(cache["v_scale"][layer].at[blk, off].set(sv))
+        out["k_scale"], out["v_scale"] = new_ks, new_vs
+    else:
+        dtype = cache["k"][0].dtype
+        new_k, new_v = [], []
+        for layer, (k, v) in enumerate(kvs):
+            new_k.append(cache["k"][layer].at[blk, off].set(
+                k[0].astype(dtype)))
+            new_v.append(cache["v"][layer].at[blk, off].set(
+                v[0].astype(dtype)))
     out["k"], out["v"] = new_k, new_v
     out["block_tables"] = cache["block_tables"].at[slot].set(bt_row)
     out["seq_lens"] = cache["seq_lens"].at[slot].set(prompt_len)
@@ -120,10 +164,17 @@ def copy_page(cache: dict, src, dst) -> dict:
     half of copy-on-write: a slot about to write into a *shared* page
     (refcount > 1 in :class:`PageAllocator`) first duplicates it into a
     private page, then points its block-table entry at the copy — the
-    shared original stays immutable for every other holder."""
+    shared original stays immutable for every other holder.
+
+    Dtype-generic over every page pool the cache carries (``PAGE_KEYS``):
+    an int8 cache's ``k_scale``/``v_scale`` rows are copied alongside the
+    quantized pages, so a shared page and its scales stay byte-immutable
+    together — a COW copy that dropped the scales would dequantize the
+    copied rows with zeros."""
     out = dict(cache)
-    out["k"] = [k.at[dst].set(k[src]) for k in cache["k"]]
-    out["v"] = [v.at[dst].set(v[src]) for v in cache["v"]]
+    for key in PAGE_KEYS:
+        if key in cache:
+            out[key] = [a.at[dst].set(a[src]) for a in cache[key]]
     return out
 
 

@@ -1,0 +1,210 @@
+"""Post-training int8 serving quantization + draft-model helpers.
+
+Decode is HBM-bandwidth-bound (the roofline gauges classify every decode
+executable that way), so bytes-per-weight and bytes-per-KV-row are the
+throughput levers: this module provides the *weight* half and the policy
+that selects both halves, mirroring models/precision.py's shape (named
+frozen policies, ``policy(name)``, tolerant ``from_env``).
+
+Weight quantization is symmetric per-output-channel int8 applied once at
+restore time: every matmul kernel (flax ``Dense`` leaves, the only
+2-D+ params named ``kernel``) becomes an int8 tensor plus fp32 scales
+over its last (output-channel) axis; embeddings, layernorm/RMSNorm
+scales, and biases stay high precision. The engine's jitted steps call
+:func:`dequantize_variables` *inside* the compiled program, so the
+executable's parameter buffers — what lives in HBM and what
+``memory_analysis`` counts — are the int8 tensors, and the dequantized
+fp32 view is a transient the scheduler fuses into the consuming matmul.
+
+The KV half lives in serving/kvcache.py (``cache_dtype=int8`` +
+per-row scale pools); ``ops/attention.quantize_kv_rows`` is the shared
+row quantizer. Policies:
+
+- ``off``     — fp32/bf16 weights, compute-dtype KV cache (the anchor)
+- ``int8``    — int8 weights, compute-dtype KV cache
+- ``int8-kv`` — int8 weights AND int8 paged KV cache
+
+Draft-model helpers for speculative decoding: ``draft_config`` shrinks a
+model config to its first ``num_layers // factor`` layers and
+``draft_variables_from`` prunes the restored variables to match —
+embeddings, final norm, and lm_head are shared with the target, so the
+draft is a free byproduct of the restore, not a second checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+
+import jax.numpy as jnp
+
+QUANT_OPTIONS = ("off", "int8", "int8-kv")
+
+# quantized-kernel marker leaves: {"q8": int8 kernel, "scale": fp32 per-
+# output-channel scales (broadcastable: [1, ..., out])}
+_Q_KEYS = frozenset(("q8", "scale"))
+
+_LAYER_RE = re.compile(r"^(?:layer|h)_(\d+)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantPolicy:
+    name: str = "off"
+    quantize_weights: bool = False
+    quantize_kv: bool = False
+
+    @property
+    def cache_dtype(self):
+        """Storage dtype for the paged KV cache under this policy
+        (None = the model's compute dtype)."""
+        return jnp.int8 if self.quantize_kv else None
+
+
+_POLICIES = {
+    "off": QuantPolicy(),
+    "int8": QuantPolicy(name="int8", quantize_weights=True),
+    "int8-kv": QuantPolicy(name="int8-kv", quantize_weights=True,
+                           quantize_kv=True),
+}
+
+
+def policy(name: str) -> QuantPolicy:
+    try:
+        return _POLICIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown serving quant policy {name!r}; options: "
+            f"{', '.join(QUANT_OPTIONS)}") from None
+
+
+def from_env(default: str = "off", env=None) -> QuantPolicy:
+    """``M2KT_SERVE_QUANT`` names the policy; unknown names fall back to
+    ``default`` rather than killing a serving pod over an env typo."""
+    env = os.environ if env is None else env
+    name = env.get("M2KT_SERVE_QUANT", "") or default
+    try:
+        return policy(name)
+    except ValueError:
+        return policy(default)
+
+
+def _is_quantized_leaf(node) -> bool:
+    return isinstance(node, dict) and set(node) == _Q_KEYS
+
+
+def quantize_array(w):
+    """Symmetric per-output-channel int8 of one matmul kernel: the last
+    axis is the output-channel axis (flax Dense kernel [in, out]), every
+    other axis folds into the amax. Scales keep ``keepdims`` so the
+    dequant broadcast needs no reshape."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=tuple(range(w.ndim - 1)),
+                   keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"q8": q, "scale": scale.astype(jnp.float32)}
+
+
+def quantize_variables(variables):
+    """Quantize every matmul kernel in a restored variables pytree.
+
+    Kernels are the 2-D+ floating leaves named ``kernel`` — embeddings
+    (``embedding``), norm ``scale``/``bias``, and Dense biases are 1-D
+    or differently named and pass through in full precision, exactly
+    the policy the issue states. The result is still a dict pytree
+    (quantized leaves become ``{"q8", "scale"}`` sub-dicts), so it jits,
+    donates, and checkpoints like the original."""
+    def walk(node, name):
+        if isinstance(node, dict):
+            return {k: walk(v, k) for k, v in node.items()}
+        if (name == "kernel" and hasattr(node, "ndim") and node.ndim >= 2
+                and jnp.issubdtype(node.dtype, jnp.floating)):
+            return quantize_array(node)
+        return node
+
+    return walk(variables, "")
+
+
+def dequantize_variables(variables):
+    """Inverse view of :func:`quantize_variables` — called INSIDE the
+    engine's jitted steps so the executable's parameter inputs stay
+    int8 and the fp32 kernels exist only as fused transients."""
+    def walk(node):
+        if _is_quantized_leaf(node):
+            return node["q8"].astype(jnp.float32) * node["scale"]
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(variables)
+
+
+def param_bytes(variables) -> int:
+    """Total parameter-buffer bytes of a (possibly quantized) variables
+    pytree — what the compiled executables hold resident in HBM. The
+    quant bench gate checks the int8 tree genuinely shrank."""
+    import jax
+
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(variables)
+               if hasattr(x, "dtype"))
+
+
+def draft_config(cfg, factor: int = 2):
+    """Shrunk same-family draft config: the first
+    ``max(1, num_layers // factor)`` layers of the target. Everything
+    else (vocab, widths, heads) must match — the draft proposes token
+    ids the target verifies, so the vocab is load-bearing."""
+    return dataclasses.replace(
+        cfg, num_layers=max(1, cfg.num_layers // max(1, factor)))
+
+
+def draft_variables_from(variables, draft_cfg):
+    """Prune restored target variables down to ``draft_cfg``'s depth:
+    keep ``layer_i``/``h_i`` subtrees with ``i < draft_layers`` (they
+    are contiguous from 0, so no renumbering), share embeddings, final
+    norm, and lm_head verbatim. Works on quantized trees too — the
+    ``{"q8", "scale"}`` marker leaves are opaque dicts whose keys never
+    collide with the layer pattern."""
+    n = draft_cfg.num_layers
+
+    def prune(node):
+        if _is_quantized_leaf(node) or not isinstance(node, dict):
+            return node
+        out = {}
+        for key, sub in node.items():
+            m = _LAYER_RE.match(key)
+            if m and int(m.group(1)) >= n:
+                continue
+            out[key] = prune(sub)
+        return out
+
+    return prune(variables)
+
+
+def logit_gate(ref, got, eps: float = 1e-6) -> dict:
+    """Logit-error comparison between a reference (fp32) and a quantized
+    run over aligned logit rows: max absolute error, max relative error
+    (normalized by the reference row's dynamic range), and greedy top-1
+    agreement. The bench quant phase FAILS on divergence through these
+    numbers, not on slowness alone."""
+    import numpy as np
+
+    ref = np.asarray(ref, np.float32)
+    got = np.asarray(got, np.float32)
+    if ref.shape != got.shape:
+        raise ValueError(f"logit shape mismatch: {ref.shape} vs {got.shape}")
+    flat_ref = ref.reshape(-1, ref.shape[-1])
+    flat_got = got.reshape(-1, got.shape[-1])
+    span = np.maximum(
+        flat_ref.max(axis=-1) - flat_ref.min(axis=-1), eps)
+    abs_err = np.abs(flat_ref - flat_got).max(axis=-1)
+    agree = (flat_ref.argmax(axis=-1) == flat_got.argmax(axis=-1))
+    return {
+        "rows": int(flat_ref.shape[0]),
+        "max_abs_err": float(abs_err.max() if abs_err.size else 0.0),
+        "max_rel_err": float((abs_err / span).max() if abs_err.size
+                             else 0.0),
+        "top1_agreement": float(agree.mean() if agree.size else 1.0),
+    }

@@ -237,6 +237,83 @@ def test_shared_pages_are_never_mutated_in_place(llama_parts):
     assert eng._allocator.available == eng.cache_cfg.num_pages - 1
 
 
+def test_prefix_hit_logit_gate_int8_kv(llama_parts):
+    """Prefix cache over *quantized* pages: a cached hit replays the
+    same int8 bytes and per-row scales, but the hit path force-feeds
+    the uncovered prompt tail through decode — which attends over
+    DEQUANTIZED context, where the no-cache engine's prefill attends
+    over exact fp32 K/V. So hit-path logits sit at quantization noise,
+    not 1e-5: the bar is the relative-error logit gate while the
+    greedy trajectories coincide (ISSUE's "cached hit passes logit
+    gate"), plus the hits actually happening."""
+    from move2kube_tpu.serving import quant as quantlib
+
+    model, variables = llama_parts
+    rng = np.random.default_rng(24)
+    shared = rng.integers(1, 200, size=12).tolist()
+    reqs = [
+        Request("cold", list(shared), 4),
+        Request("rerun", list(shared), 4),
+        Request("fork", shared[:12] + [7, 9], 4),
+    ]
+    cached = _engine(model, variables, quant="int8-kv", prefix_cache=True)
+    plain = _engine(model, variables, quant="int8-kv", prefix_cache=False)
+    got, got_log = _run_capture(cached, [Request(r.rid, list(r.prompt),
+                                                 r.max_new_tokens)
+                                         for r in reqs])
+    want, want_log = _run_capture(plain, reqs)
+    assert cached.stats()["prefix_hits"] >= 2
+    gated_rows = 0
+    for r in reqs:
+        a_t, b_t = want[r.rid].tokens, got[r.rid].tokens
+        agree = 0
+        while agree < min(len(a_t), len(b_t)) and a_t[agree] == b_t[agree]:
+            agree += 1
+        for i in range(min(agree + 1, len(want_log[r.rid]),
+                           len(got_log[r.rid]))):
+            gate = quantlib.logit_gate(want_log[r.rid][i],
+                                       got_log[r.rid][i])
+            assert gate["max_rel_err"] < 0.05, (r.rid, i, gate)
+            gated_rows += 1
+    assert gated_rows >= len(reqs)
+
+
+def test_shared_int8_pages_cow_copies_scales(llama_parts):
+    """COW on a quantized cache: the shared page's int8 bytes AND its
+    k/v scale rows stay byte-immutable while a borrower generates past
+    the shared prefix, and release-to-zero still returns every page
+    (double-free guards hold with the extra scale pools in play)."""
+    model, variables = llama_parts
+    rng = np.random.default_rng(25)
+    shared = rng.integers(1, 200, size=12).tolist()
+    eng = _engine(model, variables, quant="int8-kv", prefix_cache=True)
+    eng.run([Request("seed", list(shared), 2)])
+
+    hit = eng._prefix.lookup(shared)
+    assert hit is not None and hit.pages
+    keys = ("k", "v", "k_scale", "v_scale")
+    snap = {key: [np.asarray(eng._cache[key][0][p]).copy()
+                  for p in hit.pages] for key in keys}
+    eng._allocator.free(hit.pages)
+
+    eng.run([Request("borrow", shared[:12] + [3, 5], 6)])
+    assert eng.stats()["cow_copies"] >= 1
+    hit2 = eng._prefix.lookup(shared)
+    assert hit2 is not None and hit2.pages == hit.pages
+    for key in keys:
+        for p, before in zip(hit2.pages, snap[key]):
+            np.testing.assert_array_equal(
+                np.asarray(eng._cache[key][0][p]), before,
+                err_msg=f"shared page {p} pool {key} mutated")
+    eng._allocator.free(hit2.pages)
+
+    eng._prefix.clear()
+    assert eng._allocator.available == eng.cache_cfg.num_pages - 1
+    # double-free still detected after the cache released everything
+    with pytest.raises(ValueError):
+        eng._allocator.free(hit.pages)
+
+
 def test_admit_burst_fills_all_free_slots(llama_parts):
     """M2KT_SERVE_ADMIT_BURST regression: burst<=0 admits every free
     slot in one step; the default (1) keeps the one-admission-per-step

@@ -1,0 +1,344 @@
+"""Low-precision serving tests: int8 weights, int8 paged KV cache,
+and draft-model speculative decoding.
+
+The correctness bars are tiered by what each mode may legally change:
+
+- fp32 anchor: the engine's own capture path reproduces itself (guards
+  the harness, not the model);
+- int8 weights / int8 KV: logits may move (quantization is lossy) but
+  must stay inside a tight relative-error gate while the greedy
+  trajectory coincides — cross-quant token streams are NOT asserted
+  equal, only the gated logit distance;
+- speculative decoding: zero tolerance — every emitted token is the
+  target model's own greedy choice, so spec-on and spec-off streams
+  must be *identical*, and the acceptance rate with a full-depth draft
+  must clear 0.5 (it is 1.0 by construction: the draft IS the target).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from move2kube_tpu.models.gpt2 import GPT2, gpt2_tiny
+from move2kube_tpu.models.llama import Llama, llama_tiny
+from move2kube_tpu.serving import quant as quantlib
+from move2kube_tpu.serving.engine import EngineConfig, Request, ServingEngine
+from move2kube_tpu.serving.kvcache import (
+    KVCacheConfig,
+    copy_page,
+    init_cache,
+    spec_for_model,
+)
+
+
+@pytest.fixture(scope="module")
+def llama_parts():
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def gpt2_parts():
+    cfg = dataclasses.replace(gpt2_tiny(), dtype=jnp.float32)
+    model = GPT2(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, **over) -> ServingEngine:
+    cfg = EngineConfig(**{**dict(max_batch=2, max_seq=64, block_size=8,
+                                 buckets=(16, 32)), **over})
+    return ServingEngine(model, variables, cfg)
+
+
+def _requests(seed, n=3, plen=10, max_new=6):
+    rng = np.random.default_rng(seed)
+    return [Request(f"r{i}", rng.integers(1, 200, size=plen).tolist(),
+                    max_new)
+            for i in range(n)]
+
+
+def _run_capture(eng, requests):
+    eng.capture_logits = True
+    comps = {c.rid: c for c in eng.run(requests)}
+    return comps, eng.logit_log
+
+
+# ----------------------------------------------------------------------
+# policy + array-level quantization
+# ----------------------------------------------------------------------
+
+def test_policy_table():
+    off = quantlib.policy("off")
+    assert not off.quantize_weights and not off.quantize_kv
+    assert off.cache_dtype is None
+    w8 = quantlib.policy("int8")
+    assert w8.quantize_weights and not w8.quantize_kv
+    assert w8.cache_dtype is None
+    kv8 = quantlib.policy("int8-kv")
+    assert kv8.quantize_weights and kv8.quantize_kv
+    assert kv8.cache_dtype == jnp.int8
+    with pytest.raises(ValueError):
+        quantlib.policy("fp4")
+
+
+def test_from_env_tolerant(monkeypatch):
+    monkeypatch.setenv("M2KT_SERVE_QUANT", "int8-kv")
+    assert quantlib.from_env().name == "int8-kv"
+    monkeypatch.setenv("M2KT_SERVE_QUANT", "bogus")
+    assert quantlib.from_env().name == "off"       # unknown -> default
+    monkeypatch.delenv("M2KT_SERVE_QUANT")
+    assert quantlib.from_env(default="int8").name == "int8"
+
+
+def test_quantize_array_roundtrip():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    q = quantlib.quantize_array(w)
+    assert q["q8"].dtype == jnp.int8 and q["q8"].shape == w.shape
+    # per-output-channel: one scale per trailing-axis column
+    assert q["scale"].shape == (1, 32)
+    back = q["q8"].astype(jnp.float32) * q["scale"]
+    # symmetric int8: worst-case error is half a step of the per-column
+    # scale
+    step = np.asarray(q["scale"])[0]
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    assert (err <= step * 0.5 + 1e-7).all()
+
+
+def test_quantize_variables_policy(llama_parts):
+    """Only matmul kernels drop to int8; embeddings and norm scales stay
+    high precision, and dequantize restores the original tree shape."""
+    _, variables = llama_parts
+    qv = quantlib.quantize_variables(variables)
+
+    kernels, others = [], []
+
+    def walk(node, in_q=False):
+        if isinstance(node, dict):
+            if set(node) == {"q8", "scale"}:
+                kernels.append(node)
+                return
+            for k, v in node.items():
+                walk(v, in_q)
+        else:
+            others.append(node)
+
+    walk(qv)
+    assert kernels, "no kernel was quantized"
+    assert all(k["q8"].dtype == jnp.int8 for k in kernels)
+    assert all(jnp.issubdtype(o.dtype, jnp.floating) for o in others
+               if hasattr(o, "dtype"))
+    # the shrink is the point: int8 + fp32 scales must be well under fp32
+    assert quantlib.param_bytes(qv) < 0.5 * quantlib.param_bytes(variables)
+
+    dq = quantlib.dequantize_variables(qv)
+    flat_ref = jax.tree_util.tree_leaves(variables)
+    flat_got = jax.tree_util.tree_leaves(dq)
+    assert len(flat_ref) == len(flat_got)
+    for a, b in zip(flat_ref, flat_got):
+        assert a.shape == b.shape
+
+
+def test_draft_config_and_variables(llama_parts):
+    model, variables = llama_parts
+    half = quantlib.draft_config(model.cfg, factor=2)
+    assert half.num_layers == max(1, model.cfg.num_layers // 2)
+    full = quantlib.draft_config(model.cfg, factor=1)
+    assert full.num_layers == model.cfg.num_layers
+    dv = quantlib.draft_variables_from(variables, half)
+    names = {n for n in dv["params"] if n.startswith(("layer_", "h_"))}
+    assert len(names) == half.num_layers
+    # pruned variables must actually run through a draft-sized model
+    draft = type(model)(half)
+    out = draft.apply(dv, jnp.zeros((1, 8), jnp.int32))
+    assert out.shape[-1] == model.cfg.vocab_size
+
+
+# ----------------------------------------------------------------------
+# quantized KV cache plumbing
+# ----------------------------------------------------------------------
+
+def test_quantized_cache_pools_and_copy_page(llama_parts):
+    model, _ = llama_parts
+    spec = spec_for_model(model.cfg, block_size=8, max_batch=2, max_seq=64,
+                          cache_dtype=jnp.int8)
+    assert isinstance(spec, KVCacheConfig) and spec.quantized
+    cache = init_cache(spec)
+    assert cache["k"][0].dtype == jnp.int8
+    assert cache["k_scale"][0].dtype == jnp.float32
+    assert cache["k_scale"][0].shape == (spec.num_pages, spec.block_size,
+                                         spec.num_kv_heads)
+    # seed page 1 with recognizable bytes + scales, copy to page 2
+    for key in ("k", "v"):
+        cache[key] = [a.at[1].set(7) for a in cache[key]]
+    for key in ("k_scale", "v_scale"):
+        cache[key] = [a.at[1].set(0.25) for a in cache[key]]
+    cache = copy_page(cache, 1, 2)
+    for key in ("k", "v"):
+        np.testing.assert_array_equal(np.asarray(cache[key][0][2]),
+                                      np.asarray(cache[key][0][1]))
+    for key in ("k_scale", "v_scale"):
+        np.testing.assert_array_equal(np.asarray(cache[key][0][2]),
+                                      np.asarray(cache[key][0][1]))
+
+
+def test_fp32_cache_has_no_scale_pools(llama_parts):
+    model, _ = llama_parts
+    spec = spec_for_model(model.cfg, block_size=8, max_batch=2, max_seq=64)
+    assert not spec.quantized
+    cache = init_cache(spec)
+    assert "k_scale" not in cache and "v_scale" not in cache
+
+
+# ----------------------------------------------------------------------
+# tiered logit gates
+# ----------------------------------------------------------------------
+
+def test_fp32_anchor_deterministic(llama_parts):
+    """Tier 0: two fp32 engines over the same stream agree exactly —
+    guards the capture harness before any quantization enters."""
+    model, variables = llama_parts
+    reqs = _requests(31)
+    a, log_a = _run_capture(_engine(model, variables), list(reqs))
+    b, log_b = _run_capture(
+        _engine(model, variables),
+        [Request(r.rid, list(r.prompt), r.max_new_tokens) for r in reqs])
+    for r in reqs:
+        assert a[r.rid].tokens == b[r.rid].tokens
+        for x, y in zip(log_a[r.rid], log_b[r.rid]):
+            np.testing.assert_array_equal(x, y)
+
+
+@pytest.mark.parametrize("family", ["llama", "gpt2"])
+@pytest.mark.parametrize("mode", ["int8", "int8-kv"])
+def test_quantized_logit_gate(family, mode, llama_parts, gpt2_parts):
+    """Tier 1/2: int8 weights (and optionally int8 KV) stay inside the
+    relative-error gate while the greedy trajectories coincide. The
+    comparison stops at the first token where the streams fork —
+    after a fork the two engines legitimately see different inputs."""
+    model, variables = llama_parts if family == "llama" else gpt2_parts
+    reqs = _requests(32, n=2, plen=12, max_new=5)
+    ref, ref_log = _run_capture(_engine(model, variables), list(reqs))
+    got, got_log = _run_capture(
+        _engine(model, variables, quant=mode),
+        [Request(r.rid, list(r.prompt), r.max_new_tokens) for r in reqs])
+    gated_rows = 0
+    for r in reqs:
+        a_t, b_t = ref[r.rid].tokens, got[r.rid].tokens
+        agree = 0
+        while (agree < min(len(a_t), len(b_t))
+               and a_t[agree] == b_t[agree]):
+            agree += 1
+        # while trajectories coincide the logits must be near: int8 is
+        # lossy but bounded
+        for i in range(min(agree + 1, len(ref_log[r.rid]),
+                           len(got_log[r.rid]))):
+            gate = quantlib.logit_gate(ref_log[r.rid][i],
+                                       got_log[r.rid][i])
+            assert gate["max_rel_err"] < 0.05, (r.rid, i, gate)
+            gated_rows += 1
+    assert gated_rows >= len(reqs)  # the gate actually ran
+
+
+# ----------------------------------------------------------------------
+# speculative decoding: greedy-exact + acceptance
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec_k,factor", [(2, 2), (3, 1)])
+def test_spec_decode_greedy_exact(llama_parts, spec_k, factor):
+    """Zero tolerance: the verify step only ever emits the target's own
+    argmax, so spec-on streams equal spec-off streams token for token —
+    at any draft depth and proposal length."""
+    model, variables = llama_parts
+    reqs = _requests(33, n=4, plen=9, max_new=8)
+    plain = _engine(model, variables, max_batch=4)
+    spec = _engine(model, variables, max_batch=4, spec_k=spec_k,
+                   spec_draft_factor=factor)
+    want = {c.rid: c for c in plain.run(list(reqs))}
+    got = {c.rid: c for c in spec.run(
+        [Request(r.rid, list(r.prompt), r.max_new_tokens) for r in reqs])}
+    for r in reqs:
+        assert got[r.rid].tokens == want[r.rid].tokens, r.rid
+    stats = spec.stats()
+    assert stats["spec_proposed"] > 0
+    assert 0.0 <= stats["spec_acceptance_rate"] <= 1.0
+
+
+def test_spec_acceptance_full_depth_draft(llama_parts):
+    """With a full-depth draft (the draft IS the target) every proposal
+    is the target's argmax, so acceptance is ~1.0 — well over the 0.5
+    bar — and tokens-per-step beats plain decode."""
+    model, variables = llama_parts
+    spec = _engine(model, variables, max_batch=4, spec_k=3,
+                   spec_draft_factor=1)
+    spec.run(_requests(34, n=4, plen=9, max_new=10))
+    stats = spec.stats()
+    assert stats["spec_acceptance_rate"] >= 0.5
+    assert stats["spec_tokens_per_step"] > 1.0
+
+
+def test_spec_with_prefix_cache_and_quant(llama_parts):
+    """The full stack at once: int8 weights + int8 KV + prefix cache +
+    speculative decoding still emits the engine's own greedy stream
+    (compared against the same quant level with spec off — spec is
+    exact *within* a quant level, not across levels)."""
+    model, variables = llama_parts
+    rng = np.random.default_rng(35)
+    shared = rng.integers(1, 200, size=12).tolist()
+    reqs = [Request("cold", list(shared), 6),
+            Request("rerun", list(shared), 6),
+            Request("fork", shared[:12] + [7, 9], 6)]
+    plain = _engine(model, variables, max_batch=4, quant="int8-kv",
+                    prefix_cache=True)
+    spec = _engine(model, variables, max_batch=4, quant="int8-kv",
+                   prefix_cache=True, spec_k=2, spec_draft_factor=1)
+    want = {c.rid: c for c in plain.run(
+        [Request(r.rid, list(r.prompt), r.max_new_tokens) for r in reqs])}
+    got = {c.rid: c for c in spec.run(reqs)}
+    for r in reqs:
+        assert got[r.rid].tokens == want[r.rid].tokens, r.rid
+    assert spec.stats()["prefix_hits"] >= 2
+
+
+# ----------------------------------------------------------------------
+# executable-count bound + donation under quantization
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("quant", ["off", "int8-kv"])
+def test_executable_bound_with_spec(llama_parts, quant):
+    model, variables = llama_parts
+    eng = _engine(model, variables, max_batch=4, quant=quant, spec_k=2)
+    eng.run(_requests(36, n=5, plen=9, max_new=6)
+            + _requests(37, n=2, plen=20, max_new=6))
+    report = eng.compile_report()
+    assert report["verify_executables"] >= 1
+    assert report["total_executables"] <= report["num_buckets"] + 2
+    # draft programs exist but are reported outside the counted bound
+    assert report["draft_decode_executables"] >= 1
+
+
+def test_quantized_cache_is_donated(llama_parts):
+    model, variables = llama_parts
+    eng = _engine(model, variables, quant="int8-kv")
+    aliased = eng.verify_cache_donated()
+    assert aliased >= 2 * model.cfg.num_layers
+
+
+def test_engine_from_env_quant_knobs(monkeypatch):
+    monkeypatch.setenv("M2KT_SERVE_QUANT", "int8")
+    monkeypatch.setenv("M2KT_SPEC_K", "3")
+    cfg = EngineConfig.from_env()
+    assert cfg.quant == "int8" and cfg.spec_k == 3
+    monkeypatch.setenv("M2KT_SERVE_QUANT", "nonsense")
+    monkeypatch.setenv("M2KT_SPEC_K", "-2")
+    cfg = EngineConfig.from_env()
+    assert cfg.quant == "off" and cfg.spec_k == 0

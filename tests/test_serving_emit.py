@@ -291,7 +291,9 @@ def test_serving_optimizer_injects_knobs():
            for e in ir.services["srv"].containers[0]["env"]}
     assert env == {"M2KT_SERVE_MAX_BATCH": "8",
                    "M2KT_SERVE_MAX_SEQ": "2048",
-                   "M2KT_KV_BLOCK_SIZE": "16"}
+                   "M2KT_KV_BLOCK_SIZE": "16",
+                   "M2KT_SERVE_QUANT": "off",
+                   "M2KT_SPEC_K": "0"}
 
 
 def test_serving_parameterizer_lifts_knobs():
@@ -300,14 +302,20 @@ def test_serving_parameterizer_lifts_knobs():
         {"name": "M2KT_SERVE_MAX_BATCH", "value": "16"},
         {"name": "M2KT_SERVE_MAX_SEQ", "value": "4096"},
         {"name": "M2KT_KV_BLOCK_SIZE", "value": "32"},
+        {"name": "M2KT_SERVE_QUANT", "value": "int8-kv"},
+        {"name": "M2KT_SPEC_K", "value": "4"},
     ]
     ir = tpu_serving_parameterizer(ir)
     assert ir.values.global_variables["tpuservemaxbatch"] == "16"
     assert ir.values.global_variables["tpuservemaxseq"] == "4096"
     assert ir.values.global_variables["tpukvblocksize"] == "32"
+    assert ir.values.global_variables["tpuservequant"] == "int8-kv"
+    assert ir.values.global_variables["tpuspeck"] == "4"
     env = {e["name"]: e["value"]
            for e in ir.services["srv"].containers[0]["env"]}
     assert env["M2KT_SERVE_MAX_BATCH"] == "{{ .Values.tpuservemaxbatch }}"
+    assert env["M2KT_SERVE_QUANT"] == "{{ .Values.tpuservequant }}"
+    assert env["M2KT_SPEC_K"] == "{{ .Values.tpuspeck }}"
 
 
 def test_non_serving_service_untouched():
