@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -146,6 +146,16 @@ fleet-smoke:
 quant-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_quant.py -q
 	$(CPU_ENV) $(PY) bench.py --model quant
+
+# serving kernels in isolation (all CPU-mode): interpret-mode kernel
+# equivalence tests prove the REAL Pallas kernel bodies (fused int8
+# paged-decode, packing/padding/COW/prefix-sharing, collective matmul,
+# autotune cache keying), then one kernels microbench trial with the
+# roofline assertion (FAILS if the fused path loses to its own
+# reference or is invisible to the cost model)
+kernel-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_kernels.py tests/test_autotune.py -q
+	$(CPU_ENV) M2KT_BENCH_KERNELS_TRIALS=1 $(PY) bench.py --model kernels
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -267,6 +267,15 @@ def _ask_serving_knobs(name: str) -> dict:
          "decode is bandwidth-bound, so bytes are tokens/s"],
         "off", ["off", "int8", "int8-kv"])
     knobs["quant"] = raw if raw in ("off", "int8", "int8-kv") else "off"
+    raw = qa.fetch_select(
+        f"m2kt.services.{name}.serve.kernels",
+        f"Select the fused serving-kernel mode for [{name}]",
+        ["auto enables the fused Pallas paged-decode kernel and "
+         "collective-overlapped decode matmul on TPU backends only; "
+         "on forces them (interpreter off-TPU); off keeps the jnp "
+         "reference path"],
+        "auto", ["auto", "on", "off"])
+    knobs["kernels"] = raw if raw in ("auto", "on", "off") else "auto"
     raw = qa.fetch_input(
         f"m2kt.services.{name}.serve.speck",
         f"Enter the speculative-decoding proposal length for [{name}]",
@@ -429,6 +438,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
                     "serve_max_seq": serve_knobs["max_seq"],
                     "serve_kv_block": serve_knobs["kv_block"],
                     "serve_quant": serve_knobs["quant"],
+                    "serve_kernels": serve_knobs["kernels"],
                     "spec_k": serve_knobs["spec_k"],
                     "compile_cache_dir": "/app/.jax-cache",
                     "metrics_port": metrics_port,

@@ -64,6 +64,12 @@ _BLOCK_CANDIDATES = ((128, 128), (128, 512), (256, 256), (256, 512),
 _block_cache: dict[str, tuple[int, int]] = {}
 _disk_cache_path_loaded: str | None = None
 
+# every kernel that stores winners in the shared disk cache; keys are
+# prefixed with the kernel name so one kernel's geometry can never be
+# served to another (pre-PR-11 cache files carried bare flash keys —
+# _load_disk_cache migrates those by prepending "flash:")
+_KERNEL_NAMES = ("flash", "paged_decode")
+
 
 def _autotune_enabled() -> bool:
     """M2KT_FLASH_AUTOTUNE=1/0 forces the sweep on/off; default is
@@ -93,6 +99,11 @@ def _load_disk_cache() -> None:
         with open(path, encoding="utf-8") as f:
             data = json.load(f)
         for k, v in data.items():
+            # tolerant migration: cache files written before the key
+            # carried a kernel name hold flash winners only — claim them
+            # for "flash" instead of discarding the sweep work
+            if k.split(":", 1)[0] not in _KERNEL_NAMES:
+                k = f"flash:{k}"
             _block_cache.setdefault(k, (int(v[0]), int(v[1])))
     except (OSError, ValueError, TypeError, IndexError):
         pass  # missing or corrupt cache: resweep
@@ -125,10 +136,16 @@ def _reset_block_cache() -> None:
     _disk_cache_path_loaded = None
 
 
-def _cache_key(q_shape, kv_seq: int, dtype: str, causal: bool) -> str:
+def _cache_key(q_shape, kv_seq: int, dtype: str, causal: bool,
+               kernel: str = "flash", geometry: str = "") -> str:
+    """Disk/in-process cache key: kernel name + backend + problem shape
+    (+ an optional kernel-specific geometry suffix, e.g. the paged-decode
+    page layout). Keying by kernel keeps paged-decode winners from ever
+    answering a flash lookup that happens to share a shape."""
     shape = "x".join(str(int(d)) for d in q_shape)
-    return (f"{jax.default_backend()}:{shape}:k{int(kv_seq)}:{dtype}:"
-            f"{'causal' if causal else 'full'}")
+    key = (f"{kernel}:{jax.default_backend()}:{shape}:k{int(kv_seq)}:"
+           f"{dtype}:{'causal' if causal else 'full'}")
+    return f"{key}:{geometry}" if geometry else key
 
 
 def _measure_blocks(q, k, v, causal: bool, scale: float,
@@ -624,24 +641,31 @@ def _paged_decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
     _, block_size, kvh, _ = k_pages.shape
     mb = block_tables.shape[1]
     # gather each sequence's pages into a contiguous context; int8 caches
-    # gather the quantized pages + their row scales and dequantize only
-    # the gathered context (never the whole pool)
+    # gather the quantized pages + their row scales and DEFER the scales
+    # past the contractions: a row scale is constant over head_dim, so
+    #   q . (k8 * s_k) == (q . k8) * s_k      (one mul per SCORE)
+    #   sum_s p * (v8 * s_v) == sum_s (p * s_v) * v8
+    # — the jnp mirror of what the fused kernel does in-register. No fp32
+    # [S, kvh, d] context is ever materialized, and GQA stays a batched
+    # dot over the kv-head axis instead of a repeat.
     if k_scale is not None:
-        k = (k_pages[block_tables].astype(jnp.float32)
-             * k_scale[block_tables][..., None])
-        v = (v_pages[block_tables].astype(jnp.float32)
-             * v_scale[block_tables][..., None])
-        k = k.reshape(b, mb * block_size, kvh, d)
-        v = v.reshape(b, mb * block_size, kvh, d)
+        seq = mb * block_size
         rep = h // kvh
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-        s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32), k) * scale
-        valid = (jnp.arange(mb * block_size)[None, None, :]
-                 < seq_lens[:, None, None])
+        k8 = k_pages[block_tables].reshape(b, seq, kvh, d)
+        v8 = v_pages[block_tables].reshape(b, seq, kvh, d)
+        ks = k_scale[block_tables].reshape(b, seq, kvh)
+        vs = v_scale[block_tables].reshape(b, seq, kvh)
+        qh = (q.astype(jnp.float32) * scale).reshape(b, kvh, rep, d)
+        s = jnp.einsum("bkrd,bskd->bkrs", qh, k8.astype(jnp.float32))
+        s = s * ks.transpose(0, 2, 1)[:, :, None, :]
+        valid = (jnp.arange(seq)[None, None, None, :]
+                 < seq_lens[:, None, None, None])
         s = jnp.where(valid, s, _NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhs,bshd->bhd", p, v).astype(q.dtype)
+        pv = jnp.einsum("bkrs,bskd->bkrd",
+                        p * vs.transpose(0, 2, 1)[:, :, None, :],
+                        v8.astype(jnp.float32))
+        return pv.reshape(b, h, d).astype(q.dtype)
     k = k_pages[block_tables].reshape(b, mb * block_size, kvh, d)
     v = v_pages[block_tables].reshape(b, mb * block_size, kvh, d)
     rep = h // kvh
@@ -757,6 +781,263 @@ def _paged_decode_tpu(q, k_pages, v_pages, block_tables, seq_lens,
     )(block_tables, seq_lens, q, k_pages, v_pages)
 
 
+def serve_kernels_mode() -> str:
+    """M2KT_SERVE_KERNELS: ``auto`` (default — compiled fused kernel on
+    TPU, jnp reference elsewhere), ``on``/``1`` (fused kernel everywhere;
+    off-TPU it runs through the Pallas interpreter, which is how CI
+    proves the real kernel bodies on CPU), ``off``/``0`` (jnp reference
+    only — the documented no-kernel fallback)."""
+    raw = os.environ.get("M2KT_SERVE_KERNELS", "auto").strip().lower()
+    if raw in ("on", "1", "true"):
+        return "on"
+    if raw in ("off", "0", "false"):
+        return "off"
+    return "auto"
+
+
+def _paged_decode_packed_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref,
+                                *refs, block_size: int, ppt: int, rep: int,
+                                scale: float, quantized: bool):
+    """Fused (optionally int8) paged-decode attention over PACKED page
+    tiles. Grid (sequence, tile, page-in-tile): the int8 minimum tile is
+    (32, 128) sublanes x lanes (pallas_guide.md "Tiling Constraints") and
+    a serving page is only 8-16 token rows, so single-page int8 blocks
+    would underfill the sublane dimension — instead each of the ``ppt``
+    pages the index map gathers for a tile is appended into a
+    [ppt*block_size, kvh, d] VMEM scratch, and the online-softmax update
+    runs once per packed tile on the last page's grid cell. Ragged tails
+    pad with the reserved null page and are masked by ``seq_len``; dead
+    tiles (wholly past the sequence) skip both the pack and the update.
+    Row scales ride along as [ppt*block_size, kvh] scratch and are
+    applied AFTER the contractions (one mul per score / per probability,
+    never per element of the context), so no fp32 context exists anywhere
+    — not in HBM, not even in VMEM."""
+    from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, kt_ref, vt_ref, kst_ref, vst_ref, \
+            acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, kt_ref, vt_ref, acc_ref, m_ref, l_ref = refs
+    i = pl.program_id(0)
+    t = pl.program_id(1)
+    p = pl.program_id(2)
+    seq_len = sl_ref[i]
+    tile = ppt * block_size
+    tile_start = t * tile
+
+    @pl.when((t == 0) & (p == 0))
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(tile_start < seq_len)
+    def _pack():
+        kt_ref[pl.ds(p * block_size, block_size)] = k_ref[:]
+        vt_ref[pl.ds(p * block_size, block_size)] = v_ref[:]
+        if quantized:
+            kst_ref[pl.ds(p * block_size, block_size)] = ks_ref[:]
+            vst_ref[pl.ds(p * block_size, block_size)] = vs_ref[:]
+
+    @pl.when((p == ppt - 1) & (tile_start < seq_len))
+    def _tile():
+        h, d = q_ref.shape
+        kvh = h // rep
+        qh = (q_ref[:].astype(jnp.float32) * scale).reshape(kvh, rep, d)
+        kT = kt_ref[:].astype(jnp.float32).transpose(1, 0, 2)
+        s = jax.lax.dot_general(
+            qh, kT, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # [kvh, rep, tile]
+        if quantized:
+            s = s * kst_ref[:].transpose(1, 0)[:, None, :]
+        pos = tile_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(pos < seq_len, s, _NEG_INF).reshape(h, tile)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        pr = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(pr, axis=-1, keepdims=True)
+        pr = pr.reshape(kvh, rep, tile)
+        if quantized:
+            pr = pr * vst_ref[:].transpose(1, 0)[:, None, :]
+        vh = vt_ref[:].astype(jnp.float32).transpose(1, 0, 2)
+        pv = jax.lax.dot_general(
+            pr, vh, dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # [kvh, rep, d]
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(h, d)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when((t == pl.num_programs(1) - 1) & (p == ppt - 1))
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[:] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_decode_packed(q, k_pages, v_pages, block_tables, seq_lens,
+                         scale: float, k_scale=None, v_scale=None,
+                         pages_per_tile: int | None = None,
+                         interpret: bool | None = None):
+    """pallas_call wrapper for the packed paged-decode kernel. Works on
+    fp32/bf16 pools (no scales) and int8 pools (+ per-row scale pools).
+    ``block_tables`` is padded to a pages_per_tile multiple with the null
+    page so every tile is full-width; the kernel masks the padding."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _INTERPRET or jax.default_backend() != "tpu"
+    b, h, d = q.shape
+    _, block_size, kvh, _ = k_pages.shape
+    mb = block_tables.shape[1]
+    quantized = k_scale is not None
+    if pages_per_tile is None:
+        pages_per_tile = get_paged_pages_per_tile(
+            q.shape, k_pages.shape, str(k_pages.dtype),
+            allow_sweep=not (interpret or isinstance(q, jax.core.Tracer)))
+    ppt = max(1, min(int(pages_per_tile), mb))
+    pad = (-mb) % ppt
+    if pad:
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
+    rep = h // kvh
+    tile = ppt * block_size
+
+    def page_map(i, t, p, bt, sl):
+        return (bt[i, t * ppt + p], 0, 0, 0)
+
+    def scale_map(i, t, p, bt, sl):
+        return (bt[i, t * ppt + p], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((None, h, d), lambda i, t, p, bt, sl: (i, 0, 0)),
+        pl.BlockSpec((None, block_size, kvh, d), page_map),
+        pl.BlockSpec((None, block_size, kvh, d), page_map),
+    ]
+    scratch = [
+        pltpu.VMEM((tile, kvh, d), k_pages.dtype),
+        pltpu.VMEM((tile, kvh, d), v_pages.dtype),
+    ]
+    operands = [block_tables, seq_lens, q, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((None, block_size, kvh), scale_map),
+                     pl.BlockSpec((None, block_size, kvh), scale_map)]
+        scratch += [pltpu.VMEM((tile, kvh), jnp.float32),
+                    pltpu.VMEM((tile, kvh), jnp.float32)]
+        operands += [k_scale, v_scale]
+    scratch += [
+        pltpu.VMEM((h, d), jnp.float32),
+        pltpu.VMEM((h, _LANES), jnp.float32),
+        pltpu.VMEM((h, _LANES), jnp.float32),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, (mb + pad) // ppt, ppt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, h, d),
+                               lambda i, t, p, bt, sl: (i, 0, 0)),
+        scratch_shapes=scratch,
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_packed_kernel,
+                          block_size=block_size, ppt=ppt, rep=rep,
+                          scale=scale, quantized=quantized),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+    )(*operands)
+
+
+def _default_pages_per_tile(block_size: int, dtype: str) -> int:
+    """Pack enough pages that the packed scratch tile meets the minimum
+    sublane count for its dtype — 32 rows for int8, 8 for fp32/bf16
+    (pallas_guide.md "Tiling Constraints")."""
+    rows = 32 if jnp.dtype(dtype).itemsize == 1 else 8
+    return max(1, -(-rows // int(block_size)))
+
+
+def _measure_paged(q, k_pages, v_pages, k_scale, v_scale, block_tables,
+                   seq_lens, scale: float, ppt: int) -> float:
+    """Wall seconds for a few timed packed-kernel calls at a candidate
+    pages-per-tile (compile + warmup excluded; stubbed by tests)."""
+    run = jax.jit(functools.partial(_paged_decode_packed, scale=scale,
+                                    k_scale=k_scale, v_scale=v_scale,
+                                    pages_per_tile=ppt))
+    args = (q, k_pages, v_pages, block_tables, seq_lens)
+    jax.block_until_ready(run(*args))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = run(*args)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def _sweep_paged(q_shape, pool_shape, dtype: str) -> int:
+    """Time the packed kernel over candidate pages-per-tile on synthetic
+    pools shaped like the caller's cache and return the winner."""
+    b, h, d = (int(x) for x in q_shape)
+    num_pages, block_size, kvh, _ = (int(x) for x in pool_shape)
+    mb = max(1, (num_pages - 1) // max(1, b))
+    quantized = jnp.dtype(dtype).itemsize == 1
+    keys = jax.random.split(jax.random.PRNGKey(0), 2)
+    q = jax.random.normal(keys[0], (b, h, d), jnp.float32)
+    if quantized:
+        kp = jax.random.randint(keys[1], (num_pages, block_size, kvh, d),
+                                -127, 128, jnp.int8)
+        sc = jnp.full((num_pages, block_size, kvh), 0.01, jnp.float32)
+        ks, vs = sc, sc
+    else:
+        kp = jax.random.normal(keys[1], (num_pages, block_size, kvh, d),
+                               jnp.dtype(dtype))
+        ks = vs = None
+    bt = jnp.arange(b * mb, dtype=jnp.int32).reshape(b, mb) % num_pages
+    sl = jnp.full((b,), mb * block_size // 2, jnp.int32)
+    scale = d ** -0.5
+    base = _default_pages_per_tile(block_size, dtype)
+    cands = sorted({min(mb, c) for c in (1, base, 2 * base, 4 * base, mb)})
+    best, best_t = base, float("inf")
+    for ppt in cands:
+        try:
+            t = _measure_paged(q, kp, kp, ks, vs, bt, sl, scale, ppt)
+        except Exception:  # noqa: BLE001 - candidate may exceed VMEM
+            continue
+        if t < best_t:
+            best, best_t = ppt, t
+    logging.getLogger(__name__).info(
+        "paged-decode autotune: %s -> pages_per_tile=%d",
+        _cache_key(q_shape, mb * block_size, dtype, False,
+                   kernel="paged_decode"), best)
+    return best
+
+
+def get_paged_pages_per_tile(q_shape, pool_shape, dtype: str,
+                             allow_sweep: bool = True) -> int:
+    """Tuned pages-per-tile for the packed paged-decode kernel — same
+    cache discipline as get_block_sizes (in-process dict, then the shared
+    disk file, then a sweep when autotuning is enabled), under its own
+    ``paged_decode:``-prefixed key so flash winners can never leak in.
+    The geometry suffix pins the page layout; the stored pair is
+    (pages_per_tile, tile_tokens)."""
+    num_pages, block_size, kvh, d = (int(x) for x in pool_shape)
+    key = _cache_key(tuple(q_shape), num_pages * block_size, dtype, False,
+                     kernel="paged_decode",
+                     geometry=f"bs{block_size}xkvh{kvh}")
+    if key in _block_cache:
+        return _block_cache[key][0]
+    _load_disk_cache()
+    if key in _block_cache:
+        return _block_cache[key][0]
+    if not (allow_sweep and _autotune_enabled()):
+        return _default_pages_per_tile(block_size, dtype)
+    winner = _sweep_paged(q_shape, pool_shape, dtype)
+    _block_cache[key] = (winner, winner * block_size)
+    _store_disk_cache(key, (winner, winner * block_size))
+    return winner
+
+
 def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
                            scale: float | None = None,
                            k_scale=None, v_scale=None):
@@ -772,28 +1053,40 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
       fp32 row scales for int8 page pools (serving/kvcache.py quantized
       caches); dequantization happens here, on the gathered context only
 
-    TPU with a lane-aligned head_dim takes the Pallas kernel; anything
-    else (CPU tests, odd shapes) the jnp gather fallback. Quantized
-    caches always take the jnp path: int8 operands need (32, 128) tiles
-    (pallas_guide.md) and the serving block sizes (8/16 tokens) under-
-    fill the sublane dimension — the gather + row-scale dequant is left
-    to XLA until a 32-token-page int8 kernel is worth carrying.
+    Dispatch is a fallback ladder — compiled kernel, interpreted kernel,
+    jnp reference — governed by M2KT_SERVE_KERNELS (serve_kernels_mode):
+
+    - ``auto``: TPU takes the packed fused kernel (int8 pools dequantize
+      in-register with deferred row scales; fp32 pools use the per-page
+      kernel when head_dim is lane-aligned, the packed one otherwise);
+      off-TPU takes the jnp reference, whose int8 branch folds scales
+      after the contractions — the kernel's algorithm, XLA-compiled.
+    - ``on``: packed fused kernel everywhere; off-TPU it runs through the
+      Pallas interpreter (slow — for tests/CI proving kernel bodies).
+    - ``off``: jnp reference only.
+
+    Any kernel failure logs a warning and drops to the jnp reference.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     d = q.shape[-1]
     block_size = k_pages.shape[1]
-    if k_scale is not None:
-        return _paged_decode_reference(q, k_pages, v_pages, block_tables,
-                                       seq_lens, scale, k_scale=k_scale,
-                                       v_scale=v_scale)
-    if (jax.default_backend() == "tpu" and d % 128 == 0
-            and block_size % 8 == 0):
+    mode = serve_kernels_mode()
+    on_tpu = jax.default_backend() == "tpu"
+    use_kernel = mode == "on" or (mode == "auto" and on_tpu
+                                  and (d % 128 == 0 or _INTERPRET))
+    if use_kernel:
         try:
-            return _paged_decode_tpu(q, k_pages, v_pages, block_tables,
-                                     seq_lens, scale)
+            if (k_scale is None and on_tpu and not _INTERPRET
+                    and d % 128 == 0 and block_size % 8 == 0):
+                return _paged_decode_tpu(q, k_pages, v_pages, block_tables,
+                                         seq_lens, scale)
+            return _paged_decode_packed(q, k_pages, v_pages, block_tables,
+                                        seq_lens, scale, k_scale=k_scale,
+                                        v_scale=v_scale)
         except Exception as e:  # noqa: BLE001 - fall back rather than fail
             logging.getLogger(__name__).warning(
                 "pallas paged decode failed (%s: %s); falling back to jnp "
                 "reference", type(e).__name__, e)
     return _paged_decode_reference(q, k_pages, v_pages, block_tables,
-                                   seq_lens, scale)
+                                   seq_lens, scale, k_scale=k_scale,
+                                   v_scale=v_scale)

@@ -31,6 +31,8 @@ CPU-correct: numerics tests run on 8 forced host devices.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -103,6 +105,86 @@ def ring_all_reduce(tree, axis_name: str):
     if pad:
         flat = flat[:size]
     return _unflatten_bucket(flat, meta)
+
+
+def has_model_axis(mesh) -> bool:
+    """True when the mesh splits parameters across a ``model`` axis —
+    the precondition for the collective-overlapped decode matmul."""
+    try:
+        shape = dict(mesh.shape)
+    except Exception:
+        return False
+    return shape.get("model", 1) > 1
+
+
+def _overlapped_matmul_shard(x, w, axis_name: str):
+    """Shard-local body of the collective decode matmul.
+
+    Row-parallel layout: ``x`` [batch, in/n] activation shard, ``w``
+    [in/n, out] weight shard; the full product needs the partial results
+    summed over the axis. Instead of matmul-then-psum (which serializes
+    ICI behind the whole product — exactly the latency a one-token
+    decode step cannot hide), the output columns are split into n
+    chunks and the ring reduce-scatter's travelling partial sum is
+    interleaved with the per-chunk matmuls: each hop's ppermute has no
+    data dependency on the next chunk's compute, so XLA's latency-hiding
+    scheduler runs them concurrently (same trick as ring_all_reduce, but
+    here the summand is *produced* between hops rather than read from a
+    buffer). After n-1 hops device r owns the finished column chunk
+    (r+1) mod n; n-1 more hops all-gather the full [batch, out] row.
+    """
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x @ w
+    out = w.shape[1]
+    pad = (-out) % n
+    if pad:
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+    cols = w.reshape(w.shape[0], n, -1)        # [in/n, n, out_chunk]
+    r = lax.axis_index(axis_name)
+    ring = [(i, (i + 1) % n) for i in range(n)]
+
+    def part(idx):
+        wc = lax.dynamic_index_in_dim(cols, jnp.mod(idx, n), axis=1,
+                                      keepdims=False)
+        return jnp.dot(x, wc, preferred_element_type=jnp.float32)
+
+    # reduce-scatter with the summand computed between hops
+    total = part(r)
+    for s in range(n - 1):
+        total = lax.ppermute(total, axis_name, ring)
+        total = total + part(r - 1 - s)
+
+    # all-gather the finished column chunks back around the ring
+    chunks = jnp.zeros((n,) + total.shape, total.dtype)
+    chunks = lax.dynamic_update_index_in_dim(chunks, total,
+                                             jnp.mod(r + 1, n), axis=0)
+    for s in range(n - 1):
+        total = lax.ppermute(total, axis_name, ring)
+        chunks = lax.dynamic_update_index_in_dim(chunks, total,
+                                                 jnp.mod(r - s, n), axis=0)
+    y = chunks.transpose(1, 0, 2).reshape(x.shape[0], -1)
+    if pad:
+        y = y[:, :out]
+    return y.astype(x.dtype)
+
+
+def collective_decode_matmul(mesh, x, w, *, axis_name: str = "model"):
+    """``x @ w`` with ``w``'s contraction dim sharded over ``axis_name``.
+
+    ``x``: [batch, in] (replicated), ``w``: [in, out]. Returns the full
+    replicated product; the cross-shard sum rides the overlapped ring in
+    :func:`_overlapped_matmul_shard`. This is the latency-optimized path
+    serving/engine.py selects for decode projections when the mesh has a
+    model axis (select_decode_matmul).
+    """
+    mapped = shard_map(
+        functools.partial(_overlapped_matmul_shard, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(axis_name, None)),
+        out_specs=P(),
+    )
+    return mapped(x, w)
 
 
 def is_pure_data_parallel(mesh) -> bool:

@@ -211,6 +211,16 @@ def tpu_serving_optimizer(ir: IR) -> IR:
             "off", ["off", "int8", "int8-kv"])
         knobs["M2KT_SERVE_QUANT"] = (
             raw if raw in ("off", "int8", "int8-kv") else "off")
+        raw = qa.fetch_select(
+            f"m2kt.services.{name}.serve.kernels",
+            f"Select the fused serving-kernel mode for [{name}]",
+            ["auto enables the fused Pallas paged-decode kernel and "
+             "collective-overlapped decode matmul on TPU backends only; "
+             "on forces them (interpreter off-TPU); off keeps the jnp "
+             "reference path"],
+            "auto", ["auto", "on", "off"])
+        knobs["M2KT_SERVE_KERNELS"] = (
+            raw if raw in ("auto", "on", "off") else "auto")
         raw = qa.fetch_input(
             f"m2kt.services.{name}.serve.speck",
             f"Enter the speculative-decoding proposal length for [{name}]",

@@ -44,6 +44,8 @@ Env knobs (docs/USAGE.md):
   (serving/quant.py; default off)
 - ``M2KT_SPEC_K``           speculative-decoding proposal length; 0
   disables (default 0)
+- ``M2KT_SERVE_KERNELS``    fused-kernel dispatch auto|on|off
+  (ops/attention.py serve_kernels_mode; default auto)
 
 Low-precision serving (``quant``): weights are quantized ONCE at engine
 construction (per-output-channel int8, serving/quant.py) and dequantized
@@ -98,6 +100,27 @@ from move2kube_tpu.serving.kvcache import (
 # span both so percentile interpolation has resolution at either end
 LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+def select_decode_matmul(mesh=None):
+    """Pick the decode-projection matmul for this deployment.
+
+    A mesh with a ``model`` axis shards the big projections, and a
+    one-token decode step has no batch slack to hide the cross-shard
+    reduction behind — so when kernels are enabled
+    (``M2KT_SERVE_KERNELS`` != off) the collective-overlapped ring
+    matmul (parallel/overlap.py) is selected: reduce-scatter hops
+    interleave with per-chunk shard matmuls instead of serializing a
+    psum after the full product. Everything else (no mesh, data-only
+    mesh, kernels off) gets the plain ``x @ w``.
+    """
+    from move2kube_tpu.ops.attention import serve_kernels_mode
+    from move2kube_tpu.parallel import overlap
+
+    if (mesh is not None and overlap.has_model_axis(mesh)
+            and serve_kernels_mode() != "off"):
+        return functools.partial(overlap.collective_decode_matmul, mesh)
+    return lambda x, w: x @ w
 
 
 def _default_buckets(max_seq: int) -> tuple[int, ...]:
@@ -205,9 +228,14 @@ class ServingEngine:
 
     def __init__(self, model, variables, config: EngineConfig | None = None,
                  registry: Registry | None = None,
-                 tracer: "tracing.SpanRecorder | None" = None):
+                 tracer: "tracing.SpanRecorder | None" = None,
+                 mesh=None):
         self.model = model
         self.config = config or EngineConfig.from_env()
+        # model-parallel serving meshes swap the decode projections onto
+        # the collective-overlapped ring matmul (select_decode_matmul)
+        self.mesh = mesh
+        self.decode_matmul = select_decode_matmul(mesh)
         self.quant = quantlib.policy(self.config.quant)
         if self.quant.quantize_weights:
             # once, at construction: the jitted steps dequantize INSIDE

@@ -112,7 +112,29 @@ def init_cache(cfg: KVCacheConfig) -> dict:
                             for _ in range(cfg.num_layers)]
         cache["v_scale"] = [jnp.zeros(sshape, cfg.scale_dtype)
                             for _ in range(cfg.num_layers)]
+    _check_page_schema(cache, "init_cache")
     return cache
+
+
+def _check_page_schema(cache: dict, where: str) -> None:
+    """Fail loudly when the cache's page pools and ``PAGE_KEYS`` drift.
+
+    scatter_prefill hardcodes the k/v/k_scale/v_scale pools and
+    copy_page iterates ``PAGE_KEYS`` — a pool added to one but not the
+    others would be silently dropped from prefill writes or COW copies
+    (a shared page whose new pool isn't copied dequantizes or attends
+    with stale rows). Checked once at init_cache and again by the page
+    ops, so the break surfaces as this error instead of bad logits.
+    """
+    pools = tuple(k for k in cache if isinstance(cache[k], list))
+    unknown = [k for k in pools if k not in PAGE_KEYS]
+    expected = PAGE_KEYS if "k_scale" in cache else PAGE_KEYS[:2]
+    if unknown or tuple(k for k in PAGE_KEYS if k in cache) != expected:
+        raise ValueError(
+            f"{where}: page-pool schema mismatch — cache carries pools "
+            f"{pools}, PAGE_KEYS declares {PAGE_KEYS} (expected "
+            f"{expected}). Teach init_cache, scatter_prefill and "
+            "copy_page about the new pool before serving with it.")
 
 
 def scatter_prefill(cache: dict, kvs, slot, bt_row, prompt_len,
@@ -127,6 +149,7 @@ def scatter_prefill(cache: dict, kvs, slot, bt_row, prompt_len,
     are redirected to the null page. Also installs the row and the
     sequence length into the cache's table.
     """
+    _check_page_schema(cache, "scatter_prefill")
     bucket = kvs[0][0].shape[1]
     pos = jnp.arange(bucket)
     blk = jnp.where(pos < prompt_len, bt_row[pos // block_size], NULL_PAGE)
@@ -171,6 +194,7 @@ def copy_page(cache: dict, src, dst) -> dict:
     quantized pages, so a shared page and its scales stay byte-immutable
     together — a COW copy that dropped the scales would dequantize the
     copied rows with zeros."""
+    _check_page_schema(cache, "copy_page")
     out = dict(cache)
     for key in PAGE_KEYS:
         if key in cache:

@@ -115,6 +115,70 @@ def test_corrupt_disk_cache_is_ignored(monkeypatch, tmp_path):
     json.loads((tmp_path / "blocks.json").read_text())
 
 
+def test_cache_keys_are_kernel_prefixed():
+    """PR 11 keys the shared disk cache by kernel name + geometry so
+    paged-decode winners can never be served to flash (both store
+    2-int pairs under the same file)."""
+    flash = attention._cache_key(SHAPE, KV_SEQ, "float32", True)
+    paged = attention._cache_key((4, 4, 32), 256, "int8", False,
+                                 kernel="paged_decode",
+                                 geometry="bs8xkvh2")
+    assert flash.startswith("flash:")
+    assert paged.startswith("paged_decode:")
+    assert paged.endswith(":bs8xkvh2")
+    assert flash != paged
+
+
+def test_legacy_disk_keys_migrated(monkeypatch, tmp_path):
+    """Pre-PR-11 cache files carry bare flash keys; loading migrates
+    them under the flash: prefix instead of dropping them."""
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "1")
+    legacy_key = attention._cache_key(
+        SHAPE, KV_SEQ, "float32", True).split(":", 1)[1]
+    (tmp_path / "blocks.json").write_text(
+        json.dumps({legacy_key: [128, 256]}))
+
+    def boom(*a, **k):
+        raise AssertionError("migrated winner must suppress the sweep")
+
+    monkeypatch.setattr(attention, "_measure_blocks", boom)
+    assert attention.get_block_sizes(SHAPE, KV_SEQ, "float32", True) == (
+        128, 256)
+
+
+def test_paged_autotune_sweeps_once_and_persists(monkeypatch, tmp_path):
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "1")
+    calls = []
+
+    def fake_sweep(q_shape, pool_shape, dtype):
+        calls.append(pool_shape)
+        return 4
+
+    monkeypatch.setattr(attention, "_sweep_paged", fake_sweep)
+    pool = (129, 8, 2, 32)
+    assert attention.get_paged_pages_per_tile((4, 4, 32), pool,
+                                              "int8") == 4
+    assert attention.get_paged_pages_per_tile((4, 4, 32), pool,
+                                              "int8") == 4
+    assert len(calls) == 1
+    # fresh process: the disk entry answers under its own kernel prefix
+    attention._reset_block_cache()
+    assert attention.get_paged_pages_per_tile((4, 4, 32), pool,
+                                              "int8") == 4
+    assert len(calls) == 1
+    data = json.loads((tmp_path / "blocks.json").read_text())
+    assert all(k.startswith("paged_decode:") for k in data)
+
+
+def test_paged_default_ppt_fills_min_sublanes(monkeypatch):
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "0")
+    assert attention._default_pages_per_tile(8, "int8") == 4    # 32 rows
+    assert attention._default_pages_per_tile(16, "int8") == 2
+    assert attention._default_pages_per_tile(8, "float32") == 1  # 8 rows
+    assert attention.get_paged_pages_per_tile(
+        (4, 4, 32), (65, 8, 2, 32), "int8") == 4
+
+
 def test_interpret_mode_flash_matches_reference_with_autotune_defaults():
     """End-to-end sanity: the autotune-resolved default blocks keep the
     interpreter-mode kernel numerically identical to the reference."""

@@ -1,0 +1,331 @@
+"""Serving-kernel tests: the fused paged-decode Pallas kernel and the
+collective-overlapped decode matmul.
+
+All kernel equivalence tests run the REAL kernel body through the
+Pallas interpreter on CPU (ops/attention.py `_paged_decode_packed`
+interprets automatically off-TPU) — not a shadow implementation. The
+numeric bar is tiered like tests/test_quant.py: exact-path comparisons
+(fused vs the folded jnp reference on the same int8 pools) get a tight
+absolute gate, since both consume identical quantized rows and differ
+only in summation order; engine-level kernels-on vs kernels-off runs
+get the quant suite's relative logit gate (< 0.05) over the agreed
+greedy prefix.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from move2kube_tpu.models.llama import Llama, llama_tiny
+from move2kube_tpu.ops import attention
+from move2kube_tpu.parallel import overlap
+from move2kube_tpu.serving import quant as quantlib
+from move2kube_tpu.serving.engine import (
+    EngineConfig,
+    Request,
+    ServingEngine,
+    select_decode_matmul,
+)
+from move2kube_tpu.serving.kvcache import (
+    KVCacheConfig,
+    copy_page,
+    init_cache,
+    install_block_table,
+    scatter_prefill,
+)
+
+ATOL = 2e-5  # same-inputs paths, fp32 accumulation, different sum order
+
+
+def _int8_pools(rng, num_pages, bs, kvh, d):
+    kp = jnp.asarray(rng.integers(-127, 128, size=(num_pages, bs, kvh, d)),
+                     jnp.int8)
+    vp = jnp.asarray(rng.integers(-127, 128, size=(num_pages, bs, kvh, d)),
+                     jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.001, 0.02, size=(num_pages, bs, kvh)),
+                     jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.001, 0.02, size=(num_pages, bs, kvh)),
+                     jnp.float32)
+    return kp, vp, ks, vs
+
+
+def _tables(lens, mb, bs):
+    """Disjoint page runs per sequence, pages 1.. (0 reserved null)."""
+    bt = np.zeros((len(lens), mb), np.int32)
+    used = 1
+    for i, length in enumerate(lens):
+        pages = -(-length // bs)
+        bt[i, :pages] = np.arange(used, used + pages)
+        used += pages
+    return jnp.asarray(bt), jnp.asarray(lens, jnp.int32)
+
+
+# ----------------------------------------------------------------------
+# fused packed kernel vs the jnp reference (interpret mode on CPU)
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("ppt", [1, 2, 4, 8])
+def test_packed_kernel_int8_matches_reference(ppt):
+    rng = np.random.default_rng(0)
+    b, h, kvh, d, bs, mb = 3, 4, 2, 32, 8, 8
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    kp, vp, ks, vs = _int8_pools(rng, 30, bs, kvh, d)
+    # ragged: partial page tail, full row, tiny row
+    bt, sl = _tables([5, 37, 64], mb, bs)
+    out = attention._paged_decode_packed(q, kp, vp, bt, sl, d ** -0.5,
+                                         k_scale=ks, v_scale=vs,
+                                         pages_per_tile=ppt)
+    ref = attention._paged_decode_reference(q, kp, vp, bt, sl, d ** -0.5,
+                                            k_scale=ks, v_scale=vs)
+    assert float(jnp.max(jnp.abs(out - ref))) < ATOL
+
+
+def test_packed_kernel_fp32_matches_reference():
+    rng = np.random.default_rng(1)
+    b, h, kvh, d, bs, mb = 2, 4, 2, 32, 8, 6
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(20, bs, kvh, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(20, bs, kvh, d)), jnp.float32)
+    bt, sl = _tables([11, 48], mb, bs)
+    out = attention._paged_decode_packed(q, kp, vp, bt, sl, d ** -0.5,
+                                         pages_per_tile=2)
+    ref = attention._paged_decode_reference(q, kp, vp, bt, sl, d ** -0.5)
+    assert float(jnp.max(jnp.abs(out - ref))) < ATOL
+
+
+def test_null_page_padding_at_ragged_tails():
+    """mb not a multiple of pages-per-tile: the wrapper pads the block
+    table with the reserved null page; padded positions and positions
+    past seq_len must not leak into the softmax. Poisoning the null
+    page with huge values makes any leak blow past the gate."""
+    rng = np.random.default_rng(2)
+    b, h, kvh, d, bs, mb = 2, 4, 2, 32, 8, 5   # 5 pages, ppt=4 -> pad 3
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    kp, vp, ks, vs = _int8_pools(rng, 12, bs, kvh, d)
+    ks = ks.at[0].set(50.0)
+    vs = vs.at[0].set(50.0)
+    bt, sl = _tables([3, 33], mb, bs)           # partial first/last pages
+    out = attention._paged_decode_packed(q, kp, vp, bt, sl, d ** -0.5,
+                                         k_scale=ks, v_scale=vs,
+                                         pages_per_tile=4)
+    ref = attention._paged_decode_reference(q, kp, vp, bt, sl, d ** -0.5,
+                                            k_scale=ks, v_scale=vs)
+    assert float(jnp.max(jnp.abs(out - ref))) < ATOL
+
+
+def test_prefix_shared_pages():
+    """Two sequences whose block tables point at the SAME prefix pages
+    (refcounted prefix-cache sharing): the kernel gathers pages per
+    (sequence, position), so shared pages must read identically from
+    both rows."""
+    rng = np.random.default_rng(3)
+    b, h, kvh, d, bs, mb = 2, 4, 2, 32, 8, 6
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    kp, vp, ks, vs = _int8_pools(rng, 16, bs, kvh, d)
+    bt = jnp.asarray([[1, 2, 3, 4, 0, 0],      # prefix pages 1-3 shared
+                      [1, 2, 3, 5, 6, 0]], jnp.int32)
+    sl = jnp.asarray([28, 44], jnp.int32)
+    out = attention._paged_decode_packed(q, kp, vp, bt, sl, d ** -0.5,
+                                         k_scale=ks, v_scale=vs,
+                                         pages_per_tile=4)
+    ref = attention._paged_decode_reference(q, kp, vp, bt, sl, d ** -0.5,
+                                            k_scale=ks, v_scale=vs)
+    assert float(jnp.max(jnp.abs(out - ref))) < ATOL
+
+
+def test_cow_copied_pages():
+    """COW page copy (kvcache.copy_page) duplicates quantized rows AND
+    their scales; the fused kernel must read the copy identically to
+    the original while a divergent write to the copy stays private."""
+    cfg = KVCacheConfig(num_layers=1, num_pages=8, block_size=8,
+                        num_kv_heads=2, head_dim=32, max_batch=2,
+                        max_pages_per_seq=4, dtype=jnp.int8)
+    cache = init_cache(cfg)
+    rng = np.random.default_rng(4)
+    rows = jnp.asarray(rng.normal(size=(16, 2, 32)), jnp.float32)
+    q8, sc = attention.quantize_kv_rows(rows)
+    for pool, arr in (("k", q8), ("v", q8)):
+        cache[pool][0] = cache[pool][0].at[1:3].set(arr.reshape(2, 8, 2, 32))
+    for pool in ("k_scale", "v_scale"):
+        cache[pool][0] = cache[pool][0].at[1:3].set(sc.reshape(2, 8, 2))
+    cache = copy_page(cache, 2, 3)              # COW: page 2 -> page 3
+    # same query in both slots: identical context must give identical out
+    q = jnp.broadcast_to(
+        jnp.asarray(rng.normal(size=(1, 4, 32)), jnp.float32), (2, 4, 32))
+    bt = jnp.asarray([[1, 2, 0, 0], [1, 3, 0, 0]], jnp.int32)
+    sl = jnp.asarray([16, 16], jnp.int32)
+    args = (q, cache["k"][0], cache["v"][0], bt, sl, 32 ** -0.5)
+    kw = dict(k_scale=cache["k_scale"][0], v_scale=cache["v_scale"][0])
+    out = attention._paged_decode_packed(*args, pages_per_tile=2, **kw)
+    ref = attention._paged_decode_reference(*args, **kw)
+    assert float(jnp.max(jnp.abs(out - ref))) < ATOL
+    # rows 0 and 1 saw identical context (page 3 is a byte copy of 2)
+    assert float(jnp.max(jnp.abs(out[0] - out[1]))) < ATOL
+    # a write to the copy diverges the copy holder only
+    cache["k"][0] = cache["k"][0].at[3].set(jnp.int8(7))
+    out2 = attention._paged_decode_packed(
+        q, cache["k"][0], cache["v"][0], bt, sl, 32 ** -0.5,
+        pages_per_tile=2, **kw)
+    assert float(jnp.max(jnp.abs(out2[0] - out[0]))) < ATOL
+    assert float(jnp.max(jnp.abs(out2[1] - out[1]))) > 1e-3
+
+
+# ----------------------------------------------------------------------
+# dispatch ladder + env knob
+# ----------------------------------------------------------------------
+
+def test_serve_kernels_mode_parsing(monkeypatch):
+    for raw, want in [("", "auto"), ("auto", "auto"), ("on", "on"),
+                      ("1", "on"), ("true", "on"), ("off", "off"),
+                      ("0", "off"), ("garbage", "auto")]:
+        monkeypatch.setenv("M2KT_SERVE_KERNELS", raw)
+        assert attention.serve_kernels_mode() == want
+    monkeypatch.delenv("M2KT_SERVE_KERNELS")
+    assert attention.serve_kernels_mode() == "auto"
+
+
+def test_dispatch_on_runs_kernel_off_runs_reference(monkeypatch):
+    rng = np.random.default_rng(5)
+    b, h, kvh, d, bs, mb = 2, 4, 2, 32, 8, 4
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    kp, vp, ks, vs = _int8_pools(rng, 10, bs, kvh, d)
+    bt, sl = _tables([9, 26], mb, bs)
+    monkeypatch.setenv("M2KT_SERVE_KERNELS", "off")
+    off = attention.paged_decode_attention(q, kp, vp, bt, sl,
+                                           k_scale=ks, v_scale=vs)
+    monkeypatch.setenv("M2KT_SERVE_KERNELS", "on")
+    called = {}
+    real = attention._paged_decode_packed
+
+    def spy(*args, **kwargs):
+        called["yes"] = True
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(attention, "_paged_decode_packed", spy)
+    on = attention.paged_decode_attention(q, kp, vp, bt, sl,
+                                          k_scale=ks, v_scale=vs)
+    assert called.get("yes"), "mode=on did not reach the packed kernel"
+    assert float(jnp.max(jnp.abs(on - off))) < ATOL
+
+
+# ----------------------------------------------------------------------
+# engine integration: kernels-on decode + donation
+# ----------------------------------------------------------------------
+
+def _llama_parts():
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+@pytest.mark.slow
+def test_engine_kernel_path_logits_and_donation(monkeypatch):
+    """With M2KT_SERVE_KERNELS=on the engine's decode step runs the
+    interpreted kernel body end-to-end: the greedy logits must agree
+    with the kernels-off run inside the quant suite's relative gate
+    over the agreed prefix, and the decode step must still donate every
+    KV page pool (the kernel reads pools positionally, which must not
+    break input-output aliasing)."""
+    model, variables = _llama_parts()
+    cfg = EngineConfig(max_batch=2, max_seq=32, block_size=8,
+                       buckets=(16,), max_new_tokens=3, quant="int8-kv")
+    reqs = [Request("r0", list(range(1, 9)), 3)]
+
+    monkeypatch.setenv("M2KT_SERVE_KERNELS", "off")
+    ref_eng = ServingEngine(model, variables, cfg)
+    ref_eng.capture_logits = True
+    ref_c = {c.rid: c for c in ref_eng.run(
+        [Request(r.rid, list(r.prompt), r.max_new_tokens)
+         for r in reqs])}
+
+    monkeypatch.setenv("M2KT_SERVE_KERNELS", "on")
+    eng = ServingEngine(model, variables, cfg)
+    eng.capture_logits = True
+    got_c = {c.rid: c for c in eng.run(reqs)}
+
+    for r in reqs:
+        a_t, b_t = ref_c[r.rid].tokens, got_c[r.rid].tokens
+        agree = 0
+        while agree < min(len(a_t), len(b_t)) and a_t[agree] == b_t[agree]:
+            agree += 1
+        for i in range(min(agree + 1, len(ref_eng.logit_log[r.rid]),
+                           len(eng.logit_log[r.rid]))):
+            gate = quantlib.logit_gate(ref_eng.logit_log[r.rid][i],
+                                       eng.logit_log[r.rid][i])
+            assert gate["max_rel_err"] < 0.05, gate
+    aliases = eng.verify_cache_donated()
+    assert aliases >= 2 * eng.cache_cfg.num_layers
+
+
+# ----------------------------------------------------------------------
+# collective-overlapped decode matmul
+# ----------------------------------------------------------------------
+
+def test_collective_matmul_matches_plain():
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 100)), jnp.float32)  # pad path
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("model",))
+    y = overlap.collective_decode_matmul(mesh, x, w)
+    assert y.shape == (4, 100)
+    assert float(jnp.max(jnp.abs(y - x @ w))) < 1e-4
+
+
+def test_collective_matmul_2d_mesh_under_jit():
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(2, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 128)), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()).reshape(2, -1), ("data", "model"))
+    y = jax.jit(lambda x, w: overlap.collective_decode_matmul(mesh, x, w))(
+        x, w)
+    assert float(jnp.max(jnp.abs(y - x @ w))) < 1e-4
+
+
+def test_select_decode_matmul(monkeypatch):
+    monkeypatch.delenv("M2KT_SERVE_KERNELS", raising=False)
+    devices = np.array(jax.devices())
+    model_mesh = Mesh(devices.reshape(-1), ("model",))
+    data_mesh = Mesh(devices.reshape(-1), ("data",))
+    x = jnp.ones((2, 8), jnp.float32)
+    w = jnp.ones((8, 4), jnp.float32)
+    # model axis -> collective path, still numerically x @ w
+    fn = select_decode_matmul(model_mesh)
+    assert float(jnp.max(jnp.abs(fn(x, w) - x @ w))) < 1e-5
+    assert overlap.has_model_axis(model_mesh)
+    # no mesh / data-only mesh / kernels off -> plain matmul
+    assert not overlap.has_model_axis(data_mesh)
+    for mesh in (None, data_mesh):
+        assert select_decode_matmul(mesh)(x, w).shape == (2, 4)
+    monkeypatch.setenv("M2KT_SERVE_KERNELS", "off")
+    assert select_decode_matmul(model_mesh)(x, w).shape == (2, 4)
+
+
+# ----------------------------------------------------------------------
+# kvcache page-pool schema guard
+# ----------------------------------------------------------------------
+
+def _tiny_cache(dtype=jnp.float32):
+    return init_cache(KVCacheConfig(
+        num_layers=1, num_pages=4, block_size=4, num_kv_heads=1,
+        head_dim=8, max_batch=1, max_pages_per_seq=2, dtype=dtype))
+
+
+def test_page_schema_guard():
+    cache = _tiny_cache(jnp.int8)               # init_cache asserts clean
+    cache["adapter"] = [jnp.zeros((4, 4, 1, 8))]  # future pool, untaught
+    with pytest.raises(ValueError, match="page-pool schema"):
+        copy_page(cache, 1, 2)
+    kvs = [(jnp.zeros((1, 4, 1, 8)), jnp.zeros((1, 4, 1, 8)))]
+    with pytest.raises(ValueError, match="page-pool schema"):
+        scatter_prefill(cache, kvs, 0, jnp.zeros((2,), jnp.int32), 2, 4)
+    # install_block_table touches no pools and stays permissive
+    clean = _tiny_cache()
+    out = install_block_table(clean, 0, jnp.zeros((2,), jnp.int32), 2)
+    assert int(out["seq_lens"][0]) == 2

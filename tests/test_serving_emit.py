@@ -293,6 +293,7 @@ def test_serving_optimizer_injects_knobs():
                    "M2KT_SERVE_MAX_SEQ": "2048",
                    "M2KT_KV_BLOCK_SIZE": "16",
                    "M2KT_SERVE_QUANT": "off",
+                   "M2KT_SERVE_KERNELS": "auto",
                    "M2KT_SPEC_K": "0"}
 
 
@@ -303,6 +304,7 @@ def test_serving_parameterizer_lifts_knobs():
         {"name": "M2KT_SERVE_MAX_SEQ", "value": "4096"},
         {"name": "M2KT_KV_BLOCK_SIZE", "value": "32"},
         {"name": "M2KT_SERVE_QUANT", "value": "int8-kv"},
+        {"name": "M2KT_SERVE_KERNELS", "value": "on"},
         {"name": "M2KT_SPEC_K", "value": "4"},
     ]
     ir = tpu_serving_parameterizer(ir)
@@ -310,6 +312,7 @@ def test_serving_parameterizer_lifts_knobs():
     assert ir.values.global_variables["tpuservemaxseq"] == "4096"
     assert ir.values.global_variables["tpukvblocksize"] == "32"
     assert ir.values.global_variables["tpuservequant"] == "int8-kv"
+    assert ir.values.global_variables["tpuservekernels"] == "on"
     assert ir.values.global_variables["tpuspeck"] == "4"
     env = {e["name"]: e["value"]
            for e in ir.services["srv"].containers[0]["env"]}
