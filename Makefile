@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke slo-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -156,6 +156,16 @@ quant-smoke:
 kernel-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_kernels.py tests/test_autotune.py -q
 	$(CPU_ENV) M2KT_BENCH_KERNELS_TRIALS=1 $(PY) bench.py --model kernels
+
+# fleet tracing + per-tenant SLO plane in isolation (all CPU-mode):
+# traceparent round-trip, cross-role stitching with exact latency
+# decomposition, tenant-cardinality caps, burn-rate goldens, SLO rule
+# emission/Helm round-trip; then the bench fleet phase (tenant-tagged
+# zipfian replay; FAILS unless the stitched disagg trace decomposes
+# exactly and the synthetic best-effort flood fires the fast-burn alert)
+slo-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_fleetview.py -q
+	$(CPU_ENV) $(PY) bench.py --model fleet
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -995,13 +995,25 @@ def bench_fleet(n: int) -> dict:
     number), plus tok/s and hit rate for both configurations. The phase
     FAILS when the replay produces zero cache hits or the cached p95 TTFT
     is not better — a prefix cache that doesn't pay for itself under a
-    skewed tenant mix is a regression, not a data point. Own subprocess
-    for the same reason as the serving phase: the probe must own jax's
-    platform env before import."""
+    skewed tenant mix is a regression, not a data point.
+
+    The replay is tenant-tagged end to end (X-M2KT-Tenant semantics via
+    the router's tenant kwarg), so the probe also reports per-tenant p95
+    TTFT, drives a synthetic best-effort flood through the burn-rate
+    drill (M2KT_SLO_WINDOW_SCALE shrinks the SRE windows to seconds; the
+    fast-burn alert MUST fire), and asserts that a disagg request traced
+    router -> prefill -> decode stitches into ONE trace whose e2e
+    decomposes exactly (residual < 1ns). Own subprocess for the same
+    reason as the serving phase: the probe must own jax's platform env
+    before import."""
     import subprocess
 
     env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
                PALLAS_AXON_POOL_IPS="")
+    # drill-scale the SLO windows (fast pair 36s/3s) so the flood
+    # registers inside the probe's lifetime; an explicit operator value
+    # wins
+    env.setdefault("M2KT_SLO_WINDOW_SCALE", "0.01")
     flags = [f for f in env.get("XLA_FLAGS", "").split()
              if not f.startswith("--xla_force_host_platform_device_count")]
     flags.append("--xla_force_host_platform_device_count=8")
@@ -1021,8 +1033,10 @@ def bench_fleet(n: int) -> dict:
           f"(x{probe['p95_ttft_speedup']:.2f}, hit rate "
           f"{probe['prefix_hit_rate']:.2f}), "
           f"{probe['throughput_tok_s_cached']:.1f} vs "
-          f"{probe['throughput_tok_s_uncached']:.1f} tok/s in {dt:.1f}s",
-          file=sys.stderr)
+          f"{probe['throughput_tok_s_uncached']:.1f} tok/s in {dt:.1f}s; "
+          f"burn drill fired={probe['burn_drill_fired']}, trace residual "
+          f"{probe['trace_residual_s']:.1e}s over "
+          f"{probe['trace_parts']} parts", file=sys.stderr)
     metric, unit = PHASE_METRICS["fleet"]
     return {"phase": "fleet", "metric": metric,
             "value": probe["p95_ttft_speedup"], "unit": unit,
@@ -1038,6 +1052,13 @@ def bench_fleet(n: int) -> dict:
             "throughput_tok_s_cached": probe["throughput_tok_s_cached"],
             "throughput_tok_s_uncached": probe["throughput_tok_s_uncached"],
             "affinity_hit_fraction": probe["affinity_hit_fraction"],
+            "per_tenant_p95_ttft_ms": probe["per_tenant_p95_ttft_ms"],
+            "burn_drill_fired": probe["burn_drill_fired"],
+            "burn_rate_fast_short": probe["burn_rate_fast_short"],
+            "slo_window_scale": probe["slo_window_scale"],
+            "trace_residual_s": probe["trace_residual_s"],
+            "trace_parts": probe["trace_parts"],
+            "trace_e2e_ms": probe["trace_e2e_ms"],
             "wall_s": round(dt, 2)}
 
 
@@ -1045,7 +1066,9 @@ def run_fleet_probe() -> int:
     """In-process half of the fleet phase (spawned by bench_fleet with jax
     forced onto host devices). Builds two router+replica fleets — prefix
     cache on and off — replays the same zipfian multi-tenant stream
-    through each, and prints one JSON line."""
+    through each (tenant-tagged, so the engines' per-tenant SLO ledgers
+    fill), runs the burn-rate drill and the disagg trace-stitching
+    check, and prints one JSON line."""
     import dataclasses
 
     import jax
@@ -1053,8 +1076,13 @@ def run_fleet_probe() -> int:
     import numpy as np
 
     from move2kube_tpu.models.llama import Llama, llama_tiny
-    from move2kube_tpu.serving.engine import EngineConfig
-    from move2kube_tpu.serving.fleet.router import build_fleet
+    from move2kube_tpu.obs import tracing
+    from move2kube_tpu.obs.fleetview import SYNTH_HOP, FleetTraceCollector
+    from move2kube_tpu.serving.engine import EngineConfig, ServingEngine
+    from move2kube_tpu.serving.fleet.disagg import PrefillReplica
+    from move2kube_tpu.serving.fleet.router import (InProcessReplica,
+                                                    Router, RouterConfig,
+                                                    build_fleet)
 
     replicas = int(os.environ.get("M2KT_BENCH_FLEET_REPLICAS", "4"))
     n_tenants = int(os.environ.get("M2KT_BENCH_FLEET_TENANTS", "8"))
@@ -1101,10 +1129,15 @@ def run_fleet_probe() -> int:
             for p in prompts:
                 router.generate(list(p), max_new_tokens=8)
             ttft_ms = []
-            for p in prompts:  # max_new_tokens=1: client latency IS TTFT
+            by_tenant: dict[str, list[float]] = {}
+            for p, tid in zip(prompts, tenant_ids):
+                # max_new_tokens=1: client latency IS TTFT
                 t = time.perf_counter()
-                router.generate(list(p), max_new_tokens=1)
-                ttft_ms.append((time.perf_counter() - t) * 1e3)
+                router.generate(list(p), max_new_tokens=1,
+                                tenant=f"tenant-{tid}")
+                dt_ms = (time.perf_counter() - t) * 1e3
+                ttft_ms.append(dt_ms)
+                by_tenant.setdefault(f"tenant-{tid}", []).append(dt_ms)
             t = time.perf_counter()
             toks = sum(len(router.generate(list(p), max_new_tokens=8)
                            ["tokens"]) for p in prompts[:replicas * 4])
@@ -1113,11 +1146,40 @@ def run_fleet_probe() -> int:
                        for r in router.replicas)
             misses = sum(r.engine.stats().get("prefix_misses", 0)
                          for r in router.replicas)
-            return {"p50": float(np.percentile(ttft_ms, 50)),
-                    "p95": float(np.percentile(ttft_ms, 95)),
-                    "tput": tput,
-                    "hit_rate": hits / max(1, hits + misses),
-                    "affinity": router._affinity_hits.value}
+            out = {"p50": float(np.percentile(ttft_ms, 50)),
+                   "p95": float(np.percentile(ttft_ms, 95)),
+                   "tput": tput,
+                   "hit_rate": hits / max(1, hits + misses),
+                   "affinity": router._affinity_hits.value,
+                   "per_tenant_p95": {
+                       k: float(np.percentile(v, 95))
+                       for k, v in sorted(by_tenant.items())}}
+            if prefix_cache:
+                # the tenant label must have flowed router -> engine
+                # into the bounded-cardinality serve histograms and the
+                # SLO ledger's per-tenant gauges
+                text = "\n".join(r.engine.registry.render()
+                                 for r in router.replicas)
+                assert "m2kt_serve_tenant_ttft_seconds" in text
+                assert 'tenant="tenant-0"' in text, \
+                    "tenant label did not reach any engine registry"
+                assert "m2kt_slo_tenant_ttft_p95_seconds" in text
+                eng = router.replicas[0].engine
+                # burn-rate drill: a synthetic best-effort flood of
+                # rejected requests against the drill-scaled windows
+                # (M2KT_SLO_WINDOW_SCALE) — the fast-burn alert input
+                # MUST fire, and recover state is visible in the gauges
+                for _ in range(64):
+                    eng.slo.record("best-effort", ok=True, ttft_s=0.005)
+                for _ in range(2000):
+                    eng.slo.record("best-effort", ok=False)
+                assert eng.slo.fast_burn_firing(), \
+                    "best-effort flood did not fire the fast-burn alert"
+                eng.registry.render()  # export hook: gauges refresh
+                out["burn_drill_fired"] = True
+                out["burn_fast_short"] = eng.slo.burn_rate(
+                    eng.slo.spec.fast_windows[1])
+            return out
         finally:
             for rep in router.replicas:
                 rep.close()
@@ -1129,6 +1191,40 @@ def run_fleet_probe() -> int:
     assert speedup > 1.0, (
         f"prefix cache did not improve p95 TTFT: "
         f"{warm['p95']:.2f}ms cached vs {cold['p95']:.2f}ms uncached")
+
+    # acceptance drill: one disagg request traced router -> prefill ->
+    # decode must stitch into ONE trace whose router-observed e2e
+    # decomposes EXACTLY into child spans + synthesized hop gaps
+    router_tr = tracing.SpanRecorder(role="router")
+    decode_tr = tracing.SpanRecorder(role="decode")
+    prefill_tr = tracing.SpanRecorder(role="prefill")
+    ecfg = EngineConfig(max_batch=2, max_seq=256, block_size=8,
+                        buckets=(256,))
+    rep = InProcessReplica(
+        "decode-0",
+        ServingEngine(model, variables, ecfg, tracer=decode_tr)).start()
+    pre = PrefillReplica(model, variables, ecfg, tracer=prefill_tr)
+    rtr = Router([rep], config=RouterConfig(disagg_threshold=8),
+                 prefill_replicas=[pre], tracer=router_tr)
+    try:
+        rtr.generate(list(prompts[0]), max_new_tokens=2,
+                     tenant="tenant-0")
+        col = FleetTraceCollector()
+        docs = [router_tr.ring_doc(), decode_tr.ring_doc(),
+                prefill_tr.ring_doc()]
+        merged = col.stitch(docs)
+        [root] = [s for s in merged["spans"]
+                  if s["name"] == "router.request"
+                  and not s["parent_id"]]
+        names = {s["name"] for s in merged["traces"][root["trace_id"]]}
+        assert {"prefill.request", "serve.request", SYNTH_HOP} <= names, (
+            f"disagg trace did not stitch across roles: {sorted(names)}")
+        decomp = col.decompose(root["trace_id"], docs=docs)
+        assert abs(decomp["residual_s"]) < 1e-9, (
+            f"stitched decomposition not exact: {decomp['residual_s']}")
+    finally:
+        rep.close()
+
     total_routed = 2 * (2 * n_requests + replicas * 4)
     print(json.dumps({
         "replicas": replicas, "tenants": n_tenants,
@@ -1143,6 +1239,15 @@ def run_fleet_probe() -> int:
         "throughput_tok_s_uncached": round(cold["tput"], 1),
         "affinity_hit_fraction": round(
             (warm["affinity"] + cold["affinity"]) / max(1, total_routed), 3),
+        "per_tenant_p95_ttft_ms": {
+            k: round(v, 3) for k, v in warm["per_tenant_p95"].items()},
+        "burn_drill_fired": warm["burn_drill_fired"],
+        "burn_rate_fast_short": round(warm["burn_fast_short"], 1),
+        "slo_window_scale": float(
+            os.environ.get("M2KT_SLO_WINDOW_SCALE", "1") or "1"),
+        "trace_residual_s": decomp["residual_s"],
+        "trace_parts": len(decomp["parts"]),
+        "trace_e2e_ms": round(decomp["e2e_s"] * 1e3, 3),
     }), flush=True)
     return 0
 

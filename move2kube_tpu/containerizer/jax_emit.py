@@ -291,6 +291,42 @@ def _ask_serving_knobs(name: str) -> dict:
     return knobs
 
 
+def _ask_slo_knobs(name: str) -> dict:
+    """Per-tenant SLO targets (obs/slo.py) as QA problems: the TTFT p95
+    target, the availability objective, and the tenant-label cardinality
+    cap. Baked into the serve template's env defaults and lifted into
+    Helm values by ``passes/parameterize.py``'s tpu_slo_parameterizer."""
+    from move2kube_tpu import qa
+
+    knobs = {}
+    for key, qid, desc, extra, default in (
+        ("ttft_p95", "obs.slo.ttftp95",
+         "Enter the TTFT p95 SLO target in seconds for [{name}]",
+         "requests whose time-to-first-token exceeds this count against "
+         "the error budget; burn-rate alerts fire on budget spend", "0.5"),
+        ("availability", "obs.slo.availability",
+         "Enter the availability SLO objective for [{name}]",
+         "fraction of requests that must complete AND meet latency "
+         "targets (e.g. 0.99 = 1% error budget)", "0.99"),
+        ("max_tenants", "obs.slo.maxtenants",
+         "Enter the max distinct tenant labels for [{name}]",
+         "bounded metric cardinality: tenants beyond this collapse into "
+         "the 'other' series", "8"),
+    ):
+        raw = qa.fetch_input(
+            f"m2kt.services.{name}.{qid}", desc.format(name=name),
+            [extra], default)
+        try:
+            knobs[key] = (max(1, int(raw)) if key == "max_tenants"
+                          else float(raw))
+        except (TypeError, ValueError):
+            log.warning("invalid %s answer %r for %s; using %s",
+                        qid, raw, name, default)
+            knobs[key] = (int(default) if key == "max_tenants"
+                          else float(default))
+    return knobs
+
+
 def _ask_obs_port(name: str) -> int:
     """Telemetry (/metrics) port as a QA problem. Same ID as
     ``passes/optimize.py``'s tpu_observability_optimizer — asked once,
@@ -423,6 +459,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
     if serving:
         acc.serving_port = serve_port
         serve_knobs = _ask_serving_knobs(name)
+        slo_knobs = _ask_slo_knobs(name)
         with open(os.path.join(_ASSETS, "serve_tpu.py"),
                   encoding="utf-8") as f:
             container.add_file(
@@ -440,6 +477,9 @@ def emit_container(service: PlanService, plan=None) -> Container:
                     "serve_quant": serve_knobs["quant"],
                     "serve_kernels": serve_knobs["kernels"],
                     "spec_k": serve_knobs["spec_k"],
+                    "slo_ttft_p95": slo_knobs["ttft_p95"],
+                    "slo_availability": slo_knobs["availability"],
+                    "slo_max_tenants": slo_knobs["max_tenants"],
                     "compile_cache_dir": "/app/.jax-cache",
                     "metrics_port": metrics_port,
                 }))

@@ -20,6 +20,12 @@ roofline accounting from ``cost_analysis``/``memory_analysis``, the
 preflight plan report (``m2kt-plan-report.{json,md}``), and the OOM
 memory-snapshot sidecar the flight recorder folds in.
 
+PR 12 extends the plane across the fleet: W3C traceparent propagation
+and role tagging (:mod:`tracing`), the ``/traces`` drain endpoint
+(:mod:`server`), the cross-role trace collector with exact hop-gap
+stitching (:mod:`fleetview`), and the per-tenant SLO/burn-rate ledger
+(:mod:`slo`).
+
 Stdlib-only on import (jax is loaded lazily, only for profiling and
 device-memory reads) so the whole package vendors into emitted images.
 """
@@ -47,12 +53,21 @@ from move2kube_tpu.obs.costmodel import (
     write_memory_snapshot,
     write_plan_report,
 )
+from move2kube_tpu.obs.fleetview import FleetTraceCollector
 from move2kube_tpu.obs.metrics import (
+    OVERFLOW_LABEL,
     Counter,
     Gauge,
     Histogram,
     Registry,
     default_registry,
+)
+from move2kube_tpu.obs.slo import (
+    SLOSpec,
+    SLOTracker,
+    TENANT_HEADER,
+    clean_tenant,
+    max_tenants,
 )
 from move2kube_tpu.obs.server import (
     DEFAULT_METRICS_PORT,
@@ -65,7 +80,10 @@ from move2kube_tpu.obs.server import (
 from move2kube_tpu.obs.tracing import (
     Span,
     SpanRecorder,
+    TRACEPARENT_HEADER,
+    fleet_role,
     install_ring_flush,
+    parse_traceparent,
 )
 from move2kube_tpu.obs.tracing import enabled as tracing_enabled
 from move2kube_tpu.obs.tracing import get as get_tracer
@@ -92,6 +110,16 @@ __all__ = [
     "get_tracer",
     "tracing_enabled",
     "install_ring_flush",
+    "parse_traceparent",
+    "fleet_role",
+    "TRACEPARENT_HEADER",
+    "FleetTraceCollector",
+    "SLOSpec",
+    "SLOTracker",
+    "TENANT_HEADER",
+    "clean_tenant",
+    "max_tenants",
+    "OVERFLOW_LABEL",
     "CHIP_SPECS",
     "ChipSpec",
     "CostReport",

@@ -21,6 +21,11 @@ import threading
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0)
 
+# where capped families send series beyond ``max_series``: one shared
+# overflow bucket instead of unbounded growth from untrusted label
+# values (tenant ids arrive on request headers)
+OVERFLOW_LABEL = "other"
+
 
 def _escape_label(value: str) -> str:
     return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
@@ -45,15 +50,22 @@ def _fmt(value: float) -> str:
 
 class _Family:
     """One metric family: a name + help + label names + children keyed by
-    label-value tuples. A label-less family has a single child keyed ()."""
+    label-value tuples. A label-less family has a single child keyed ().
+
+    ``max_series > 0`` caps distinct children: the first ``max_series``
+    label tuples get their own series, everything after collapses into a
+    shared ``("other", ...)`` child — first-come seats approximate the
+    top-K heavy hitters, and an adversary spraying unique tenant headers
+    grows the exposition by at most one series."""
 
     kind = "untyped"
 
     def __init__(self, name: str, help: str, labelnames: tuple[str, ...],
-                 lock: threading.RLock) -> None:
+                 lock: threading.RLock, max_series: int = 0) -> None:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
+        self.max_series = int(max_series)
         self._lock = lock
         self._children: dict[tuple[str, ...], object] = {}
 
@@ -76,6 +88,11 @@ class _Family:
                 f"got {len(values)}")
         with self._lock:
             child = self._children.get(values)
+            if child is None:
+                if (self.max_series > 0 and self.labelnames
+                        and len(self._children) >= self.max_series):
+                    values = (OVERFLOW_LABEL,) * len(self.labelnames)
+                    child = self._children.get(values)
             if child is None:
                 child = self._children[values] = self._make_child()
             return child
@@ -185,8 +202,9 @@ class Histogram(_Family):
     kind = "histogram"
 
     def __init__(self, name, help, labelnames, lock,
-                 buckets=DEFAULT_BUCKETS) -> None:
-        super().__init__(name, help, labelnames, lock)
+                 buckets=DEFAULT_BUCKETS, max_series: int = 0) -> None:
+        super().__init__(name, help, labelnames, lock,
+                         max_series=max_series)
         edges = sorted(float(b) for b in buckets)
         if not edges:
             raise ValueError("histogram needs at least one bucket")
@@ -293,18 +311,23 @@ class Registry:
             return fam
 
     def counter(self, name: str, help: str = "",
-                labels: tuple[str, ...] = ()) -> Counter:
-        return self._get_or_create(Counter, name, help, labels)
+                labels: tuple[str, ...] = (),
+                max_series: int = 0) -> Counter:
+        return self._get_or_create(Counter, name, help, labels,
+                                   max_series=max_series)
 
     def gauge(self, name: str, help: str = "",
-              labels: tuple[str, ...] = ()) -> Gauge:
-        return self._get_or_create(Gauge, name, help, labels)
+              labels: tuple[str, ...] = (),
+              max_series: int = 0) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels,
+                                   max_series=max_series)
 
     def histogram(self, name: str, help: str = "",
                   labels: tuple[str, ...] = (),
-                  buckets=DEFAULT_BUCKETS) -> Histogram:
+                  buckets=DEFAULT_BUCKETS,
+                  max_series: int = 0) -> Histogram:
         return self._get_or_create(Histogram, name, help, labels,
-                                   buckets=buckets)
+                                   buckets=buckets, max_series=max_series)
 
     def add_collect_hook(self, fn) -> None:
         """Run ``fn()`` at every exposition, before rendering — the pull

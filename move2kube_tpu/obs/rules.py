@@ -31,6 +31,11 @@ THRESHOLDS = {
     "tpuservequeuemax": "64",      # queued requests before alarm
     "tpumfumin": "0.05",           # achieved-MFU alarm floor
     "tpuhbmheadroomfrac": "0.92",  # peak-HBM fraction of chip capacity
+    # SLO burn-rate multiples (obs/slo.py exports the gauges; the SRE
+    # 1h/5m + 6h/30m window pairing lives in the gauge's window label)
+    "tpuslofastburn": "14.4",      # fast-burn page threshold
+    "tpusloslowburn": "6",         # slow-burn ticket threshold
+    "tpuslottftp95": "0.5",        # per-tenant TTFT p95 objective, s
 }
 
 
@@ -154,6 +159,65 @@ def prometheus_rule(name: str, selector_label: str,
                     "replicas or raise the max decode batch."),
             },
         })
+        inner = sel[1:-1]
+        rules.append({
+            "alert": "M2KTSLOFastBurn",
+            # the SRE multi-window pairing: page only while BOTH the
+            # long and the short fast window burn over threshold, so
+            # the page stops as soon as the short window recovers
+            "expr": (
+                f'm2kt_slo_burn_rate{{window="fast_long",{inner}}} '
+                f"> {th['tpuslofastburn']} and "
+                f'm2kt_slo_burn_rate{{window="fast_short",{inner}}} '
+                f"> {th['tpuslofastburn']}"),
+            "for": "2m",
+            "labels": {"severity": "critical", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: SLO error budget burning fast",
+                "description": (
+                    "At this burn rate the monthly error budget is gone "
+                    "in hours — a flood or a latency regression is "
+                    "failing the TTFT/availability objective right now. "
+                    "Check per-tenant attainment "
+                    "(m2kt_slo_tenant_attainment) to see who is "
+                    "affected and the router reason-labeled retry "
+                    "counters for the cause."),
+            },
+        })
+        rules.append({
+            "alert": "M2KTSLOSlowBurn",
+            "expr": (
+                f'm2kt_slo_burn_rate{{window="slow_long",{inner}}} '
+                f"> {th['tpusloslowburn']} and "
+                f'm2kt_slo_burn_rate{{window="slow_short",{inner}}} '
+                f"> {th['tpusloslowburn']}"),
+            "for": "15m",
+            "labels": {"severity": "warning", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: SLO error budget burning steadily",
+                "description": (
+                    "A sustained moderate burn: not page-worthy, but at "
+                    "this rate the budget is exhausted before the SLO "
+                    "period ends. Ticket and trend the per-tenant TTFT "
+                    "p95 gauges."),
+            },
+        })
+        rules.append({
+            "alert": "M2KTSLOTenantTTFTHigh",
+            "expr": (f"m2kt_slo_tenant_ttft_p95_seconds{sel} "
+                     f"> {th['tpuslottftp95']}"),
+            "for": "10m",
+            "labels": {"severity": "warning", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: a tenant's TTFT p95 is over target",
+                "description": (
+                    "One tenant is missing the TTFT objective while the "
+                    "aggregate may still look healthy — check the "
+                    "tenant label on this alert, their prefix-cache "
+                    "affinity, and whether their traffic is landing on "
+                    "a spilled replica."),
+            },
+        })
     return {
         "apiVersion": "monitoring.coreos.com/v1",
         "kind": "PrometheusRule",
@@ -214,6 +278,19 @@ def grafana_dashboard(name: str, selector_label: str,
         panels.append(_panel(
             9, "Serving roofline class by executable",
             f"m2kt_serve_roofline_bound{sel}", 0, 32))
+        # SLO row (obs/slo.py): budget burn + who is missing the target
+        panels.append(_panel(
+            10, "SLO burn rate by window",
+            f"m2kt_slo_burn_rate{sel}", 12, 32))
+        panels.append(_panel(
+            11, "SLO attainment by window",
+            f"m2kt_slo_attainment{sel}", 0, 40, "percentunit"))
+        panels.append(_panel(
+            12, "Tenant TTFT p95",
+            f"m2kt_slo_tenant_ttft_p95_seconds{sel}", 12, 40, "s"))
+        panels.append(_panel(
+            13, "Tenant attainment",
+            f"m2kt_slo_tenant_attainment{sel}", 0, 48, "percentunit"))
     return {
         "title": f"move2kube-tpu: {name}",
         "uid": f"m2kt-{name}",

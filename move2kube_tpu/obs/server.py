@@ -1,4 +1,5 @@
-"""Stdlib-HTTP telemetry server: /metrics, /healthz, /readyz, /profile.
+"""Stdlib-HTTP telemetry server: /metrics, /healthz, /readyz, /profile,
+/traces.
 
 One daemon thread per process (ThreadingHTTPServer: a slow profiler
 capture must not block a concurrent scrape). ``/profile`` drives
@@ -14,6 +15,12 @@ while ``/readyz`` reports the workload's actual state (``starting`` /
 ``serving`` / ``draining``) via a caller-supplied provider and returns
 503 until it says ``serving``, so a serving pod takes no traffic before
 warm-up and is drained from endpoints before shutdown.
+
+``/traces`` serves the process's span ring as the same JSON document the
+flight recorder dumps (host/role/slice + spans with unix-anchored
+endpoints) — the fleet trace collector polls it on every role to stitch
+one cross-process timeline. ``/traces?clear=1`` drains: snapshot, then
+reset the ring, so repeated collector pulls do not double-count.
 """
 
 from __future__ import annotations
@@ -51,8 +58,11 @@ class TelemetryServer:
 
     def __init__(self, port: int = 0, registry: Registry | None = None,
                  profile_dir: str | None = None,
-                 readiness=None) -> None:
+                 readiness=None, tracer=None) -> None:
         self.registry = registry if registry is not None else default_registry()
+        # span recorder served by /traces; None falls back to the
+        # process-wide recorder iff tracing is enabled
+        self._tracer = tracer
         self.profile_dir = (profile_dir
                             or os.environ.get(PROFILE_DIR_ENV, "")
                             or DEFAULT_PROFILE_DIR)
@@ -99,6 +109,8 @@ class TelemetryServer:
             self._handle_readyz(req)
         elif parsed.path == "/profile":
             self._handle_profile(req, parse_qs(parsed.query))
+        elif parsed.path == "/traces":
+            self._handle_traces(req, parse_qs(parsed.query))
         else:
             self._send(req, 404, "not found\n")
 
@@ -106,6 +118,25 @@ class TelemetryServer:
         """Install/replace the readiness provider after construction (the
         serve template builds the server before the engine exists)."""
         self._readiness = readiness
+
+    def set_tracer(self, tracer) -> None:
+        """Install/replace the span recorder served by ``/traces`` (same
+        post-construction shape as ``set_readiness``)."""
+        self._tracer = tracer
+
+    def _handle_traces(self, req, query: dict) -> None:
+        from move2kube_tpu.obs import tracing
+
+        tracer = self._tracer
+        if tracer is None and tracing.enabled():
+            tracer = tracing.get()
+        if tracer is None:
+            self._send(req, 404, "tracing disabled\n")
+            return
+        doc = tracer.ring_doc()
+        if query.get("clear", ["0"])[0] not in ("0", "", "false"):
+            tracer.clear()
+        self._send(req, 200, json.dumps(doc) + "\n", "application/json")
 
     def _handle_readyz(self, req) -> None:
         state = "serving"
@@ -177,7 +208,8 @@ class TelemetryServer:
 def start_telemetry_server(port: int | None = None,
                            registry: Registry | None = None,
                            profile_dir: str | None = None,
-                           readiness=None) -> TelemetryServer | None:
+                           readiness=None,
+                           tracer=None) -> TelemetryServer | None:
     """Start the telemetry server. ``port=None`` resolves from
     ``M2KT_METRICS_PORT`` and returns None when that says disabled (0 /
     unset) — the shape the emitted templates use. An explicit ``port=0``
@@ -189,7 +221,7 @@ def start_telemetry_server(port: int | None = None,
     try:
         return TelemetryServer(port=port, registry=registry,
                                profile_dir=profile_dir,
-                               readiness=readiness).start()
+                               readiness=readiness, tracer=tracer).start()
     except OSError:
         # never kill a training run over a busy metrics port
         return None

@@ -48,6 +48,11 @@ from contextlib import contextmanager
 TRACE_ENV = "M2KT_TRACE"
 RING_SECONDS_ENV = "M2KT_TRACE_RING_SECONDS"
 FLIGHT_PATH_ENV = "M2KT_FLIGHT_PATH"
+ROLE_ENV = "M2KT_FLEET_ROLE"
+
+# the W3C header name, and the fleet roles a recorder may claim
+TRACEPARENT_HEADER = "traceparent"
+FLEET_ROLES = ("router", "prefill", "decode", "train")
 
 DEFAULT_RING_SECONDS = 120.0
 # hard cap regardless of ring_seconds: a serving engine decoding 1k
@@ -88,6 +93,41 @@ def ring_path() -> str:
     return flight_path() + ".ring"
 
 
+def fleet_role() -> str:
+    """The role this process plays in the fleet (``M2KT_FLEET_ROLE``);
+    defaults to ``train`` — the workload every pre-fleet emitter ran."""
+    role = os.environ.get(ROLE_ENV, "").strip().lower()
+    return role if role else "train"
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """Validate a W3C ``traceparent`` header and return
+    ``(trace_id, parent_span_id)``, or None for anything malformed —
+    request headers are untrusted input and a bad one must degrade to
+    "start a fresh trace", never to an exception on the serve path."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if (len(version), len(trace_id), len(span_id)) != (2, 32, 16):
+        return None
+    if len(flags) != 2:
+        return None
+    try:
+        int(version, 16)
+        int(trace_id, 16)
+        int(span_id, 16)
+        int(flags, 16)
+    except ValueError:
+        return None
+    # version ff is reserved-invalid; all-zero ids mean "no parent"
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
 def _new_id(nbytes: int) -> str:
     return os.urandom(nbytes).hex()
 
@@ -110,6 +150,14 @@ class Span:
         self.attrs: dict = dict(attrs) if attrs else {}
         self._token = None
 
+    def traceparent(self) -> str:
+        """This span's identity as a W3C ``traceparent`` header value —
+        what the router injects on every cross-process hop so the
+        replica's root span lands in the router's trace. Ids are already
+        W3C-sized (32-hex trace, 16-hex span), sampled flag always set:
+        the ring is the sampler."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
 
 class SpanRecorder:
     """Thread-safe bounded ring of completed spans + in-flight set.
@@ -122,7 +170,8 @@ class SpanRecorder:
 
     def __init__(self, ring_seconds: float | None = None,
                  max_spans: int = DEFAULT_MAX_SPANS,
-                 host: str | None = None, slice_id: int | None = None):
+                 host: str | None = None, slice_id: int | None = None,
+                 role: str | None = None):
         self._lock = threading.Lock()
         self._ring: deque[Span] = deque()
         self._active: dict[str, Span] = {}
@@ -139,6 +188,10 @@ class SpanRecorder:
             except ValueError:
                 slice_id = 0
         self.slice_id = slice_id
+        # fleet role rides every span and the flight-recorder header, so
+        # a ring flushed by a dead prefill replica is distinguishable
+        # from a router's or a trainer's at a glance
+        self.role = (role or fleet_role()).strip().lower()
         self.dropped = 0
         # per-recorder context: nested start() calls parent automatically
         # within one thread/task without threading ids through call sites
@@ -149,21 +202,30 @@ class SpanRecorder:
 
     def start(self, name: str, attrs: dict | None = None,
               parent: Span | None = None, trace_id: str | None = None,
-              detached: bool = False) -> Span:
-        """Open a span. Parent/trace identity comes from (in order) the
-        explicit args, the calling context's current span, or a fresh
-        root trace. The new span becomes the context's current span —
-        unless ``detached``, which neither inherits nor sets the context
-        (the serving engine interleaves many live request traces in one
-        thread and threads identity explicitly instead)."""
-        if parent is None and not detached:
-            parent = self._current.get()
-        if parent is not None:
-            trace_id = trace_id or parent.trace_id
-            parent_id = parent.span_id
+              detached: bool = False,
+              remote_parent: str | None = None) -> Span:
+        """Open a span. Parent/trace identity comes from (in order) a
+        ``remote_parent`` W3C traceparent header (cross-process: the
+        span adopts the remote trace id and parents under the remote
+        span), the explicit args, the calling context's current span, or
+        a fresh root trace. The new span becomes the context's current
+        span — unless ``detached``, which neither inherits nor sets the
+        context (the serving engine interleaves many live request traces
+        in one thread and threads identity explicitly instead). A
+        malformed ``remote_parent`` is ignored, not raised: headers are
+        untrusted."""
+        remote = parse_traceparent(remote_parent) if remote_parent else None
+        if remote is not None:
+            trace_id, parent_id = remote
         else:
-            trace_id = trace_id or _new_id(16)
-            parent_id = ""
+            if parent is None and not detached:
+                parent = self._current.get()
+            if parent is not None:
+                trace_id = trace_id or parent.trace_id
+                parent_id = parent.span_id
+            else:
+                trace_id = trace_id or _new_id(16)
+                parent_id = ""
         span = Span(name, trace_id, _new_id(8), parent_id,
                     time.perf_counter(), attrs)
         if not detached:
@@ -256,6 +318,7 @@ class SpanRecorder:
                 "ts_unix": round(self._unix(s.t0), 6),
                 "dur_s": round(end - s.t0, 9),
                 "in_flight": s.t1 is None,
+                "role": self.role,
                 "attrs": dict(s.attrs),
             })
         return out
@@ -277,12 +340,14 @@ class SpanRecorder:
                 "cat": "m2kt",
                 "args": {**s["attrs"], "trace_id": s["trace_id"],
                          "span_id": s["span_id"],
-                         "parent_id": s["parent_id"]},
+                         "parent_id": s["parent_id"],
+                         "role": s["role"]},
             })
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {"host": self.host, "slice_id": self.slice_id,
+                          "role": self.role,
                           "anchor_unix": self._t0_unix},
         }
 
@@ -293,6 +358,7 @@ class SpanRecorder:
             {"key": "host.name", "value": {"stringValue": self.host}},
             {"key": "m2kt.slice_id",
              "value": {"intValue": str(self.slice_id)}},
+            {"key": "m2kt.role", "value": {"stringValue": self.role}},
             {"key": "service.name", "value": {"stringValue": "move2kube-tpu"}},
         ]
         lines = []
@@ -330,20 +396,27 @@ class SpanRecorder:
 
     # -- flight-recorder half ---------------------------------------------
 
-    def flush_ring(self, path: str | None = None) -> str | None:
-        """Atomically dump the ring for the supervisor's flight recorder.
-        Best-effort by design — this runs on dying-process paths and must
-        never mask the original exit code."""
-        path = path or ring_path()
-        doc = {
+    def ring_doc(self) -> dict:
+        """The ring as one self-describing JSON document — the shape the
+        flight recorder dumps and the ``/traces`` drain endpoint serves,
+        so the fleet collector and the supervisor parse the same thing."""
+        return {
             "host": self.host,
             "slice_id": self.slice_id,
+            "role": self.role,
             "pid": os.getpid(),
             "written_unix": time.time(),
             "ring_seconds": self.ring_seconds,
             "dropped": self.dropped,
             "spans": self.snapshot(),
         }
+
+    def flush_ring(self, path: str | None = None) -> str | None:
+        """Atomically dump the ring for the supervisor's flight recorder.
+        Best-effort by design — this runs on dying-process paths and must
+        never mask the original exit code."""
+        path = path or ring_path()
+        doc = self.ring_doc()
         try:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)

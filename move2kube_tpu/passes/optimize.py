@@ -347,6 +347,57 @@ def tpu_observability_optimizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_slo_optimizer(ir: IR) -> IR:
+    """Bake the per-tenant SLO targets into accelerated *serving*
+    services' pod env (``M2KT_SLO_TTFT_P95_S`` / ``M2KT_SLO_AVAILABILITY``
+    / ``M2KT_OBS_MAX_TENANTS``).
+
+    Asks the SAME QA problems as the jax-xla emitter
+    (``m2kt.services.<name>.obs.slo.*``) — answered once and cached, so
+    the serve template's baked-in defaults and the workload env agree;
+    the tpu_slo_parameterizer then lifts these env values into Helm
+    values (tpuslottftp95 etc.) so operators retune without a rebuild.
+    Training services are skipped: the SLO ledger measures request
+    latency, which only the serving engine has."""
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None or not getattr(acc, "serving", False):
+            continue
+        name = common.make_dns_label(svc.name)
+        entries = []
+        for qid, desc, extra, default, env_name, is_int in (
+            ("obs.slo.ttftp95",
+             f"Enter the TTFT p95 SLO target in seconds for [{name}]",
+             "requests whose time-to-first-token exceeds this count "
+             "against the error budget; burn-rate alerts fire on budget "
+             "spend", "0.5", "M2KT_SLO_TTFT_P95_S", False),
+            ("obs.slo.availability",
+             f"Enter the availability SLO objective for [{name}]",
+             "fraction of requests that must complete AND meet latency "
+             "targets (e.g. 0.99 = 1% error budget)", "0.99",
+             "M2KT_SLO_AVAILABILITY", False),
+            ("obs.slo.maxtenants",
+             f"Enter the max distinct tenant labels for [{name}]",
+             "bounded metric cardinality: tenants beyond this collapse "
+             "into the 'other' series", "8", "M2KT_OBS_MAX_TENANTS", True),
+        ):
+            raw = qa.fetch_input(f"m2kt.services.{name}.{qid}", desc,
+                                 [extra], default)
+            try:
+                value = (str(max(1, int(raw))) if is_int
+                         else str(float(raw)))
+            except (TypeError, ValueError):
+                value = default
+            entries.append((env_name, value))
+        for container in svc.containers:
+            env = container.setdefault("env", [])
+            existing = {e.get("name") for e in env}
+            for env_name, value in entries:
+                if env_name not in existing:
+                    env.append({"name": env_name, "value": value})
+    return ir
+
+
 def tpu_planreport_optimizer(ir: IR) -> IR:
     """Bake ``M2KT_PLAN_REPORT=1`` into accelerated *training* services
     behind the ``m2kt.services.<name>.obs.planreport`` QA knob
@@ -383,6 +434,7 @@ OPTIMIZERS = [
     tpu_fleet_optimizer,
     tpu_elastic_optimizer,
     tpu_observability_optimizer,
+    tpu_slo_optimizer,
     tpu_planreport_optimizer,
 ]
 

@@ -185,6 +185,35 @@ def tpu_obs_parameterizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_slo_parameterizer(ir: IR) -> IR:
+    """Lift the SLO env the slo optimizer injected into chart values, so
+    a Helm install retunes the SLO plane per environment
+    (``--set tpuslottftp95=0.3``) without a rebuild. The values names
+    match obs/rules.py ``THRESHOLDS`` where they overlap
+    (``tpuslottftp95``), so the burn-rate PrometheusRule's alert floor
+    and the runtime target stay one knob."""
+    lifted = {
+        "M2KT_SLO_TTFT_P95_S": "tpuslottftp95",
+        "M2KT_SLO_AVAILABILITY": "tpusloavailability",
+        "M2KT_OBS_MAX_TENANTS": "tpuslomaxtenants",
+    }
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None or not getattr(acc, "serving", False):
+            continue
+        for container in svc.containers:
+            for env in container.get("env", []) or []:
+                key = lifted.get(env.get("name"))
+                if key is None:
+                    continue
+                value = env.get("value")
+                if value is None or "{{" in str(value):
+                    continue
+                ir.values.global_variables.setdefault(key, str(value))
+                env["value"] = "{{ .Values.%s }}" % key
+    return ir
+
+
 def tpu_rules_parameterizer(ir: IR) -> IR:
     """Lift the alert-rule thresholds (obs/rules.py ``THRESHOLDS``) into
     chart values for every service whose ``m2kt.services.<name>.obs.rules``
@@ -218,7 +247,8 @@ PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
                   storage_class_parameterizer, tpu_training_parameterizer,
                   tpu_serving_parameterizer, tpu_fleet_parameterizer,
                   tpu_elastic_parameterizer,
-                  tpu_obs_parameterizer, tpu_rules_parameterizer]
+                  tpu_obs_parameterizer, tpu_slo_parameterizer,
+                  tpu_rules_parameterizer]
 
 
 def parameterize(ir: IR) -> IR:

@@ -78,6 +78,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from move2kube_tpu.obs import slo as slolib
 from move2kube_tpu.obs import tracing
 from move2kube_tpu.obs.metrics import Registry
 from move2kube_tpu.serving import kvcache
@@ -194,6 +195,12 @@ class Request:
     rid: str
     prompt: list[int]
     max_new_tokens: int | None = None
+    # multi-tenant attribution: the X-M2KT-Tenant header value, carried
+    # router -> replica -> engine ("" = the default tenant)
+    tenant: str = ""
+    # W3C traceparent of the caller's span: the engine's serve.request
+    # root adopts its trace id so cross-process traces stitch
+    traceparent: str = ""
 
 
 @dataclasses.dataclass
@@ -288,6 +295,7 @@ class ServingEngine:
         self._decode_tokens = 0
         self._prefill_count = 0
         self._submit_ts: dict[str, float] = {}
+        self._req_tenant: dict[str, str] = {}
         # per-request distributed traces (admit -> queue-wait -> prefill
         # -> decode steps -> complete); identity is threaded explicitly
         # because many live request traces interleave in one thread
@@ -299,6 +307,9 @@ class ServingEngine:
         # serve template passes obs.default_registry() so /metrics sees it
         self.registry = registry if registry is not None else Registry()
         self._init_metrics()
+        # per-tenant SLO ledger: attainment windows + burn-rate gauges on
+        # the same registry /metrics scrapes
+        self.slo = slolib.SLOTracker(registry=self.registry)
         self._snapshot_persistent_cache()
 
     def _init_metrics(self) -> None:
@@ -358,12 +369,40 @@ class ServingEngine:
         self._spec_acceptance = reg.gauge(
             "m2kt_serve_spec_acceptance_rate",
             "Accepted / proposed draft tokens (cumulative)")
+        # per-tenant attribution lives in NEW families (the unlabelled
+        # m2kt_serve_* histograms keep their label-less default child,
+        # which stats() depends on); cardinality is capped — tenant K+1
+        # and beyond collapse into the "other" series
+        cap = slolib.max_tenants()
+        self._tenant_ttft = reg.histogram(
+            "m2kt_serve_tenant_ttft_seconds",
+            "Time to first token by tenant", buckets=LATENCY_BUCKETS,
+            labels=("tenant",), max_series=cap + 1)
+        self._tenant_lat = reg.histogram(
+            "m2kt_serve_tenant_token_latency_seconds",
+            "Per-token decode latency by tenant", buckets=LATENCY_BUCKETS,
+            labels=("tenant",), max_series=cap + 1)
+        self._tenant_admitted = reg.counter(
+            "m2kt_serve_tenant_admitted_total",
+            "Requests admitted into a slot by tenant",
+            labels=("tenant",), max_series=cap + 1)
+        self._tenant_rejected = reg.counter(
+            "m2kt_serve_tenant_rejected_total",
+            "Requests rejected at submit by tenant",
+            labels=("tenant",), max_series=cap + 1)
         self._quant_mode = reg.gauge(
             "m2kt_serve_quant_mode",
             "Serving quant policy (0=off, 1=int8, 2=int8-kv)")
         self._quant_mode.set(quantlib.QUANT_OPTIONS.index(self.quant.name))
         self._total_pages = max(1, self.cache_cfg.num_pages - 1)  # page 0 reserved
         self._update_occupancy()
+
+    def _close_ttft(self, rid: str, ttft: float) -> None:
+        """Per-tenant side of a TTFT close: the tenant histogram and the
+        SLO ledger see the same reading the fleet histogram recorded."""
+        tenant = self._req_tenant.get(rid, "default")
+        self._tenant_ttft.labels(tenant).observe(ttft)
+        self.slo.record(tenant, ok=True, ttft_s=ttft)
 
     def _update_occupancy(self) -> None:
         active = sum(1 for s in self._slots if s is not None)
@@ -480,6 +519,7 @@ class ServingEngine:
     def submit(self, req: Request) -> None:
         plen = len(req.prompt)
         max_new = req.max_new_tokens or self.config.max_new_tokens
+        tenant = slolib.clean_tenant(req.tenant)
         try:
             if plen < 1:
                 raise ValueError(f"{req.rid}: empty prompt")
@@ -495,12 +535,20 @@ class ServingEngine:
                     f"{slack} exceeds max_seq {self.cache_cfg.max_seq}")
         except ValueError:
             self._rejected.inc()
+            self._tenant_rejected.labels(tenant).inc()
+            self.slo.record(tenant, ok=False)
             raise
         self._submit_ts[req.rid] = time.perf_counter()
+        self._req_tenant[req.rid] = tenant
         if self.tracer is not None:
+            # adopt the caller's trace id when the request carries a
+            # traceparent so the fleet collector stitches router and
+            # replica rings into one trace
             self._req_spans[req.rid] = self.tracer.start(
-                "serve.request", attrs={"rid": req.rid, "prompt_len": plen},
-                detached=True)
+                "serve.request",
+                attrs={"rid": req.rid, "prompt_len": plen,
+                       "tenant": tenant},
+                detached=True, remote_parent=req.traceparent or None)
         self._pending.append(req)
         self._queue_depth.set(len(self._pending))
 
@@ -554,9 +602,12 @@ class ServingEngine:
                 if submit_ts is not None:
                     ttft = t0 + dt - submit_ts
                     self._ttft_hist.observe(ttft)
+                    self._close_ttft(slot.req.rid, ttft)
                     root = self._req_spans.get(slot.req.rid)
                     if root is not None:
                         root.attrs["ttft_s"] = ttft
+            self._tenant_lat.labels(
+                self._req_tenant.get(slot.req.rid, "default")).observe(dt)
             if logits_np is not None:
                 self.logit_log.setdefault(slot.req.rid, []).append(
                     logits_np[i].copy())
@@ -649,9 +700,12 @@ class ServingEngine:
                 if submit_ts is not None:
                     ttft = t0 + dt - submit_ts
                     self._ttft_hist.observe(ttft)
+                    self._close_ttft(slot.req.rid, ttft)
                     root = self._req_spans.get(slot.req.rid)
                     if root is not None:
                         root.attrs["ttft_s"] = ttft
+            self._tenant_lat.labels(
+                self._req_tenant.get(slot.req.rid, "default")).observe(dt)
             done = None
             for m, tok in enumerate(emitted):
                 if self.capture_logits:
@@ -718,6 +772,7 @@ class ServingEngine:
         self._allocator.free(slot.pages)
         self._slots[slot_idx] = None
         self._completed.labels(reason=reason).inc()
+        self._req_tenant.pop(slot.req.rid, None)
         if self.tracer is not None:
             root = self._req_spans.pop(slot.req.rid, None)
             if root is not None:
@@ -833,6 +888,8 @@ class ServingEngine:
                      prefix_hit=True)
         self._slots[slot_idx] = slot
         self._admitted.inc()
+        self._tenant_admitted.labels(
+            self._req_tenant.get(req.rid, "default")).inc()
         self._prefix_hits.inc()
         self._prefix_hit_tokens.inc(c)
         submit_ts = self._submit_ts.get(req.rid)
@@ -890,6 +947,8 @@ class ServingEngine:
             self._draft_cache = dc
         self._prefill_count += 1
         self._admitted.inc()
+        self._tenant_admitted.labels(
+            self._req_tenant.get(req.rid, "default")).inc()
         if self._prefix is not None:
             self._prefix_misses.inc()
         submit_ts = self._submit_ts.pop(req.rid, None)
@@ -900,6 +959,7 @@ class ServingEngine:
             # it doesn't approximate it)
             now = time.perf_counter()
             self._ttft_hist.observe(now - submit_ts)
+            self._close_ttft(req.rid, now - submit_ts)
             root = self._req_spans.get(req.rid)
             if self.tracer is not None and root is not None:
                 self.tracer.record(
@@ -981,14 +1041,19 @@ class ServingEngine:
         plen = int(prompt_len)
         max_new = req.max_new_tokens or self.config.max_new_tokens
         bucket = int(kvs[0][0].shape[1])
+        tenant = slolib.clean_tenant(req.tenant)
         if (plen < 1
                 or plen + max_new + self._spec_slack > self.cache_cfg.max_seq):
             self._rejected.inc()
+            self._tenant_rejected.labels(tenant).inc()
+            self.slo.record(tenant, ok=False)
             raise ValueError(f"{req.rid}: handoff of {plen} prompt + "
                              f"{max_new} new tokens does not fit max_seq "
                              f"{self.cache_cfg.max_seq}")
         if bucket > self.cache_cfg.max_seq:
             self._rejected.inc()
+            self._tenant_rejected.labels(tenant).inc()
+            self.slo.record(tenant, ok=False)
             raise ValueError(f"{req.rid}: handoff bucket {bucket} exceeds "
                              f"max_seq {self.cache_cfg.max_seq}")
         free = [i for i, s in enumerate(self._slots) if s is None]
@@ -999,6 +1064,7 @@ class ServingEngine:
         if pages is None:
             return False, []
         slot_idx = free[0]
+        t_install = time.perf_counter()
         bt_row = np.full((self.cache_cfg.max_pages_per_seq,), NULL_PAGE,
                          np.int32)
         bt_row[:len(pages)] = pages
@@ -1016,6 +1082,24 @@ class ServingEngine:
                 np.int32(slot_idx), np.int32(plen))
             self._draft_cache = dc
         self._admitted.inc()
+        self._tenant_admitted.labels(tenant).inc()
+        self._req_tenant[req.rid] = tenant
+        # availability counts the seat; TTFT closed on the prefill side,
+        # where the request's submit clock lives
+        self.slo.record(tenant, ok=True)
+        if self.tracer is not None and req.rid not in self._req_spans:
+            # the decode replica opens its own root for the handed-off
+            # request; remote_parent stitches it under the router's span
+            root = self.tracer.start(
+                "serve.request",
+                attrs={"rid": req.rid, "prompt_len": plen,
+                       "tenant": tenant, "disagg": 1},
+                detached=True, remote_parent=req.traceparent or None)
+            self._req_spans[req.rid] = root
+            self.tracer.record(
+                "serve.kv_install", t_install, time.perf_counter(),
+                attrs={"bucket": bucket, "prompt_len": plen},
+                trace_id=root.trace_id, parent_id=root.span_id)
         tok = int(first_token)
         slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
                      max_new=max_new)

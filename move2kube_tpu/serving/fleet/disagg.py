@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from move2kube_tpu.obs import tracing
 from move2kube_tpu.obs.metrics import Registry
 from move2kube_tpu.serving.engine import Completion, EngineConfig, Request
 
@@ -49,6 +50,11 @@ class KVHandoff:
     first_token: int
     kv: list[tuple[np.ndarray, np.ndarray]]  # per layer, [1, bucket, h, d]
     max_new_tokens: int | None = None
+    # fleet attribution rides the handoff: the tenant header and the
+    # router's span traceparent, so the decode replica's serve.request
+    # stitches into the same trace the router opened
+    tenant: str = ""
+    traceparent: str = ""
 
     def to_bytes(self) -> bytes:
         meta = {
@@ -56,6 +62,7 @@ class KVHandoff:
             "prompt_len": self.prompt_len, "bucket": self.bucket,
             "first_token": self.first_token,
             "max_new_tokens": self.max_new_tokens,
+            "tenant": self.tenant, "traceparent": self.traceparent,
         }
         buf = io.BytesIO()
         np.savez_compressed(
@@ -81,11 +88,15 @@ class KVHandoff:
                 bucket=int(meta["bucket"]),
                 first_token=int(meta["first_token"]),
                 kv=[(ks[i], vs[i]) for i in range(ks.shape[0])],
-                max_new_tokens=meta["max_new_tokens"])
+                max_new_tokens=meta["max_new_tokens"],
+                # older peers' handoffs simply lack the attribution keys
+                tenant=str(meta.get("tenant", "") or ""),
+                traceparent=str(meta.get("traceparent", "") or ""))
 
     def request(self) -> Request:
         return Request(rid=self.rid, prompt=list(self.prompt),
-                       max_new_tokens=self.max_new_tokens)
+                       max_new_tokens=self.max_new_tokens,
+                       tenant=self.tenant, traceparent=self.traceparent)
 
 
 class PrefillReplica:
@@ -94,9 +105,11 @@ class PrefillReplica:
     decode step — its whole job is turning prompts into handoffs."""
 
     def __init__(self, model, variables, config: EngineConfig | None = None,
-                 registry: Registry | None = None):
+                 registry: Registry | None = None, tracer=None):
         from move2kube_tpu.serving import quant as quantlib
 
+        self.tracer = tracer if tracer is not None else (
+            tracing.get() if tracing.enabled() else None)
         self.model = model
         self.config = config or EngineConfig.from_env()
         # same weight policy as the decode engine: the prefill executable
@@ -141,12 +154,24 @@ class PrefillReplica:
         t0 = time.perf_counter()
         first, kvs = self._prefill(self.variables, ids, np.int32(plen))
         kv_np = [(np.asarray(k), np.asarray(v)) for k, v in kvs]
-        self._prefill_time.inc(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._prefill_time.inc(t1 - t0)
         self._prefills.inc()
+        if self.tracer is not None:
+            # record() keeps the forward's own perf_counter readings; the
+            # remote parent (the router's call span) is resolved by hand
+            remote = tracing.parse_traceparent(req.traceparent or None)
+            self.tracer.record(
+                "prefill.request", t0, t1,
+                attrs={"rid": req.rid, "prompt_len": plen, "bucket": bucket,
+                       "tenant": req.tenant or "default"},
+                trace_id=remote[0] if remote else None,
+                parent_id=remote[1] if remote else "")
         return KVHandoff(
             rid=req.rid, prompt=list(req.prompt), prompt_len=plen,
             bucket=bucket, first_token=int(first), kv=kv_np,
-            max_new_tokens=req.max_new_tokens)
+            max_new_tokens=req.max_new_tokens,
+            tenant=req.tenant, traceparent=req.traceparent)
 
 
 class KVTransport:

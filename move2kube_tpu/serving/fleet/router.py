@@ -31,8 +31,38 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from move2kube_tpu.obs import tracing
 from move2kube_tpu.obs.metrics import Registry
+from move2kube_tpu.obs.slo import TENANT_HEADER, clean_tenant
+from move2kube_tpu.obs.tracing import TRACEPARENT_HEADER
 from move2kube_tpu.serving.engine import EngineConfig, Request, ServingEngine
+
+
+class ReplicaHTTPError(RuntimeError):
+    """A replica answered with a non-2xx status. Carries the status code
+    and a body excerpt so the router's mark-down reason and logs say
+    *what the replica said*, not just that urllib raised."""
+
+    def __init__(self, replica: str, path: str, status: int, body: str):
+        self.replica = replica
+        self.path = path
+        self.status = int(status)
+        self.body_excerpt = (body or "").strip()[:200]
+        super().__init__(
+            f"{replica}{path}: HTTP {self.status}: "
+            f"{self.body_excerpt or '<empty body>'}")
+
+
+def failure_reason(err: Exception) -> str:
+    """A bounded-cardinality label for why a replica call failed —
+    the value the reason-labeled retry/mark-down counters carry."""
+    if isinstance(err, ReplicaHTTPError):
+        return f"http_{err.status}"
+    if isinstance(err, TimeoutError):
+        return "timeout"
+    if isinstance(err, (urllib.error.URLError, ConnectionError, OSError)):
+        return "connection"
+    return type(err).__name__.lower()
 
 
 def prefix_hash(tokens, salt: str = "", k: int = 16) -> int:
@@ -55,7 +85,8 @@ class ReplicaHandle:
     name: str = "replica"
 
     def generate(self, prompt, max_new_tokens: int | None = None,
-                 rid: str | None = None) -> dict:
+                 rid: str | None = None, tenant: str = "",
+                 traceparent: str = "") -> dict:
         raise NotImplementedError
 
     def queue_depth(self) -> float:
@@ -119,7 +150,8 @@ class InProcessReplica(ReplicaHandle):
         stats = self.engine.stats()
         return float(stats["queue_depth"] + stats["active_slots"])
 
-    def generate(self, prompt, max_new_tokens=None, rid=None) -> dict:
+    def generate(self, prompt, max_new_tokens=None, rid=None,
+                 tenant: str = "", traceparent: str = "") -> dict:
         if self.fail_next > 0:
             self.fail_next -= 1
             raise RuntimeError(f"{self.name}: injected failure")
@@ -132,7 +164,9 @@ class InProcessReplica(ReplicaHandle):
             event, box = threading.Event(), []
             self._waiters[rid] = (event, box)
             self.engine.submit(Request(rid=rid, prompt=list(prompt),
-                                       max_new_tokens=max_new_tokens))
+                                       max_new_tokens=max_new_tokens,
+                                       tenant=tenant,
+                                       traceparent=traceparent))
         if not event.wait(timeout=120):
             self._waiters.pop(rid, None)
             raise TimeoutError(f"{self.name}: request {rid} timed out")
@@ -141,8 +175,11 @@ class InProcessReplica(ReplicaHandle):
                 "prompt_len": comp.prompt_len, "tokens": comp.tokens,
                 "finish_reason": comp.finish_reason}
 
-    def install(self, handoff_bytes: bytes) -> dict:
-        """Seat a disagg KV handoff and decode it to completion."""
+    def install(self, handoff_bytes: bytes, tenant: str = "",
+                traceparent: str = "") -> dict:
+        """Seat a disagg KV handoff and decode it to completion. The
+        handoff wire format already carries tenant/traceparent; the
+        kwargs exist for signature parity with :class:`HttpReplica`."""
         from move2kube_tpu.serving.fleet.disagg import KVHandoff
 
         h = KVHandoff.from_bytes(handoff_bytes)
@@ -183,22 +220,44 @@ class HttpReplica(ReplicaHandle):
         self.health_url = (health_url or base_url).rstrip("/")
         self.timeout_s = timeout_s
 
-    def generate(self, prompt, max_new_tokens=None, rid=None) -> dict:
+    def _post(self, path: str, data: bytes, ctype: str,
+              tenant: str = "", traceparent: str = "") -> bytes:
+        """POST with trace/tenant header injection. A non-2xx answer is
+        surfaced as :class:`ReplicaHTTPError` with the status and a body
+        excerpt — urllib's bare ``HTTP Error 500`` hid what the replica
+        actually said."""
+        headers = {"Content-Type": ctype}
+        if tenant:
+            headers[TENANT_HEADER] = tenant
+        if traceparent:
+            headers[TRACEPARENT_HEADER] = traceparent
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", data=data, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as err:
+            try:
+                body = err.read(512).decode("utf-8", "replace")
+            except OSError:
+                body = ""
+            raise ReplicaHTTPError(self.name, path, err.code,
+                                   body) from err
+
+    def generate(self, prompt, max_new_tokens=None, rid=None,
+                 tenant: str = "", traceparent: str = "") -> dict:
         body = json.dumps({"prompt": list(prompt),
                            "max_new_tokens": max_new_tokens,
                            "rid": rid}).encode()
-        req = urllib.request.Request(
-            f"{self.base_url}/generate", data=body,
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-            return json.loads(resp.read().decode())
+        return json.loads(self._post(
+            "/generate", body, "application/json",
+            tenant=tenant, traceparent=traceparent).decode())
 
-    def install(self, handoff_bytes: bytes) -> dict:
-        req = urllib.request.Request(
-            f"{self.base_url}/install", data=handoff_bytes,
-            headers={"Content-Type": "application/octet-stream"})
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-            return json.loads(resp.read().decode())
+    def install(self, handoff_bytes: bytes, tenant: str = "",
+                traceparent: str = "") -> dict:
+        return json.loads(self._post(
+            "/install", handoff_bytes, "application/octet-stream",
+            tenant=tenant, traceparent=traceparent).decode())
 
     def prefill(self, request):
         """Disagg prefill over HTTP: POST the prompt, get back the
@@ -208,11 +267,9 @@ class HttpReplica(ReplicaHandle):
         body = json.dumps({"prompt": list(request.prompt),
                            "max_new_tokens": request.max_new_tokens,
                            "rid": request.rid}).encode()
-        req = urllib.request.Request(
-            f"{self.base_url}/prefill", data=body,
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-            return KVHandoff.from_bytes(resp.read())
+        return KVHandoff.from_bytes(self._post(
+            "/prefill", body, "application/json",
+            tenant=request.tenant, traceparent=request.traceparent))
 
     def queue_depth(self) -> float:
         try:
@@ -269,11 +326,17 @@ class RouterConfig:
 
 class Router:
     def __init__(self, replicas, config: RouterConfig | None = None,
-                 prefill_replicas=(), registry: Registry | None = None):
+                 prefill_replicas=(), registry: Registry | None = None,
+                 tracer=None):
         self.replicas = list(replicas)
         self.prefill_replicas = list(prefill_replicas)
         self.config = config or RouterConfig()
         self.registry = registry if registry is not None else Registry()
+        # the router's span ring: every routed request opens a
+        # router.request root, every replica hop a router.call child
+        # whose traceparent() rides the outbound headers
+        self.tracer = tracer if tracer is not None else (
+            tracing.get() if tracing.enabled() else None)
         # last-known health, refreshed by probe(); a failed call marks
         # the replica down immediately without waiting for a probe
         self._up: dict[str, bool] = {r.name: True for r in self.replicas}
@@ -285,6 +348,14 @@ class Router:
         self._retries = reg.counter(
             "m2kt_router_retries_total", "Requests retried on another "
             "replica after a failure")
+        self._retry_reasons = reg.counter(
+            "m2kt_router_retries_by_reason_total",
+            "Retries by the failure reason that triggered them",
+            labels=("reason",))
+        self._markdowns = reg.counter(
+            "m2kt_router_marked_down_total",
+            "Replicas marked down, by replica and failure reason",
+            labels=("replica", "reason"))
         self._hedges = reg.counter(
             "m2kt_router_hedges_total", "Duplicate requests fired at the "
             "runner-up after the hedge deadline")
@@ -349,38 +420,70 @@ class Router:
         self._spills.inc()
         return min(healthy, key=lambda r: r.queue_depth())
 
-    def _mark_down(self, replica: ReplicaHandle) -> None:
+    def _mark_down(self, replica: ReplicaHandle,
+                   reason: str = "probe") -> None:
         self._up[replica.name] = False
         self._replica_up.labels(replica=replica.name).set(0.0)
+        self._markdowns.labels(replica=replica.name, reason=reason).inc()
 
     # ------------------------------------------------------------------
     # request path
     # ------------------------------------------------------------------
 
+    def _open_call(self, root, replica: ReplicaHandle, hop: str):
+        """Open a ``router.call`` child span for one replica hop and
+        return ``(span, traceparent_header)`` — the header is what rides
+        the outbound request so the replica's root parents under it."""
+        if self.tracer is None or root is None:
+            return None, ""
+        span = self.tracer.start(
+            "router.call",
+            attrs={"replica": getattr(replica, "name", hop), "hop": hop},
+            parent=root, detached=True)
+        return span, span.traceparent()
+
     def generate(self, prompt, max_new_tokens: int | None = None,
-                 rid: str | None = None) -> dict:
+                 rid: str | None = None, tenant: str = "",
+                 traceparent: str | None = None) -> dict:
         prompt = list(prompt)
+        tenant = clean_tenant(tenant)
         self._inflight.inc()
+        root = None
+        if self.tracer is not None:
+            # many requests route concurrently in one process: the root
+            # is detached and identity threads through explicitly. An
+            # inbound traceparent (a client already tracing) wins.
+            root = self.tracer.start(
+                "router.request",
+                attrs={"prompt_len": len(prompt), "tenant": tenant},
+                detached=True, remote_parent=traceparent)
         try:
             if (self.config.disagg_threshold
                     and len(prompt) >= self.config.disagg_threshold
                     and self.prefill_replicas):
                 try:
-                    out = self._generate_disagg(prompt, max_new_tokens, rid)
+                    out = self._generate_disagg(prompt, max_new_tokens,
+                                                rid, tenant, root)
                     self._requests.labels(outcome="ok").inc()
                     return out
                 except Exception:  # noqa: BLE001 - fall back to direct path
                     pass
-            out = self._generate_direct(prompt, max_new_tokens, rid)
+            out = self._generate_direct(prompt, max_new_tokens, rid,
+                                        tenant, root)
             self._requests.labels(outcome="ok").inc()
             return out
-        except Exception:
+        except Exception as err:
             self._requests.labels(outcome="error").inc()
+            if root is not None:
+                root.attrs["error"] = failure_reason(err)
             raise
         finally:
+            if root is not None:
+                self.tracer.end(root)
             self._inflight.dec()
 
-    def _generate_direct(self, prompt, max_new_tokens, rid) -> dict:
+    def _generate_direct(self, prompt, max_new_tokens, rid, tenant="",
+                         root=None) -> dict:
         tried: list[ReplicaHandle] = []
         last_err: Exception | None = None
         for attempt in range(self.config.max_retries + 1):
@@ -389,21 +492,40 @@ class Router:
                 break
             if attempt:
                 self._retries.inc()
+                if last_err is not None:
+                    self._retry_reasons.labels(
+                        failure_reason(last_err)).inc()
             tried.append(replica)
             try:
                 if self.config.hedge_after_s is not None:
                     return self._call_hedged(replica, prompt,
-                                             max_new_tokens, rid, tried)
-                return replica.generate(prompt, max_new_tokens, rid)
+                                             max_new_tokens, rid, tried,
+                                             tenant, root)
+                return self._call_one(replica, prompt, max_new_tokens,
+                                      rid, tenant, root)
             except Exception as err:  # noqa: BLE001 - any failure fails over
                 last_err = err
-                self._mark_down(replica)
+                self._mark_down(replica, failure_reason(err))
         if last_err is not None:
             raise last_err
         raise RuntimeError("router: no healthy replica available")
 
+    def _call_one(self, replica, prompt, max_new_tokens, rid, tenant,
+                  root) -> dict:
+        span, header = self._open_call(root, replica, "generate")
+        try:
+            return replica.generate(prompt, max_new_tokens, rid,
+                                    tenant=tenant, traceparent=header)
+        except Exception as err:  # noqa: BLE001 - annotate, then re-raise
+            if span is not None:
+                span.attrs["error"] = failure_reason(err)
+            raise
+        finally:
+            if span is not None:
+                self.tracer.end(span)
+
     def _call_hedged(self, primary, prompt, max_new_tokens, rid,
-                     tried) -> dict:
+                     tried, tenant="", root=None) -> dict:
         """Fire ``primary``; if it has not answered within the hedge
         deadline, fire the runner-up too and take whichever finishes
         first. The loser's work is wasted by design — hedging trades
@@ -414,7 +536,8 @@ class Router:
 
         def call(replica):
             try:
-                results.append(replica.generate(prompt, max_new_tokens, rid))
+                results.append(self._call_one(
+                    replica, prompt, max_new_tokens, rid, tenant, root))
                 done.set()
             except Exception as err:  # noqa: BLE001 - collected below
                 errors.append(err)
@@ -439,21 +562,39 @@ class Router:
             return results[0]
         raise errors[0] if errors else RuntimeError("hedge: no result")
 
-    def _generate_disagg(self, prompt, max_new_tokens, rid) -> dict:
+    def _generate_disagg(self, prompt, max_new_tokens, rid, tenant="",
+                         root=None) -> dict:
         """Long prompts route prefill->decode: round-robin a prefill
         replica for the KV handoff, then seat it on the prefix-affine
         decode replica (same placement as the direct path, so the
-        decode side's cache locality is preserved)."""
+        decode side's cache locality is preserved). Both hops get their
+        own router.call span; the handoff wire carries the install
+        hop's traceparent so the decode replica's root stitches under
+        it even when the bytes travel through a queue."""
         prefill = self.prefill_replicas[self._rr
                                         % len(self.prefill_replicas)]
         self._rr += 1
-        handoff = prefill.prefill(Request(
-            rid=rid or f"disagg-{self._rr}", prompt=list(prompt),
-            max_new_tokens=max_new_tokens))
+        pspan, pheader = self._open_call(root, prefill, "prefill")
+        try:
+            handoff = prefill.prefill(Request(
+                rid=rid or f"disagg-{self._rr}", prompt=list(prompt),
+                max_new_tokens=max_new_tokens, tenant=tenant,
+                traceparent=pheader))
+        finally:
+            if pspan is not None:
+                self.tracer.end(pspan)
         decode = self.pick(prompt)
         if decode is None:
             raise RuntimeError("router: no healthy decode replica")
-        out = decode.install(handoff.to_bytes())
+        dspan, dheader = self._open_call(root, decode, "install")
+        handoff.tenant = tenant
+        handoff.traceparent = dheader
+        try:
+            out = decode.install(handoff.to_bytes(), tenant=tenant,
+                                 traceparent=dheader)
+        finally:
+            if dspan is not None:
+                self.tracer.end(dspan)
         self._disagg.inc()
         return out
 
@@ -508,7 +649,10 @@ class RouterHTTPServer:
                         payload["prompt"],
                         payload.get("max_new_tokens",
                                     outer.default_max_new),
-                        payload.get("rid"))
+                        payload.get("rid"),
+                        tenant=self.headers.get(TENANT_HEADER, ""),
+                        traceparent=self.headers.get(
+                            TRACEPARENT_HEADER))
                     self._send(200, json.dumps(out).encode())
                 except Exception as err:  # noqa: BLE001 - surface as 500
                     self._send(500, json.dumps(

@@ -417,7 +417,8 @@ class FakeReplica(ReplicaHandle):
         self.hold_s = 0.0
         self.up = True
 
-    def generate(self, prompt, max_new_tokens=None, rid=None):
+    def generate(self, prompt, max_new_tokens=None, rid=None,
+                 tenant="", traceparent=""):
         if self.fail_next > 0:
             self.fail_next -= 1
             raise RuntimeError(f"{self.name}: injected failure")

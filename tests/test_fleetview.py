@@ -1,0 +1,620 @@
+"""Fleet trace plane + per-tenant SLO plane: traceparent propagation,
+cross-role trace stitching with exact latency decomposition, bounded
+tenant cardinality, burn-rate goldens, and the SLO alert/Helm contract.
+
+The decomposition tests are the acceptance invariant of the PR: the
+router-observed e2e must EXACTLY (to float rounding) equal the sum of
+its decomposed parts — child spans, synthesized network hops, and local
+idle gaps — even when the replica's clock is skewed by whole seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from move2kube_tpu.apiresource.base import convert_objects
+from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+from move2kube_tpu.obs.fleetview import SYNTH_HOP, FleetTraceCollector
+from move2kube_tpu.obs.metrics import OVERFLOW_LABEL, Registry
+from move2kube_tpu.obs.rules import THRESHOLDS
+from move2kube_tpu.obs.server import TelemetryServer
+from move2kube_tpu.obs.slo import (
+    TENANT_HEADER,
+    SLOSpec,
+    SLOTracker,
+    clean_tenant,
+)
+from move2kube_tpu.obs.tracing import (
+    TRACEPARENT_HEADER,
+    SpanRecorder,
+    parse_traceparent,
+)
+from move2kube_tpu.passes.optimize import (
+    tpu_observability_optimizer,
+    tpu_slo_optimizer,
+)
+from move2kube_tpu.passes.parameterize import (
+    tpu_rules_parameterizer,
+    tpu_slo_parameterizer,
+)
+from move2kube_tpu.qa import engine as qaengine
+from move2kube_tpu.serving.fleet.router import (
+    HttpReplica,
+    ReplicaHTTPError,
+    Router,
+    RouterConfig,
+    failure_reason,
+)
+from move2kube_tpu.types.ir import IR, Service
+from move2kube_tpu.types.plan import AcceleratorInfo
+
+
+# ----------------------------------------------------------------------
+# traceparent round-trip
+# ----------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    rec = SpanRecorder(role="router")
+    span = rec.start("router.request", detached=True)
+    header = span.traceparent()
+    assert re.fullmatch(r"00-[0-9a-f]{32}-[0-9a-f]{16}-01", header)
+    assert parse_traceparent(header) == (span.trace_id, span.span_id)
+    rec.end(span)
+
+
+@pytest.mark.parametrize("bad", [
+    None,
+    "",
+    "garbage",
+    "00-abc-def-01",                                   # short ids
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",         # reserved version
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",         # zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",         # zero span id
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",         # non-hex
+    "00-" + "a" * 32 + "-" + "b" * 16,                 # missing flags
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-1",          # short flags
+])
+def test_traceparent_rejects_malformed(bad):
+    assert parse_traceparent(bad) is None
+
+
+def test_remote_parent_wins_over_local_context():
+    """A valid remote traceparent must graft the span into the remote
+    trace even when a local span is current — that is the cross-process
+    stitching contract (the replica's serve.request parents under the
+    router's router.call, never under replica-local housekeeping)."""
+    rec = SpanRecorder(role="decode")
+    header = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    with rec.span("local.busywork"):
+        child = rec.start("serve.request", detached=True,
+                          remote_parent=header)
+    assert child.trace_id == "ab" * 16
+    assert child.parent_id == "cd" * 8
+    rec.end(child)
+    # malformed header degrades to a fresh root, never raises
+    orphan = rec.start("serve.request", detached=True,
+                       remote_parent="not-a-header")
+    assert orphan.parent_id == ""
+    assert orphan.trace_id != "ab" * 16
+    rec.end(orphan)
+
+
+# ----------------------------------------------------------------------
+# router -> HttpReplica -> engine hop (real HTTP, one process)
+# ----------------------------------------------------------------------
+
+
+class _StubDecodeServer:
+    """A stdlib stand-in for the emitted decode pod: extracts the tenant
+    and traceparent headers exactly as the serve template does, records
+    a ``serve.request`` span on its own decode-role recorder, and
+    answers the generate JSON the router expects."""
+
+    def __init__(self, fail_status: int = 0):
+        self.tracer = SpanRecorder(role="decode")
+        self.seen: list[dict] = []
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                tenant = self.headers.get(TENANT_HEADER, "")
+                header = self.headers.get(TRACEPARENT_HEADER, "")
+                stub.seen.append({"path": self.path, "tenant": tenant,
+                                  "traceparent": header})
+                if fail_status:
+                    self.send_response(fail_status)
+                    self.end_headers()
+                    self.wfile.write(b"kv cache exhausted")
+                    return
+                span = stub.tracer.start(
+                    "serve.request", attrs={"tenant": tenant or "default"},
+                    detached=True, remote_parent=header or None)
+                json.loads(body.decode())
+                stub.tracer.end(span)
+                out = json.dumps({"rid": "r", "tokens": [1, 2],
+                                  "text": "", "finish_reason": "stop"})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(out.encode())
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def test_http_hop_shares_trace_id_and_tenant_header():
+    stub = _StubDecodeServer()
+    router_tracer = SpanRecorder(role="router")
+    try:
+        rep = HttpReplica("decode-0", f"http://127.0.0.1:{stub.port}")
+        router = Router([rep], config=RouterConfig(),
+                        tracer=router_tracer)
+        out = router.generate([1, 2, 3], max_new_tokens=2, tenant="acme")
+        assert out["finish_reason"] == "stop"
+    finally:
+        stub.close()
+
+    [seen] = stub.seen
+    assert seen["tenant"] == "acme"
+    parsed = parse_traceparent(seen["traceparent"])
+    assert parsed is not None
+
+    # stitch the two rings: one trace spans both roles, the replica's
+    # serve.request parents under the router's call span, and the
+    # collector synthesizes the wire hops on that edge
+    col = FleetTraceCollector()
+    docs = [router_tracer.ring_doc(), stub.tracer.ring_doc()]
+    merged = col.stitch(docs)
+    [root] = [s for s in merged["spans"]
+              if s["name"] == "router.request"]
+    trace = merged["traces"][root["trace_id"]]
+    names = {s["name"] for s in trace}
+    assert {"router.request", "router.call", "serve.request",
+            SYNTH_HOP} <= names
+    [serve] = [s for s in trace if s["name"] == "serve.request"]
+    [call] = [s for s in trace if s["name"] == "router.call"]
+    assert serve["trace_id"] == root["trace_id"] == parsed[0]
+    assert serve["parent_id"] == call["span_id"]
+    assert serve["role"] == "decode" and call["role"] == "router"
+
+    d = col.decompose(root["trace_id"], docs=docs)
+    assert abs(d["residual_s"]) < 1e-9
+    assert abs(sum(p["dur_s"] for p in d["parts"]) - d["e2e_s"]) < 1e-9
+    assert {"hop", "remote", "gap"} <= {p["kind"] for p in d["parts"]}
+
+
+def test_http_replica_error_carries_status_and_body():
+    stub = _StubDecodeServer(fail_status=507)
+    try:
+        rep = HttpReplica("decode-0", f"http://127.0.0.1:{stub.port}")
+        with pytest.raises(ReplicaHTTPError) as exc:
+            rep.generate([1, 2, 3], max_new_tokens=2)
+    finally:
+        stub.close()
+    err = exc.value
+    assert err.status == 507
+    assert "kv cache exhausted" in err.body_excerpt
+    assert "decode-0" in str(err) and "507" in str(err)
+    assert failure_reason(err) == "http_507"
+    assert failure_reason(TimeoutError()) == "timeout"
+    assert failure_reason(ConnectionError()) == "connection"
+
+
+def test_traces_endpoint_serves_and_drains_ring():
+    """/traces is the collector's pull surface: it serves the ring doc
+    and ``?clear=1`` drains it — exactly what FleetTraceCollector's URL
+    sources hit."""
+    tracer = SpanRecorder(role="router")
+    tracer.end(tracer.start("router.request", detached=True))
+    srv = TelemetryServer(port=0, registry=Registry(),
+                          tracer=tracer).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/traces", timeout=5) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["role"] == "router"
+        assert [s["name"] for s in doc["spans"]] == ["router.request"]
+
+        # the collector pulls the same doc through its URL-source path
+        [pulled] = FleetTraceCollector(sources=[base]).collect()
+        assert pulled["spans"][0]["name"] == "router.request"
+
+        with urllib.request.urlopen(f"{base}/traces?clear=1",
+                                    timeout=5) as r:
+            json.loads(r.read().decode())
+        with urllib.request.urlopen(f"{base}/traces", timeout=5) as r:
+            assert json.loads(r.read().decode())["spans"] == []
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------------------------
+# collector merge: hand-built docs, clock skew, exact decomposition
+# ----------------------------------------------------------------------
+
+TID = "ab" * 16
+
+
+def _span(name, sid, parent, ts, dur, **attrs):
+    return {"name": name, "trace_id": TID, "span_id": sid,
+            "parent_id": parent, "ts_unix": ts, "dur_s": dur,
+            "in_flight": False, "attrs": dict(attrs)}
+
+
+def _skewed_docs(skew: float):
+    """Router on host-a; replica on host-b whose clock is off by
+    ``skew`` seconds. Ground truth as seen by the router: request runs
+    [1000.0, 1000.030]; its call span runs [1000.002, 1000.022]; the
+    replica really worked 0.012s of that window."""
+    router = {"host": "host-a", "pid": 11, "role": "router", "spans": [
+        _span("router.request", "r1", "", 1000.0, 0.030),
+        _span("router.call", "c1", "r1", 1000.002, 0.020, hop="decode"),
+    ]}
+    replica = {"host": "host-b", "pid": 22, "role": "decode", "spans": [
+        _span("serve.request", "s1", "c1", 1000.004 + skew, 0.012),
+    ]}
+    return [router, replica]
+
+
+@pytest.mark.parametrize("skew", [0.0, 3.7, -12.25])
+def test_stitch_synthesizes_skew_free_hops(skew):
+    col = FleetTraceCollector()
+    merged = col.stitch(_skewed_docs(skew))
+    hops = [s for s in merged["spans"] if s["name"] == SYNTH_HOP]
+    assert len(hops) == 2 and all(s["synthetic"] for s in hops)
+    send = next(s for s in hops if s["attrs"]["direction"] == "send")
+    recv = next(s for s in hops if s["attrs"]["direction"] == "recv")
+    assert send["attrs"]["from_role"] == "router"
+    assert send["attrs"]["to_role"] == "decode"
+    # skew shifts the two gaps in opposite directions; their sum is
+    # skew-free and closes the client span exactly
+    assert send["dur_s"] + recv["dur_s"] + 0.012 == pytest.approx(
+        0.020, abs=1e-12)
+    assert send["dur_s"] == pytest.approx(0.002 + skew, abs=1e-9)
+
+
+@pytest.mark.parametrize("skew", [0.0, 3.7, -12.25])
+def test_decompose_is_exact_under_skew(skew):
+    d = FleetTraceCollector().decompose(TID, docs=_skewed_docs(skew))
+    assert d["e2e_s"] == pytest.approx(0.030, abs=1e-12)
+    assert abs(d["residual_s"]) < 1e-9
+    assert sum(p["dur_s"] for p in d["parts"]) == pytest.approx(
+        d["e2e_s"], abs=1e-9)
+    assert [p["kind"] for p in d["parts"]] == [
+        "gap", "hop", "remote", "hop", "gap"]
+    remote = next(p for p in d["parts"] if p["kind"] == "remote")
+    assert remote["name"] == "serve.request"
+    assert remote["dur_s"] == pytest.approx(0.012, abs=1e-12)
+    # the two local idle gaps are what the router did NOT spend on the
+    # call: 2ms before dispatch, 8ms after the reply
+    gaps = [p["dur_s"] for p in d["parts"] if p["kind"] == "gap"]
+    assert gaps == [pytest.approx(0.002, abs=1e-9),
+                    pytest.approx(0.008, abs=1e-9)]
+
+
+def test_stitch_synthesizes_hops_for_in_process_fleets():
+    """Role is part of the source identity: a test/bench fleet running
+    router and decode recorders under one pid must still get hop
+    synthesis on the cross-role edge."""
+    docs = _skewed_docs(0.0)
+    for doc in docs:
+        doc["host"], doc["pid"] = "host-a", 11
+    merged = FleetTraceCollector().stitch(docs)
+    assert [s for s in merged["spans"] if s["name"] == SYNTH_HOP]
+
+
+def test_collector_skips_dead_sources():
+    docs = _skewed_docs(0.0)
+    col = FleetTraceCollector(
+        sources=["http://127.0.0.1:1/nope", *docs], timeout_s=0.2)
+    assert len(col.collect()) == 2
+
+
+def test_exports_flag_synthetic_spans():
+    col = FleetTraceCollector()
+    docs = _skewed_docs(3.7)
+    chrome = col.chrome_trace(docs)
+    cats = {e["cat"] for e in chrome["traceEvents"] if e["ph"] == "X"}
+    assert cats == {"m2kt", "m2kt.synthetic"}
+    procs = [e for e in chrome["traceEvents"]
+             if e["name"] == "process_name"]
+    assert {p["args"]["name"] for p in procs} == {
+        "router@host-a", "decode@host-b"}
+    lines = col.otlp_lines(docs)
+    spans = [json.loads(ln)["resourceSpans"][0]["scopeSpans"][0]
+             ["spans"][0] for ln in lines]
+    assert all(re.fullmatch(r"[0-9a-f]{16}", s["spanId"]) or
+               not any(a["key"] == "m2kt.synthetic" and
+                       a["value"]["boolValue"] for a in s["attributes"])
+               for s in spans)
+    synth = [s for s in spans if any(
+        a["key"] == "m2kt.synthetic" and a["value"]["boolValue"]
+        for a in s["attributes"])]
+    assert len(synth) == 2
+    assert all(re.fullmatch(r"[0-9a-f]{16}", s["spanId"]) for s in synth)
+
+
+# ----------------------------------------------------------------------
+# bounded tenant cardinality
+# ----------------------------------------------------------------------
+
+
+def test_registry_caps_label_cardinality_into_other():
+    reg = Registry()
+    c = reg.counter("m2kt_t_total", "h", labels=("tenant",), max_series=2)
+    c.labels("a").inc()
+    c.labels("b").inc()
+    c.labels("mallory-1").inc()
+    c.labels("mallory-2").inc(3)
+    text = reg.render()
+    assert 'm2kt_t_total{tenant="a"} 1' in text
+    assert "mallory" not in text
+    assert f'm2kt_t_total{{tenant="{OVERFLOW_LABEL}"}} 4' in text
+    # capped series stay bounded: re-observing known labels still works
+    c.labels("a").inc()
+    assert 'tenant="a"} 2' in reg.render()
+
+
+def test_slo_tracker_tenant_cap_and_overflow_aggregation():
+    t = [0.0]
+    tr = SLOTracker(spec=SLOSpec(), clock=lambda: t[0], tenant_cap=2)
+    tr.record("acme", ok=True, ttft_s=0.01)
+    tr.record("globex", ok=True, ttft_s=0.02)
+    tr.record("mallory-1", ok=True, ttft_s=9.0)
+    tr.record("mallory-2", ok=True, ttft_s=7.0)
+    assert tr.tenants() == ["acme", "globex", OVERFLOW_LABEL]
+    assert tr.tenant_ttft_p95("acme") == pytest.approx(0.01)
+    # beyond-cap tenants aggregate into the overflow series
+    assert tr.tenant_ttft_p95(OVERFLOW_LABEL) == pytest.approx(9.0)
+
+
+def test_clean_tenant_normalizes_untrusted_header():
+    assert clean_tenant("acme") == "acme"
+    assert clean_tenant("") == "default"
+    assert clean_tenant(None) == "default"
+    assert len(clean_tenant("x" * 200)) <= 64
+
+
+# ----------------------------------------------------------------------
+# burn-rate goldens (injectable clock)
+# ----------------------------------------------------------------------
+
+
+def _tracker():
+    t = [0.0]
+    tr = SLOTracker(spec=SLOSpec(availability=0.99),
+                    clock=lambda: t[0])
+    return t, tr
+
+
+def test_fast_burn_fires_slow_holds():
+    """The paging golden: a sharp recent outage on top of healthy
+    steady-state traffic. Both fast windows (1h/5m) burn far over 14.4x
+    budget, but the slow-short (30m) window is diluted below 6x by the
+    good traffic around it — page, no ticket."""
+    t, tr = _tracker()
+    # an old bad burst: inside the 1h fast-long window, outside 30m
+    t[0] = 21600.0 - 2000.0
+    for _ in range(200):
+        tr.record("acme", ok=False)
+    # healthy steady state, one good request every 2s for the last 30m
+    for i in range(900):
+        t[0] = 21600.0 - 1800.0 + 2.0 * i
+        tr.record("acme", ok=True, ttft_s=0.01)
+    # the recent outage: 30 failures in the last seconds
+    t[0] = 21599.0
+    for _ in range(30):
+        tr.record("acme", ok=False)
+    t[0] = 21600.0
+    fl, fs = tr.spec.fast_windows
+    sl, ss = tr.spec.slow_windows
+    assert tr.burn_rate(fs) > 14.4 and tr.burn_rate(fl) > 14.4
+    assert tr.burn_rate(ss) < 6.0  # slow-short diluted -> no ticket
+    assert tr.fast_burn_firing()
+    assert not tr.slow_burn_firing()
+
+
+def test_fast_burn_holds_without_long_window_confirmation():
+    """The SRE pairing: a blip that only the 5m window sees must not
+    page — the 1h window stays under threshold."""
+    t, tr = _tracker()
+    for i in range(1800):  # 1h of good traffic, one every 2s
+        t[0] = 18000.0 + 2.0 * i
+        tr.record("acme", ok=True, ttft_s=0.01)
+    t[0] = 21599.0
+    for _ in range(30):  # 30 bad: dominates 5m, noise over 1h
+        tr.record("acme", ok=False)
+    t[0] = 21600.0
+    fl, fs = tr.spec.fast_windows
+    assert tr.burn_rate(fs) > 14.4
+    assert tr.burn_rate(fl) < 14.4
+    assert not tr.fast_burn_firing()
+
+
+def test_burn_quiet_when_healthy_and_total_outage_fires_both():
+    t, tr = _tracker()
+    for i in range(100):
+        t[0] = 1.0 * i
+        tr.record("acme", ok=True, ttft_s=0.01)
+    t[0] = 100.0
+    assert tr.burn_rate() == pytest.approx(0.0)
+    assert not tr.fast_burn_firing() and not tr.slow_burn_firing()
+
+    t2, tr2 = _tracker()
+    for i in range(100):
+        t2[0] = 1.0 * i
+        tr2.record("acme", ok=False)
+    t2[0] = 100.0
+    # attainment 0 -> burn = 1/budget = 100x for every window
+    assert tr2.burn_rate() == pytest.approx(100.0)
+    assert tr2.fast_burn_firing() and tr2.slow_burn_firing()
+
+
+def test_latency_misses_burn_budget_not_just_errors():
+    """A request that completes but blows the TTFT target spends error
+    budget — the SLO is attainment of the latency objective, not uptime."""
+    t, tr = _tracker()
+    for i in range(50):
+        t[0] = 1.0 * i
+        tr.record("acme", ok=True,
+                  ttft_s=0.01 if i % 2 else 2.0)  # half miss 0.5s target
+    t[0] = 50.0
+    assert tr.attainment(60.0) == pytest.approx(0.5)
+    assert tr.burn_rate(60.0) == pytest.approx(50.0)
+
+
+def test_window_scale_shrinks_drill_windows():
+    spec = SLOSpec(window_scale=1.0 / 360)
+    assert spec.fast_windows == (10.0, 300.0 / 360)
+    assert spec.slow_windows == (60.0, 5.0)
+    assert SLOSpec().fast_windows == (3600.0, 300.0)
+
+
+def test_slo_gauges_exported():
+    reg = Registry()
+    t = [0.0]
+    tr = SLOTracker(spec=SLOSpec(), registry=reg, clock=lambda: t[0])
+    tr.record("acme", ok=True, ttft_s=0.01)
+    tr.record("acme", ok=False)
+    t[0] = 10.0
+    text = reg.render()
+    for fam in ("m2kt_slo_attainment", "m2kt_slo_burn_rate",
+                "m2kt_slo_fast_burn_firing", "m2kt_slo_error_budget",
+                "m2kt_slo_tenant_ttft_p95_seconds",
+                "m2kt_slo_tenant_attainment"):
+        assert fam in text, fam
+    assert 'window="fast_short"' in text
+    assert 'tenant="acme"' in text
+
+
+# ----------------------------------------------------------------------
+# SLO rule emission + Helm round-trip
+# ----------------------------------------------------------------------
+
+
+class _AnswerEngine(qaengine.Engine):
+    def __init__(self, answers: dict):
+        self.answers = answers
+
+    def fetch_answer(self, problem):
+        if problem.id in self.answers:
+            problem.set_answer(self.answers[problem.id])
+        return problem
+
+
+def _qa(answers: dict | None = None):
+    qaengine.reset_engines()
+    if answers:
+        qaengine.add_engine(_AnswerEngine(answers))
+    qaengine.start_engine(qa_skip=True)
+
+
+def _serving_ir(name="srv"):
+    svc = Service(name=name)
+    svc.accelerator = AcceleratorInfo(
+        gpu_count=4, tpu_accelerator="tpu-v5p-slice",
+        tpu_topology="2x2x1", serving=True, serving_port=8000)
+    svc.containers.append({"name": name, "image": f"r/{name}:latest"})
+    ir = IR(name="p")
+    ir.add_service(svc)
+    return ir, svc
+
+
+def test_slo_burn_rate_alerts_emitted():
+    ir, _ = _serving_ir()
+    _qa({"m2kt.services.srv.obs.rules": True})
+    try:
+        ir = tpu_observability_optimizer(ir)
+        objs = convert_objects(ir, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+    [pr] = [o for o in objs if o.get("kind") == "PrometheusRule"]
+    alerts = {r["alert"]: r for r in pr["spec"]["groups"][0]["rules"]}
+    assert {"M2KTSLOFastBurn", "M2KTSLOSlowBurn",
+            "M2KTSLOTenantTTFTHigh"} <= set(alerts)
+    fast = alerts["M2KTSLOFastBurn"]
+    # multi-window pairing baked into the PromQL, literal threshold
+    assert 'window="fast_long"' in fast["expr"]
+    assert 'window="fast_short"' in fast["expr"]
+    assert " and " in fast["expr"] and "> 14.4" in fast["expr"]
+    assert fast["labels"]["severity"] == "critical"
+    slow = alerts["M2KTSLOSlowBurn"]
+    assert 'window="slow_long"' in slow["expr"] and "> 6" in slow["expr"]
+    assert slow["labels"]["severity"] == "warning"
+    assert ("m2kt_slo_tenant_ttft_p95_seconds"
+            in alerts["M2KTSLOTenantTTFTHigh"]["expr"])
+    assert "> 0.5" in alerts["M2KTSLOTenantTTFTHigh"]["expr"]
+
+
+def test_slo_helm_roundtrip_env_and_alert_share_one_knob():
+    """The full Helm contract: the slo optimizer bakes the QA-answered
+    targets into pod env; the slo parameterizer lifts them into chart
+    values; the rules parameterizer seeds the remaining thresholds; and
+    the emitted PromQL references the SAME ``tpuslottftp95`` value the
+    env does — one ``--set`` retunes runtime target and alert floor."""
+    ir, svc = _serving_ir()
+    _qa({"m2kt.services.srv.obs.rules": True,
+         "m2kt.services.srv.obs.slo.ttftp95": "0.25",
+         "m2kt.services.srv.obs.slo.availability": "0.999",
+         "m2kt.services.srv.obs.slo.maxtenants": "16"})
+    try:
+        ir = tpu_observability_optimizer(ir)
+        ir = tpu_slo_optimizer(ir)
+        env = {e["name"]: e["value"]
+               for e in svc.containers[0]["env"]}
+        assert env["M2KT_SLO_TTFT_P95_S"] == "0.25"
+        assert env["M2KT_SLO_AVAILABILITY"] == "0.999"
+        assert env["M2KT_OBS_MAX_TENANTS"] == "16"
+
+        ir = tpu_slo_parameterizer(ir)
+        ir = tpu_rules_parameterizer(ir)
+        objs = convert_objects(ir, [DeploymentAPIResource()])
+    finally:
+        qaengine.reset_engines()
+
+    gv = ir.values.global_variables
+    # env-derived values win the setdefault: the QA answer, not the
+    # THRESHOLDS literal, seeds tpuslottftp95
+    assert gv["tpuslottftp95"] == "0.25"
+    assert gv["tpusloavailability"] == "0.999"
+    assert gv["tpuslomaxtenants"] == "16"
+    assert gv["tpuslofastburn"] == THRESHOLDS["tpuslofastburn"]
+
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_SLO_TTFT_P95_S"] == "{{ .Values.tpuslottftp95 }}"
+    assert env["M2KT_OBS_MAX_TENANTS"] == "{{ .Values.tpuslomaxtenants }}"
+
+    [pr] = [o for o in objs if o.get("kind") == "PrometheusRule"]
+    alerts = {r["alert"]: r for r in pr["spec"]["groups"][0]["rules"]}
+    assert ("> {{ .Values.tpuslofastburn }}"
+            in alerts["M2KTSLOFastBurn"]["expr"])
+    assert ("> {{ .Values.tpuslottftp95 }}"
+            in alerts["M2KTSLOTenantTTFTHigh"]["expr"])
+
+
+def test_slo_parameterizer_skips_training_services():
+    ir, svc = _serving_ir()
+    svc.accelerator.serving = False
+    svc.containers[0]["env"] = [
+        {"name": "M2KT_SLO_TTFT_P95_S", "value": "0.5"}]
+    ir = tpu_slo_parameterizer(ir)
+    assert "tpuslottftp95" not in ir.values.global_variables
+    assert svc.containers[0]["env"][0]["value"] == "0.5"
