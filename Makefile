@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke slo-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke slo-smoke chaos-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -166,6 +166,14 @@ kernel-smoke:
 slo-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_fleetview.py -q
 	$(CPU_ENV) $(PY) bench.py --model fleet
+
+# serving fault tolerance in isolation (all CPU-mode): chaos injectors,
+# token-exact mid-stream resume, drain + deadline shedding, and the
+# bench chaos phase (kill a replica mid-stream, drain another —
+# recovery must be token-identical and within the latency budget)
+chaos-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_chaos.py -q
+	$(CPU_ENV) $(PY) bench.py --model chaos
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -67,7 +67,8 @@ WARM_FAST_S = float(os.environ.get("M2KT_BENCH_WARM_FAST_S", "3.0"))
 MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
 PHASES = ("resnet", "bert", "pallas", "llama", "translate", "goodput",
-          "scaling", "serving", "fleet", "quant", "kernels", "obs")
+          "scaling", "serving", "fleet", "quant", "kernels", "obs",
+          "chaos")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
@@ -83,6 +84,7 @@ PHASE_METRICS = {
     "quant": ("int8_decode_speedup_vs_fp32", "x"),
     "kernels": ("fused_paged_decode_speedup_vs_ref", "x"),
     "obs": ("telemetry_overhead_fraction", "fraction"),
+    "chaos": ("chaos_recovered_token_exact_fraction", "fraction"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
@@ -1252,6 +1254,220 @@ def run_fleet_probe() -> int:
     return 0
 
 
+def bench_chaos(n: int) -> dict:
+    """Serving-fleet fault-tolerance phase on forced host devices: a
+    zipfian replay through the router while a chaos injector kills one
+    replica mid-stream (at an exact token) and another replica is
+    gracefully drained mid-replay. The phase FAILS unless ZERO requests
+    are lost, every completion is token-identical to an uninterrupted
+    golden replay (greedy decode + journal resume => byte-exact), at
+    least one request was resumed, the drained replica emptied cleanly,
+    the deadline-shed drill rejected an unmeetable request, and the
+    faulted replay's p95 latency stayed within the recovery budget
+    (M2KT_BENCH_CHAOS_LAT_BUDGET x the golden p95). Own subprocess for
+    the same reason as the other serving phases: the probe must own
+    jax's platform env before import."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--chaos-probe"],
+        env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"chaos probe rc={res.returncode}: {res.stderr[-300:]}")
+    probe = json.loads(res.stdout.strip().splitlines()[-1])
+    dt = time.perf_counter() - t0
+    print(f"[bench] chaos x{probe['replicas']}: killed "
+          f"{probe['victim']} at token {probe['kill_token']}, drained "
+          f"{probe['drained']} (clean={probe['drain_clean']}); "
+          f"{probe['resumed_total']} resumed, token-exact fraction "
+          f"{probe['recovered_token_exact_fraction']:.3f}, p95 "
+          f"{probe['chaos_p95_ms']:.1f}ms vs golden "
+          f"{probe['golden_p95_ms']:.1f}ms "
+          f"(x{probe['latency_ratio']:.2f} <= "
+          f"x{probe['latency_budget']:.1f}), deadline sheds "
+          f"{probe['deadline_shed_total']} in {dt:.1f}s",
+          file=sys.stderr)
+    metric, unit = PHASE_METRICS["chaos"]
+    return {"phase": "chaos", "metric": metric,
+            "value": probe["recovered_token_exact_fraction"], "unit": unit,
+            "vs_baseline": 0.0, "baseline": "none_published",
+            "replicas": probe["replicas"],
+            "requests": probe["requests"],
+            "kill_token": probe["kill_token"],
+            "victim": probe["victim"],
+            "drained": probe["drained"],
+            "drain_clean": probe["drain_clean"],
+            "resumed_total": probe["resumed_total"],
+            "deadline_shed_total": probe["deadline_shed_total"],
+            "golden_p95_ms": probe["golden_p95_ms"],
+            "chaos_p95_ms": probe["chaos_p95_ms"],
+            "latency_ratio": probe["latency_ratio"],
+            "latency_budget": probe["latency_budget"],
+            "wall_s": round(dt, 2)}
+
+
+def run_chaos_probe() -> int:
+    """In-process half of the chaos phase (spawned by bench_chaos with
+    jax forced onto host devices). Golden replay on an unfaulted fleet,
+    then the same stream against a fleet where one replica dies at an
+    exact mid-stream token (exactly-once, marker-gated) and another is
+    drained halfway through; asserts nothing is lost, every stream is
+    token-identical, and the deadline plane sheds an unmeetable
+    request. Prints one JSON line."""
+    import dataclasses
+    import re
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.serving.engine import DeadlineExceeded, EngineConfig
+    from move2kube_tpu.serving.fleet.chaos import ChaosConfig, ServingChaos
+    from move2kube_tpu.serving.fleet.router import build_fleet
+
+    n_replicas = int(os.environ.get("M2KT_BENCH_CHAOS_REPLICAS", "3"))
+    n_tenants = int(os.environ.get("M2KT_BENCH_CHAOS_TENANTS", "4"))
+    n_requests = int(os.environ.get("M2KT_BENCH_CHAOS_REQUESTS", "20"))
+    kill_at = int(os.environ.get("M2KT_BENCH_CHAOS_KILL_TOKEN", "4"))
+    max_new = 8
+    budget = float(os.environ.get("M2KT_BENCH_CHAOS_LAT_BUDGET", "5.0"))
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    ecfg = EngineConfig(max_batch=2, max_seq=128, block_size=8,
+                        buckets=(64,), prefix_cache=True)
+
+    rng = np.random.default_rng(11)
+    prefixes = [rng.integers(1, cfg.vocab_size, size=40).tolist()
+                for _ in range(n_tenants)]
+    tenant_ids = np.minimum(rng.zipf(1.6, size=n_requests), n_tenants) - 1
+    prompts = [prefixes[t] + rng.integers(1, cfg.vocab_size,
+                                          size=2).tolist()
+               for t in tenant_ids]
+
+    def replay(router, on_index=None):
+        tokens, lat_ms = [], []
+        for i, (p, tid) in enumerate(zip(prompts, tenant_ids)):
+            if on_index is not None:
+                on_index(i)
+            t = time.perf_counter()
+            out = router.generate(list(p), max_new_tokens=max_new,
+                                  tenant=f"tenant-{tid}")
+            lat_ms.append((time.perf_counter() - t) * 1e3)
+            tokens.append(list(out["tokens"]))
+        return tokens, lat_ms
+
+    def warm(router):
+        # every replica compiles its prefill/decode executables before
+        # the replay (a failover or spill can land anywhere), so the
+        # faulted pass measures recovery, not first-touch compilation
+        for rep in router.replicas:
+            rep.generate(prompts[0][:10], max_new_tokens=4)
+
+    # golden: the uninterrupted fleet's per-request token streams
+    router_g = build_fleet(model, variables, n_replicas,
+                           engine_config=ecfg)
+    try:
+        warm(router_g)
+        golden, golden_lat = replay(router_g)
+    finally:
+        for rep in router_g.replicas:
+            rep.close()
+
+    # faulted fleet: same stream, one replica killed at a mid-stream
+    # token (the affine owner of the hottest tenant, so the kill lands
+    # on real traffic), another drained halfway through the replay
+    router_c = build_fleet(model, variables, n_replicas,
+                           engine_config=ecfg)
+    marker = os.path.join(tempfile.mkdtemp(prefix="m2kt-chaos-"),
+                          "fired")
+    try:
+        warm(router_c)
+        victim = router_c.pick(prompts[0])
+        victim.chaos = ServingChaos(
+            ChaosConfig(kill_token=kill_at, marker=marker))
+        drained = next(r for r in router_c.replicas
+                       if r.name != victim.name)
+        drain_state = {}
+
+        def on_index(i):
+            if i == n_requests // 2 and "clean" not in drain_state:
+                drain_state["clean"] = drained.drain(grace_s=10.0)
+
+        chaos, chaos_lat = replay(router_c, on_index)
+        assert not drained.healthy(), "drained replica still in the ring"
+
+        # zero lost + token-exact: every request completed, and every
+        # stream (including the resumed one) matches the golden replay
+        assert len(chaos) == n_requests, "requests were lost under chaos"
+        exact = sum(1 for a, b in zip(chaos, golden) if a == b)
+        frac = exact / n_requests
+        assert frac == 1.0, (
+            f"only {exact}/{n_requests} streams token-identical after "
+            f"kill+drain")
+        assert os.path.exists(marker), "the kill never fired"
+
+        text = router_c.registry.render()
+        resumed = sum(
+            float(m.group(1)) for m in re.finditer(
+                r"m2kt_router_resumed_total\{[^}]*\} ([0-9.e+-]+)", text))
+        assert resumed >= 1, "no request was resumed mid-stream"
+
+        # deadline plane: an unmeetable budget is shed at admission,
+        # not timed out slowly
+        shed_err = None
+        try:
+            router_c.generate(list(prompts[0]), max_new_tokens=max_new,
+                              deadline_s=1e-4)
+        except DeadlineExceeded as err:
+            shed_err = err
+        assert shed_err is not None, "unmeetable deadline was not shed"
+        sheds = sum(
+            float(m.group(1)) for rep in router_c.replicas
+            for m in re.finditer(
+                r"m2kt_serve_deadline_shed_total\{[^}]*\} ([0-9.e+-]+)",
+                rep.engine.registry.render()))
+        assert sheds >= 1, "deadline shed left no counter trace"
+
+        golden_p95 = float(np.percentile(golden_lat, 95))
+        chaos_p95 = float(np.percentile(chaos_lat, 95))
+        ratio = chaos_p95 / max(1e-9, golden_p95)
+        assert ratio <= budget, (
+            f"recovery blew the latency budget: p95 {chaos_p95:.1f}ms vs "
+            f"golden {golden_p95:.1f}ms (x{ratio:.2f} > x{budget})")
+    finally:
+        for rep in router_c.replicas:
+            rep.close()
+
+    print(json.dumps({
+        "replicas": n_replicas, "requests": n_requests,
+        "kill_token": kill_at, "victim": victim.name,
+        "drained": drained.name,
+        "drain_clean": bool(drain_state.get("clean")),
+        "resumed_total": int(resumed),
+        "deadline_shed_total": int(sheds),
+        "recovered_token_exact_fraction": round(frac, 3),
+        "golden_p95_ms": round(golden_p95, 3),
+        "chaos_p95_ms": round(chaos_p95, 3),
+        "latency_ratio": round(ratio, 3),
+        "latency_budget": budget,
+    }), flush=True)
+    return 0
+
+
 def bench_quant(n: int) -> dict:
     """Low-precision serving phase on forced host devices: the serving
     probe's mixed-length stream decoded at fp32, int8 weights, int8
@@ -1907,7 +2123,8 @@ def run_child(phases: list[str]) -> int:
            "translate": bench_translate, "goodput": bench_goodput,
            "scaling": bench_scaling, "serving": bench_serving,
            "fleet": bench_fleet, "quant": bench_quant,
-           "kernels": bench_kernels, "obs": bench_obs}
+           "kernels": bench_kernels, "obs": bench_obs,
+           "chaos": bench_chaos}
     ok = True
     for phase in phases:
         try:
@@ -2231,7 +2448,13 @@ def main() -> int:
     parser.add_argument("--obs-probe", action="store_true",
                         help="internal: telemetry overhead + exposition "
                              "scrape measurement (spawned by the obs phase)")
+    parser.add_argument("--chaos-probe", action="store_true",
+                        help="internal: kill/drain/deadline fault drill "
+                             "with token-exact recovery gates (spawned by "
+                             "the chaos phase)")
     args = parser.parse_args()
+    if args.chaos_probe:
+        return run_chaos_probe()
     if args.scaling_probe:
         return run_scaling_probe()
     if args.serving_probe:

@@ -272,7 +272,7 @@ class DeploymentAPIResource(APIResource):
             # fleet-mode serving fans out into per-role workloads
             # (router / prefill / decode) instead of one Deployment;
             # podmonitor/rules/coord objects ride along either way
-            fleet = fleet_wiring.maybe_fleet_objects(self, svc)
+            fleet = fleet_wiring.maybe_fleet_objects(self, svc, ir)
             if fleet is not None:
                 objs.extend(fleet)
             else:

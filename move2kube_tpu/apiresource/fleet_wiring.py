@@ -112,6 +112,43 @@ def fleet_knobs(svc_name: str) -> dict | None:
         counts[key] = value
     counts["routers"] = max(1, counts["routers"])
     counts["decode"] = max(1, counts["decode"])
+    # resilience knobs: the request deadline every hop inherits (router
+    # -> replica -> engine admission), the drain grace the preStop hook
+    # and SIGTERM handler honor, and the PodDisruptionBudget floor
+    for key, env_var, qid, desc, default in (
+        ("deadline", "M2KT_DEADLINE_S", "serve.fleet.deadline",
+         "End-to-end request deadline (seconds) for [{name}]'s fleet "
+         "(0 = none)", "120"),
+        ("draingrace", "M2KT_DRAIN_GRACE_S", "serve.fleet.draingrace",
+         "Graceful-drain budget (seconds) for [{name}]'s replicas",
+         "30"),
+    ):
+        raw = os.environ.get(env_var, "")
+        if not raw:
+            raw = str(qa.fetch_input(
+                f"m2kt.services.{name}.{qid}", desc.format(name=name),
+                [f"override via {env_var}"], default) or default)
+        try:
+            counts[key] = max(0.0, float(raw))
+        except ValueError:
+            log.warning("bad %s %r for %s; using %s", qid, raw, name,
+                        default)
+            counts[key] = float(default)
+    minavail = _int_env("M2KT_FLEET_MIN_AVAILABLE")
+    if minavail is None:
+        answer = qa.fetch_input(
+            f"m2kt.services.{name}.serve.fleet.minavailable",
+            f"PodDisruptionBudget minAvailable per role for [{name}]",
+            ["Floor of pods a voluntary disruption (node drain, upgrade) "
+             "must leave running in each fleet role; override via "
+             "M2KT_FLEET_MIN_AVAILABLE"], "1")
+        try:
+            minavail = max(0, int(answer))
+        except (TypeError, ValueError):
+            log.warning("invalid minavailable answer %r for %s; using 1",
+                        answer, name)
+            minavail = 1
+    counts["minavailable"] = minavail
     salt = os.environ.get("M2KT_FLEET_AFFINITY_SALT", "")
     if not salt:
         salt = str(qa.fetch_input(
@@ -245,6 +282,54 @@ def role_hpa(svc: Service, role: str, replicas: int) -> dict:
     return obj
 
 
+def role_pdb(svc: Service, role: str, selector: dict,
+             min_available) -> dict:
+    """policy/v1 PodDisruptionBudget for one fleet role, so a node drain
+    or upgrade never takes a whole role down at once. ``min_available``
+    is an int, or the ``{{ .Values.tpufleetminavailable }}`` ref when
+    the Helm parameterizer seeded the chart value (PDB minAvailable is
+    an IntOrString field, so the rendered string form is valid)."""
+    name = f"{svc.name}-{role}"
+    obj = make_obj("PodDisruptionBudget", "policy/v1", name,
+                   {ROLE_LABEL: role})
+    obj["spec"] = {
+        "minAvailable": min_available,
+        "selector": {"matchLabels": dict(selector)},
+    }
+    return obj
+
+
+# a serving pod's preStop: POST /drain on the traffic port and block
+# until the replica finished (or gave up on) its in-flight streams —
+# only then does kubelet deliver SIGTERM. stdlib urllib: the serving
+# image carries no curl.
+_DRAIN_PRESTOP = ("import urllib.request\n"
+                  "urllib.request.urlopen(urllib.request.Request("
+                  "'http://127.0.0.1:{port}/drain', data=b''), "
+                  "timeout={timeout})")
+
+
+def drain_pod_hooks(template: dict, role: str, port: int,
+                    grace_s: float) -> None:
+    """Graceful-drain plumbing on a serving pod template: a termination
+    grace period sized to the drain budget (plus margin for the final
+    SIGTERM->exit lap) and, on the engine roles, a preStop hook POSTing
+    /drain so in-flight decode streams finish before kubelet's SIGTERM.
+    The router/prefill roles hold no decode state — their preStop just
+    waits out endpoint-removal propagation."""
+    spec = template.setdefault("spec", {})
+    spec["terminationGracePeriodSeconds"] = int(grace_s) + 15
+    if role == DECODE_ROLE:
+        hook = {"exec": {"command": [
+            "python", "-c",
+            _DRAIN_PRESTOP.format(port=port, timeout=int(grace_s) + 5),
+        ]}}
+    else:
+        hook = {"exec": {"command": ["/bin/sh", "-c", "sleep 5"]}}
+    for c in spec.get("containers", []):
+        c.setdefault("lifecycle", {}).setdefault("preStop", hook)
+
+
 def knative_autoscaling_annotations(role: str, replicas: int) -> dict:
     """Knative revision annotations for one role: the HPA autoscaler
     class pointed at the same serving gauges as the Deployment path's
@@ -262,13 +347,19 @@ def knative_autoscaling_annotations(role: str, replicas: int) -> dict:
     }
 
 
-def maybe_fleet_objects(deployer, svc: Service) -> list[dict] | None:
+def maybe_fleet_objects(deployer, svc: Service,
+                        ir=None) -> list[dict] | None:
     """The Deployment path's fleet fan-out: per-role Deployments (built
     by the caller's ``_create_deployment`` so pod templates, probes and
     scrape annotations stay single-owner), headless role Services for
-    the backend roles, and one HPA per role. Returns None when the
-    service is not a fleet-mode serving service — the caller then emits
-    its usual single workload."""
+    the backend roles, one HPA per role, and one PodDisruptionBudget per
+    role. Returns None when the service is not a fleet-mode serving
+    service — the caller then emits its usual single workload.
+
+    ``ir`` (when given) carries the Helm split contract: if the fleet
+    parameterizer seeded ``tpufleetminavailable`` in
+    ``ir.values.global_variables``, the PDBs bake the ``.Values`` ref so
+    a Helm install retunes the disruption floor without re-emitting."""
     acc = svc.accelerator
     if acc is None or not getattr(acc, "serving", False) or svc.job:
         return None
@@ -281,6 +372,10 @@ def maybe_fleet_objects(deployer, svc: Service) -> list[dict] | None:
         _tpu_resources,
     )
 
+    min_available = int(knobs.get("minavailable", 1))
+    gvs = getattr(getattr(ir, "values", None), "global_variables", {}) or {}
+    if "tpufleetminavailable" in gvs:
+        min_available = "{{ .Values.tpufleetminavailable }}"
     port = _serving_port(svc)
     objs: list[dict] = []
     for role in fleet_roles(knobs):
@@ -296,8 +391,11 @@ def maybe_fleet_objects(deployer, svc: Service) -> list[dict] | None:
             # the router and land on a random engine
             labels[SELECTOR_LABEL] = svc.name
         dep = deployer._create_deployment(clone, labels)
-        dep["spec"]["selector"] = {"matchLabels": {
-            SELECTOR_LABEL: labels[SELECTOR_LABEL], ROLE_LABEL: role}}
+        selector = {SELECTOR_LABEL: labels[SELECTOR_LABEL],
+                    ROLE_LABEL: role}
+        dep["spec"]["selector"] = {"matchLabels": dict(selector)}
+        drain_pod_hooks(dep["spec"]["template"], role, port,
+                        float(knobs.get("draingrace", 30.0)))
         if role == ROUTER_ROLE:
             # no telemetry-port /readyz here (that probe is serving-only
             # and keyed on the accelerator); the router's own HTTP front
@@ -314,6 +412,7 @@ def maybe_fleet_objects(deployer, svc: Service) -> list[dict] | None:
             objs.append(role_headless_service(
                 svc, role, SELECTOR_LABEL, port))
         objs.append(role_hpa(svc, role, clone.replicas))
+        objs.append(role_pdb(svc, role, selector, min_available))
     log.info("%s: fleet mode — %d objects across roles (%s)", svc.name,
              len(objs), ", ".join(fleet_roles(knobs)))
     return objs

@@ -264,6 +264,14 @@ def tpu_fleet_optimizer(ir: IR) -> IR:
             ("M2KT_FLEET_PREFILL", str(knobs["prefill"])),
             ("M2KT_FLEET_DECODE", str(knobs["decode"])),
             ("M2KT_SERVE_PREFIX_CACHE", "1"),
+            # fault-tolerance contract: every hop (router admission,
+            # replica wait, engine shed) derives its budget from this
+            # deadline; the drain grace feeds both the preStop hook and
+            # the in-process SIGTERM handler; min-available feeds the
+            # per-role PodDisruptionBudgets
+            ("M2KT_DEADLINE_S", f"{knobs['deadline']:g}"),
+            ("M2KT_DRAIN_GRACE_S", f"{knobs['draingrace']:g}"),
+            ("M2KT_FLEET_MIN_AVAILABLE", str(knobs["minavailable"])),
         ]
         if knobs.get("salt"):
             entries.append(("M2KT_FLEET_AFFINITY_SALT", str(knobs["salt"])))

@@ -119,7 +119,13 @@ def tpu_fleet_parameterizer(ir: IR) -> IR:
               "M2KT_FLEET_ROUTERS": "tpufleetrouters",
               "M2KT_FLEET_PREFILL": "tpufleetprefill",
               "M2KT_FLEET_DECODE": "tpufleetdecode",
-              "M2KT_FLEET_AFFINITY_SALT": "tpufleetsalt"}
+              "M2KT_FLEET_AFFINITY_SALT": "tpufleetsalt",
+              # resilience knobs (split contract with fleet_wiring's PDB
+              # emitter: seeding tpufleetminavailable here makes the
+              # PodDisruptionBudgets bake the .Values ref)
+              "M2KT_DEADLINE_S": "tpufleetdeadline",
+              "M2KT_DRAIN_GRACE_S": "tpufleetdraingrace",
+              "M2KT_FLEET_MIN_AVAILABLE": "tpufleetminavailable"}
     for svc in ir.services.values():
         acc = getattr(svc, "accelerator", None)
         if acc is None or not getattr(acc, "serving", False):

@@ -24,6 +24,12 @@ Knobs (all inert when unset — production pods never set them):
   supervisor's restarted attempt survives. Without a marker the fault
   fires on every attempt (for testing retry exhaustion).
 
+The SERVING fleet has its own injector family built on the same
+exactly-once marker primitive (:func:`_marker_fired`):
+serving/fleet/chaos.py drives kill-replica-at-token-N, KV-handoff
+drop/truncate, slow-replica, and health-flap faults from
+``M2KT_CHAOS_*`` env vars — see :func:`serving_chaos`.
+
 Stdlib-only; vendored into emitted images (where it stays dormant).
 """
 
@@ -96,6 +102,15 @@ def maybe_inject(step: int) -> None:
               f"{step}; DCN peers unreachable", file=sys.stderr, flush=True)
         sys.exit(SLICE_LOST_EXIT_CODE)
     sys.exit(int(os.environ.get("M2KT_FAULT_EXIT_CODE", "1")))
+
+
+def serving_chaos():
+    """The serving-side injector, armed from ``M2KT_CHAOS_*`` env vars
+    (None when nothing is configured). Lazy import: this module stays
+    stdlib-only and importable in contexts that never serve."""
+    from move2kube_tpu.serving.fleet.chaos import maybe_chaos
+
+    return maybe_chaos()
 
 
 # -- checkpoint damage (what a preempted host leaves behind) ----------------

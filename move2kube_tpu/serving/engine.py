@@ -190,6 +190,19 @@ class EngineConfig:
         return cls(**cfg)
 
 
+class DeadlineExceeded(ValueError):
+    """The request's propagated deadline cannot be met: already expired,
+    or provably unmeetable from the engine's own decode-latency history.
+    A ValueError so every existing reject path (HTTP 4xx mapping, router
+    no-retry) treats it as the caller's problem, not the replica's."""
+
+
+class EngineDraining(RuntimeError):
+    """submit() refused because the engine is draining: it finishes its
+    in-flight work but admits nothing new. The caller (router) should
+    re-route, not retry here."""
+
+
 @dataclasses.dataclass
 class Request:
     rid: str
@@ -201,6 +214,12 @@ class Request:
     # W3C traceparent of the caller's span: the engine's serve.request
     # root adopts its trace id so cross-process traces stitch
     traceparent: str = ""
+    # remaining deadline budget (seconds) at submission, carried by the
+    # X-M2KT-Deadline header; None = no deadline. Admission sheds
+    # requests that cannot finish inside it (reject-fast beats
+    # timeout-slow), and queued requests that expire before a slot
+    # frees complete with finish_reason "shed"
+    deadline_s: float | None = None
 
 
 @dataclasses.dataclass
@@ -208,7 +227,7 @@ class Completion:
     rid: str
     prompt_len: int
     tokens: list[int]
-    finish_reason: str  # "eos" | "length"
+    finish_reason: str  # "eos" | "length" | "shed"
 
 
 @dataclasses.dataclass
@@ -296,6 +315,18 @@ class ServingEngine:
         self._prefill_count = 0
         self._submit_ts: dict[str, float] = {}
         self._req_tenant: dict[str, str] = {}
+        # absolute (perf_counter) deadlines for queued requests; a queued
+        # request whose deadline passes before a slot frees is shed at
+        # admission instead of burning a slot on a dead-on-arrival stream
+        self._deadline_abs: dict[str, float] = {}
+        # graceful drain: finish in-flight work, admit nothing new
+        self._draining = False
+        # token-emission hook for the fleet layer: called
+        # ``on_token(rid, token)`` the moment a generated token lands in
+        # its slot, at every emission site (decode step, spec window,
+        # prefill first token, disagg install first token). The router's
+        # journal rides this so a replica death mid-stream loses nothing
+        self.on_token = None
         # per-request distributed traces (admit -> queue-wait -> prefill
         # -> decode steps -> complete); identity is threaded explicitly
         # because many live request traces interleave in one thread
@@ -338,6 +369,11 @@ class ServingEngine:
         self._rejected = reg.counter(
             "m2kt_serve_rejected_total",
             "Requests rejected at submit (too long / empty)")
+        self._deadline_shed = reg.counter(
+            "m2kt_serve_deadline_shed_total",
+            "Requests shed because their propagated deadline is "
+            "expired, unmeetable, or passed while queued",
+            labels=("reason",))
         self._completed = reg.counter(
             "m2kt_serve_completed_total", "Completed sequences by reason",
             labels=("reason",))
@@ -520,6 +556,8 @@ class ServingEngine:
         plen = len(req.prompt)
         max_new = req.max_new_tokens or self.config.max_new_tokens
         tenant = slolib.clean_tenant(req.tenant)
+        if self._draining:
+            raise EngineDraining(f"{req.rid}: engine is draining")
         try:
             if plen < 1:
                 raise ValueError(f"{req.rid}: empty prompt")
@@ -533,11 +571,20 @@ class ServingEngine:
                 raise ValueError(
                     f"{req.rid}: prompt + max_new_tokens = {plen + max_new}"
                     f"{slack} exceeds max_seq {self.cache_cfg.max_seq}")
+            reason = self._deadline_verdict(req.deadline_s, max_new)
+            if reason is not None:
+                self._deadline_shed.labels(reason=reason).inc()
+                raise DeadlineExceeded(
+                    f"{req.rid}: deadline {req.deadline_s:.3f}s {reason} "
+                    f"for {max_new} new tokens")
         except ValueError:
             self._rejected.inc()
             self._tenant_rejected.labels(tenant).inc()
             self.slo.record(tenant, ok=False)
             raise
+        if req.deadline_s is not None:
+            self._deadline_abs[req.rid] = (time.perf_counter()
+                                           + req.deadline_s)
         self._submit_ts[req.rid] = time.perf_counter()
         self._req_tenant[req.rid] = tenant
         if self.tracer is not None:
@@ -551,6 +598,57 @@ class ServingEngine:
                 detached=True, remote_parent=req.traceparent or None)
         self._pending.append(req)
         self._queue_depth.set(len(self._pending))
+
+    def _deadline_verdict(self, deadline_s: float | None,
+                          max_new: int) -> str | None:
+        """Shed reason for a deadline, or None when it is acceptable.
+        "expired" = already past; "unmeetable" = the engine's own
+        observed p50 decode-step latency says ``max_new`` tokens cannot
+        land inside the remaining budget (no history = benefit of the
+        doubt)."""
+        if deadline_s is None:
+            return None
+        if deadline_s <= 0:
+            return "expired"
+        p50 = self._lat_hist.quantile(0.50) if self._lat_hist.count else 0.0
+        if p50 > 0 and max_new * p50 > deadline_s:
+            return "unmeetable"
+        return None
+
+    def drain(self) -> None:
+        """Stop admitting new requests; in-flight work keeps stepping.
+        The caller pumps :meth:`step` until :meth:`has_work` clears."""
+        self._draining = True
+
+    def undrain(self) -> None:
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _shed(self, req: Request, reason: str) -> Completion:
+        """Complete a queued request as shed: counted, SLO-charged, and
+        surfaced as a Completion so no waiter hangs."""
+        self._deadline_shed.labels(reason=reason).inc()
+        tenant = self._req_tenant.pop(req.rid, "default")
+        self.slo.record(tenant, ok=False)
+        self._submit_ts.pop(req.rid, None)
+        self._deadline_abs.pop(req.rid, None)
+        self._completed.labels(reason="shed").inc()
+        if self.tracer is not None:
+            root = self._req_spans.pop(req.rid, None)
+            if root is not None:
+                self.tracer.end(root, attrs={"finish_reason": "shed",
+                                             "shed_reason": reason})
+        self._queue_depth.set(len(self._pending))
+        return Completion(rid=req.rid, prompt_len=len(req.prompt),
+                          tokens=[], finish_reason="shed")
+
+    def _emit_token(self, rid: str, tok: int) -> None:
+        cb = self.on_token
+        if cb is not None:
+            cb(rid, tok)
 
     def has_work(self) -> bool:
         return bool(self._pending) or any(
@@ -613,6 +711,7 @@ class ServingEngine:
                     logits_np[i].copy())
             slot.tokens.append(tok)
             slot.last_token = tok
+            self._emit_token(slot.req.rid, tok)
             if self.tracer is not None:
                 root = self._req_spans.get(slot.req.rid)
                 if root is not None:
@@ -713,6 +812,7 @@ class ServingEngine:
                         logits_np[i, f + m].copy())
                 slot.tokens.append(tok)
                 produced += 1
+                self._emit_token(slot.req.rid, tok)
                 done = self._finish_reason(slot, tok)
                 if done:
                     slot.last_token = tok
@@ -773,6 +873,7 @@ class ServingEngine:
         self._slots[slot_idx] = None
         self._completed.labels(reason=reason).inc()
         self._req_tenant.pop(slot.req.rid, None)
+        self._deadline_abs.pop(slot.req.rid, None)
         if self.tracer is not None:
             root = self._req_spans.pop(slot.req.rid, None)
             if root is not None:
@@ -805,10 +906,16 @@ class ServingEngine:
     def _admit_one(self) -> tuple[bool, list[Completion]]:
         if not self._pending:
             return False, []
+        req = self._pending[0]
+        dl = self._deadline_abs.get(req.rid)
+        if dl is not None and time.perf_counter() > dl:
+            # expired while queued: sheds even with no free slot, so a
+            # saturated engine still rejects dead-on-arrival work fast
+            self._pending.popleft()
+            return True, [self._shed(req, "queued_expired")]
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free:
             return False, []
-        req = self._pending[0]
         plen = len(req.prompt)
         max_new = req.max_new_tokens or self.config.max_new_tokens
         hit = self._try_prefix_hit(req, plen)
@@ -977,6 +1084,7 @@ class ServingEngine:
         slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
                      max_new=max_new)
         self._slots[slot_idx] = slot
+        self._emit_token(req.rid, tok)
         self._insert_prefix(slot_idx, slot, bt_row, plen, spare)
         done = self._finish_reason(slot, tok)
         if done:
@@ -1042,6 +1150,17 @@ class ServingEngine:
         max_new = req.max_new_tokens or self.config.max_new_tokens
         bucket = int(kvs[0][0].shape[1])
         tenant = slolib.clean_tenant(req.tenant)
+        if self._draining:
+            raise EngineDraining(f"{req.rid}: engine is draining")
+        reason = self._deadline_verdict(req.deadline_s, max_new)
+        if reason is not None:
+            self._deadline_shed.labels(reason=reason).inc()
+            self._rejected.inc()
+            self._tenant_rejected.labels(tenant).inc()
+            self.slo.record(tenant, ok=False)
+            raise DeadlineExceeded(
+                f"{req.rid}: handoff deadline {req.deadline_s:.3f}s "
+                f"{reason} for {max_new} new tokens")
         if (plen < 1
                 or plen + max_new + self._spec_slack > self.cache_cfg.max_seq):
             self._rejected.inc()
@@ -1104,6 +1223,7 @@ class ServingEngine:
         slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
                      max_new=max_new)
         self._slots[slot_idx] = slot
+        self._emit_token(req.rid, tok)
         self._update_occupancy()
         done = self._finish_reason(slot, tok)
         if done:

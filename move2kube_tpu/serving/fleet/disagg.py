@@ -75,23 +75,35 @@ class KVHandoff:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "KVHandoff":
-        with np.load(io.BytesIO(data), allow_pickle=False) as z:
-            meta = json.loads(z["meta"].tobytes().decode())
-            if meta.get("v") != _WIRE_VERSION:
-                raise ValueError(
-                    f"KV handoff wire version {meta.get('v')!r}; "
-                    f"this replica speaks {_WIRE_VERSION}")
-            ks, vs = z["k"], z["v"]
-            return cls(
-                rid=meta["rid"], prompt=[int(t) for t in z["prompt"]],
-                prompt_len=int(meta["prompt_len"]),
-                bucket=int(meta["bucket"]),
-                first_token=int(meta["first_token"]),
-                kv=[(ks[i], vs[i]) for i in range(ks.shape[0])],
-                max_new_tokens=meta["max_new_tokens"],
-                # older peers' handoffs simply lack the attribution keys
-                tenant=str(meta.get("tenant", "") or ""),
-                traceparent=str(meta.get("traceparent", "") or ""))
+        """Parse a wire handoff. EVERY malformation — truncated or
+        garbage npz, missing arrays/keys, undecodable meta — surfaces as
+        ``ValueError`` so the ingesting replica answers a clean 4xx
+        instead of crashing its worker thread on a zipfile/OS error
+        (chaos injector: ``M2KT_CHAOS_HANDOFF=truncate``)."""
+        try:
+            with np.load(io.BytesIO(data), allow_pickle=False) as z:
+                meta = json.loads(z["meta"].tobytes().decode())
+                if meta.get("v") != _WIRE_VERSION:
+                    raise ValueError(
+                        f"KV handoff wire version {meta.get('v')!r}; "
+                        f"this replica speaks {_WIRE_VERSION}")
+                ks, vs = z["k"], z["v"]
+                return cls(
+                    rid=str(meta["rid"]),
+                    prompt=[int(t) for t in z["prompt"]],
+                    prompt_len=int(meta["prompt_len"]),
+                    bucket=int(meta["bucket"]),
+                    first_token=int(meta["first_token"]),
+                    kv=[(ks[i], vs[i]) for i in range(ks.shape[0])],
+                    max_new_tokens=meta["max_new_tokens"],
+                    # older peers' handoffs simply lack attribution keys
+                    tenant=str(meta.get("tenant", "") or ""),
+                    traceparent=str(meta.get("traceparent", "") or ""))
+        except ValueError:
+            raise
+        except Exception as err:  # noqa: BLE001 - BadZipFile, KeyError, ...
+            raise ValueError(f"malformed KV handoff: "
+                             f"{type(err).__name__}: {err}") from err
 
     def request(self) -> Request:
         return Request(rid=self.rid, prompt=list(self.prompt),

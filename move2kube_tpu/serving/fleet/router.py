@@ -14,6 +14,21 @@ replica; failures mark the replica down and retry elsewhere (bounded),
 and an optional hedge fires a duplicate to the runner-up when the
 primary sits on a request too long.
 
+Fault tolerance (docs/ARCHITECTURE.md "fleet resilience"): the router
+keeps a per-request **journal** of every token a replica has streamed
+(the engine's ``on_token`` hook feeds it); when a replica dies
+mid-stream the journaled tokens are force-fed as a prompt suffix on a
+surviving replica — greedy decode is deterministic and the prefix
+cache makes the re-prefill cheap — so the resumed stream is
+byte-identical to an uninterrupted run (``m2kt_router_resumed_total``
+counts them by failure reason). Deadlines propagate router -> replica
+-> engine via the ``X-M2KT-Deadline`` header carrying the *remaining*
+budget in seconds (skew-free: recomputed at each hop), and every wait
+in this file derives from it — there are no hard-coded request
+timeouts. Replicas drain gracefully (finish in-flight, refuse new,
+flip ``/readyz``), and readmission probes back off exponentially with
+deterministic jitter so a restarting replica is not thundering-herded.
+
 Everything observable exports as ``m2kt_router_*`` through the PR-5
 registry; the HTTP front serves ``/generate`` plus the standard
 ``/healthz``/``/readyz``/``/metrics`` trio so the emitted router pods
@@ -25,6 +40,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -35,7 +51,33 @@ from move2kube_tpu.obs import tracing
 from move2kube_tpu.obs.metrics import Registry
 from move2kube_tpu.obs.slo import TENANT_HEADER, clean_tenant
 from move2kube_tpu.obs.tracing import TRACEPARENT_HEADER
-from move2kube_tpu.serving.engine import EngineConfig, Request, ServingEngine
+from move2kube_tpu.serving.engine import (
+    DeadlineExceeded,
+    EngineConfig,
+    EngineDraining,
+    Request,
+    ServingEngine,
+)
+
+# remaining deadline budget in seconds (gRPC-style relative value, not a
+# wall-clock timestamp — immune to clock skew between pods); each hop
+# recomputes the remainder before forwarding
+DEADLINE_HEADER = "X-M2KT-Deadline"
+
+
+def probe_timeout_s() -> float:
+    """Health-probe timeout (NOT a request timeout — request waits all
+    derive from the propagated deadline). Probes need their own small
+    bound so a hung replica cannot stall the whole probe sweep."""
+    try:
+        return float(os.environ.get("M2KT_PROBE_TIMEOUT_S", "") or 2.0)
+    except ValueError:
+        return 2.0
+
+
+class ReplicaDraining(RuntimeError):
+    """The replica refused (or abandoned) the request because it is
+    draining. Retryable: the router re-routes to a surviving replica."""
 
 
 class ReplicaHTTPError(RuntimeError):
@@ -58,6 +100,10 @@ def failure_reason(err: Exception) -> str:
     the value the reason-labeled retry/mark-down counters carry."""
     if isinstance(err, ReplicaHTTPError):
         return f"http_{err.status}"
+    if isinstance(err, DeadlineExceeded):
+        return "deadline"
+    if isinstance(err, (ReplicaDraining, EngineDraining)):
+        return "draining"
     if isinstance(err, TimeoutError):
         return "timeout"
     if isinstance(err, (urllib.error.URLError, ConnectionError, OSError)):
@@ -80,13 +126,17 @@ def _rendezvous_score(key: int, name: str) -> int:
 
 
 class ReplicaHandle:
-    """One engine replica as the router sees it."""
+    """One engine replica as the router sees it. ``deadline_s`` is the
+    remaining budget for the call (None = unbounded); ``on_token`` is
+    the router's journal hook — called with each token the moment the
+    engine emits it, so a mid-stream death loses nothing."""
 
     name: str = "replica"
 
     def generate(self, prompt, max_new_tokens: int | None = None,
                  rid: str | None = None, tenant: str = "",
-                 traceparent: str = "") -> dict:
+                 traceparent: str = "", deadline_s: float | None = None,
+                 on_token=None) -> dict:
         raise NotImplementedError
 
     def queue_depth(self) -> float:
@@ -107,12 +157,18 @@ class InProcessReplica(ReplicaHandle):
         self.engine = engine
         self.fail_next = 0
         self.hold_s = 0.0  # artificial service delay, for hedging drills
+        # optional ServingChaos (serving/fleet/chaos.py): hooks into the
+        # token stream / generate entry / health checks for fault drills
+        self.chaos = None
         self._lock = threading.Lock()
         self._waiters: dict[str, tuple[threading.Event, list]] = {}
+        self._token_cbs: dict[str, object] = {}
         self._seq = 0
         self._stop = False
+        self._draining = False
         self._thread: threading.Thread | None = None
         self._up = True
+        engine.on_token = self._on_token
 
     def start(self) -> "InProcessReplica":
         if self._thread is None:
@@ -126,12 +182,40 @@ class InProcessReplica(ReplicaHandle):
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def revive(self) -> "InProcessReplica":
+        """Bring a crashed/closed replica back — the in-process stand-in
+        for a restarted pod: fresh worker thread, same engine."""
+        if self._thread is not None and self._thread.is_alive():
+            self._stop = True
+            self._thread.join(timeout=5)
+        self._thread = None
+        self._stop = False
+        self._draining = False
+        self._up = True
+        self.engine.undrain()
+        return self.start()
+
+    def _on_token(self, rid: str, tok: int) -> None:
+        """Engine token-emission fan-out. The caller's journal callback
+        runs FIRST so a chaos kill-at-token-N still leaves token N in
+        the journal — exactly the state a real mid-stream death leaves."""
+        cb = self._token_cbs.get(rid)
+        if cb is not None:
+            cb(tok)
+        if self.chaos is not None:
+            self.chaos.on_token(self.name, rid, tok)
+
     def _loop(self) -> None:
         while not self._stop:
-            with self._lock:
-                work = self.engine.has_work()
-                done = self.engine.step() if work else []
+            try:
+                with self._lock:
+                    work = self.engine.has_work()
+                    done = self.engine.step() if work else []
+            except Exception as err:  # noqa: BLE001 - replica "process" died
+                self._crash(err)
+                return
             for comp in done:
+                self._token_cbs.pop(comp.rid, None)
                 waiter = self._waiters.pop(comp.rid, None)
                 if waiter is not None:
                     event, box = waiter
@@ -140,21 +224,77 @@ class InProcessReplica(ReplicaHandle):
             if not work:
                 time.sleep(0.002)
 
+    def _crash(self, err: Exception) -> None:
+        """The worker thread died mid-step (the in-process equivalent of
+        a replica pod crashing): go unhealthy and fail every waiter so
+        no caller hangs — the router journals + resumes them."""
+        self._up = False
+        self._stop = True
+        waiters, self._waiters = dict(self._waiters), {}
+        self._token_cbs.clear()
+        for _rid, (event, box) in waiters.items():
+            box.append(err)
+            event.set()
+
     def set_healthy(self, up: bool) -> None:
         self._up = up
 
     def healthy(self) -> bool:
-        return self._up and not self._stop
+        if self.chaos is not None and not self.chaos.on_probe(self.name):
+            return False
+        return self._up and not self._stop and not self._draining
 
     def queue_depth(self) -> float:
         stats = self.engine.stats()
         return float(stats["queue_depth"] + stats["active_slots"])
 
+    def drain(self, grace_s: float = 30.0) -> bool:
+        """Graceful drain: stop admitting, keep decoding until in-flight
+        work finishes or the grace period lapses. Returns True when the
+        replica drained clean. Requests still unfinished at the deadline
+        fail their waiters with :class:`ReplicaDraining`, which the
+        router treats as retryable — so even an ungraceful cutoff loses
+        nothing. ``healthy()`` flips immediately, pulling the replica
+        out of the placement ring."""
+        self._draining = True
+        self.engine.drain()
+        deadline = time.perf_counter() + max(0.0, grace_s)
+        while time.perf_counter() < deadline:
+            if self._stop:
+                break  # crashed mid-drain; _crash already failed waiters
+            with self._lock:
+                busy = self.engine.has_work()
+            if not busy and not self._waiters:
+                break
+            time.sleep(0.002)
+        clean = not self._waiters
+        waiters, self._waiters = dict(self._waiters), {}
+        self._token_cbs.clear()
+        for rid, (event, box) in waiters.items():
+            box.append(ReplicaDraining(
+                f"{self.name}: drained before {rid} finished"))
+            event.set()
+        return clean
+
+    @staticmethod
+    def _result(comp) -> dict:
+        if isinstance(comp, Exception):
+            raise comp
+        if comp.finish_reason == "shed":
+            raise DeadlineExceeded(
+                f"{comp.rid}: shed while queued (deadline expired)")
+        return comp
+
     def generate(self, prompt, max_new_tokens=None, rid=None,
-                 tenant: str = "", traceparent: str = "") -> dict:
+                 tenant: str = "", traceparent: str = "",
+                 deadline_s: float | None = None, on_token=None) -> dict:
         if self.fail_next > 0:
             self.fail_next -= 1
             raise RuntimeError(f"{self.name}: injected failure")
+        if self._draining:
+            raise ReplicaDraining(f"{self.name}: draining, not admitting")
+        if self.chaos is not None:
+            self.chaos.on_generate(self.name, rid or "")
         if self.hold_s:
             time.sleep(self.hold_s)
         self.start()
@@ -163,30 +303,60 @@ class InProcessReplica(ReplicaHandle):
             rid = rid or f"{self.name}-{self._seq}"
             event, box = threading.Event(), []
             self._waiters[rid] = (event, box)
-            self.engine.submit(Request(rid=rid, prompt=list(prompt),
-                                       max_new_tokens=max_new_tokens,
-                                       tenant=tenant,
-                                       traceparent=traceparent))
-        if not event.wait(timeout=120):
+            if on_token is not None:
+                self._token_cbs[rid] = on_token
+            try:
+                self.engine.submit(Request(rid=rid, prompt=list(prompt),
+                                           max_new_tokens=max_new_tokens,
+                                           tenant=tenant,
+                                           traceparent=traceparent,
+                                           deadline_s=deadline_s))
+            except EngineDraining as err:
+                self._waiters.pop(rid, None)
+                self._token_cbs.pop(rid, None)
+                raise ReplicaDraining(str(err)) from err
+            except Exception:
+                self._waiters.pop(rid, None)
+                self._token_cbs.pop(rid, None)
+                raise
+        # the wait derives from the propagated deadline; with none, the
+        # crash/drain paths guarantee the event always fires eventually
+        if not event.wait(timeout=deadline_s):
             self._waiters.pop(rid, None)
-            raise TimeoutError(f"{self.name}: request {rid} timed out")
-        comp = box[0]
+            self._token_cbs.pop(rid, None)
+            raise TimeoutError(
+                f"{self.name}: request {rid} missed its "
+                f"{deadline_s:.3f}s deadline")
+        comp = self._result(box[0])
         return {"rid": comp.rid, "replica": self.name,
                 "prompt_len": comp.prompt_len, "tokens": comp.tokens,
                 "finish_reason": comp.finish_reason}
 
     def install(self, handoff_bytes: bytes, tenant: str = "",
-                traceparent: str = "") -> dict:
+                traceparent: str = "",
+                deadline_s: float | None = None) -> dict:
         """Seat a disagg KV handoff and decode it to completion. The
         handoff wire format already carries tenant/traceparent; the
         kwargs exist for signature parity with :class:`HttpReplica`."""
         from move2kube_tpu.serving.fleet.disagg import KVHandoff
 
+        if self._draining:
+            raise ReplicaDraining(f"{self.name}: draining, not admitting")
+        if self.chaos is not None:
+            handoff_bytes = self.chaos.on_handoff(self.name, handoff_bytes)
         h = KVHandoff.from_bytes(handoff_bytes)
         event, box = threading.Event(), []
         self.start()
         installed = False
+        expires = (time.perf_counter() + deadline_s
+                   if deadline_s is not None else None)
         while not installed:
+            if self._stop:
+                raise ReplicaDraining(f"{self.name}: replica stopped")
+            if expires is not None and time.perf_counter() > expires:
+                raise TimeoutError(
+                    f"{self.name}: handoff {h.rid} missed its "
+                    f"{deadline_s:.3f}s deadline before install")
             with self._lock:
                 ok, done = self.engine.install_prefilled(
                     h.request(), h.kv, h.first_token, h.prompt_len)
@@ -199,10 +369,14 @@ class InProcessReplica(ReplicaHandle):
                         self._waiters[h.rid] = (event, box)
             if not installed:
                 time.sleep(0.002)  # engine full: let the loop drain a step
-        if not event.wait(timeout=120):
+        remaining = (expires - time.perf_counter()
+                     if expires is not None else None)
+        if not event.wait(timeout=remaining):
             self._waiters.pop(h.rid, None)
-            raise TimeoutError(f"{self.name}: handoff {h.rid} timed out")
-        comp = box[0]
+            raise TimeoutError(
+                f"{self.name}: handoff {h.rid} missed its "
+                f"{deadline_s:.3f}s deadline")
+        comp = self._result(box[0])
         return {"rid": comp.rid, "replica": self.name,
                 "prompt_len": comp.prompt_len, "tokens": comp.tokens,
                 "finish_reason": comp.finish_reason}
@@ -214,27 +388,34 @@ class HttpReplica(ReplicaHandle):
     port (obs/server.py)."""
 
     def __init__(self, name: str, base_url: str,
-                 health_url: str | None = None, timeout_s: float = 120.0):
+                 health_url: str | None = None,
+                 timeout_s: float | None = None):
         self.name = name
         self.base_url = base_url.rstrip("/")
         self.health_url = (health_url or base_url).rstrip("/")
+        # fallback socket timeout for deadline-less calls only; every
+        # deadlined call derives its timeout from the remaining budget
         self.timeout_s = timeout_s
 
     def _post(self, path: str, data: bytes, ctype: str,
-              tenant: str = "", traceparent: str = "") -> bytes:
-        """POST with trace/tenant header injection. A non-2xx answer is
-        surfaced as :class:`ReplicaHTTPError` with the status and a body
-        excerpt — urllib's bare ``HTTP Error 500`` hid what the replica
-        actually said."""
+              tenant: str = "", traceparent: str = "",
+              deadline_s: float | None = None) -> bytes:
+        """POST with trace/tenant/deadline header injection. A non-2xx
+        answer is surfaced as :class:`ReplicaHTTPError` with the status
+        and a body excerpt — urllib's bare ``HTTP Error 500`` hid what
+        the replica actually said."""
         headers = {"Content-Type": ctype}
         if tenant:
             headers[TENANT_HEADER] = tenant
         if traceparent:
             headers[TRACEPARENT_HEADER] = traceparent
+        if deadline_s is not None:
+            headers[DEADLINE_HEADER] = f"{deadline_s:.3f}"
+        timeout = deadline_s if deadline_s is not None else self.timeout_s
         req = urllib.request.Request(
             f"{self.base_url}{path}", data=data, headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return resp.read()
         except urllib.error.HTTPError as err:
             try:
@@ -245,19 +426,31 @@ class HttpReplica(ReplicaHandle):
                                    body) from err
 
     def generate(self, prompt, max_new_tokens=None, rid=None,
-                 tenant: str = "", traceparent: str = "") -> dict:
+                 tenant: str = "", traceparent: str = "",
+                 deadline_s: float | None = None, on_token=None) -> dict:
+        # request/response transport: there is no mid-stream token feed,
+        # so ``on_token`` replays the whole completion at once — a death
+        # before the reply resumes as a whole-request retry, which is
+        # trivially token-exact
         body = json.dumps({"prompt": list(prompt),
                            "max_new_tokens": max_new_tokens,
                            "rid": rid}).encode()
-        return json.loads(self._post(
+        out = json.loads(self._post(
             "/generate", body, "application/json",
-            tenant=tenant, traceparent=traceparent).decode())
+            tenant=tenant, traceparent=traceparent,
+            deadline_s=deadline_s).decode())
+        if on_token is not None:
+            for tok in out.get("tokens", []):
+                on_token(tok)
+        return out
 
     def install(self, handoff_bytes: bytes, tenant: str = "",
-                traceparent: str = "") -> dict:
+                traceparent: str = "",
+                deadline_s: float | None = None) -> dict:
         return json.loads(self._post(
             "/install", handoff_bytes, "application/octet-stream",
-            tenant=tenant, traceparent=traceparent).decode())
+            tenant=tenant, traceparent=traceparent,
+            deadline_s=deadline_s).decode())
 
     def prefill(self, request):
         """Disagg prefill over HTTP: POST the prompt, get back the
@@ -269,12 +462,13 @@ class HttpReplica(ReplicaHandle):
                            "rid": request.rid}).encode()
         return KVHandoff.from_bytes(self._post(
             "/prefill", body, "application/json",
-            tenant=request.tenant, traceparent=request.traceparent))
+            tenant=request.tenant, traceparent=request.traceparent,
+            deadline_s=request.deadline_s))
 
     def queue_depth(self) -> float:
         try:
             with urllib.request.urlopen(f"{self.health_url}/stats",
-                                        timeout=2) as resp:
+                                        timeout=probe_timeout_s()) as resp:
                 stats = json.loads(resp.read().decode())
             return float(stats.get("queue_depth", 0)
                          + stats.get("active_slots", 0))
@@ -284,7 +478,7 @@ class HttpReplica(ReplicaHandle):
     def healthy(self) -> bool:
         try:
             with urllib.request.urlopen(f"{self.health_url}/readyz",
-                                        timeout=2) as resp:
+                                        timeout=probe_timeout_s()) as resp:
                 return resp.status == 200
         except (OSError, ValueError):
             return False
@@ -298,11 +492,21 @@ class RouterConfig:
     spill_queue_depth: float = 8.0  # affine queue deeper than this spills
     hedge_after_s: float | None = None  # None = hedging off
     disagg_threshold: int = 0   # prompt length that routes via prefill; 0=off
+    # default per-request deadline budget (M2KT_DEADLINE_S, Helm-lifted);
+    # every downstream wait derives from it. None/<=0 = no deadline
+    deadline_s: float | None = 120.0
+    # eos id for completing a resume locally when the journal already
+    # ends in eos (the engine owns eos semantics; the router only needs
+    # it to avoid asking a replica to decode past the end)
+    eos_id: int | None = None
+    # readmission-probe exponential backoff (after FAILED probes only —
+    # a fresh markdown is still probed immediately, so recovery latency
+    # does not regress)
+    probe_backoff_base_s: float = 0.5
+    probe_backoff_cap_s: float = 30.0
 
     @classmethod
     def from_env(cls, **overrides) -> "RouterConfig":
-        import os
-
         def _num(name, default, cast):
             try:
                 return cast(os.environ.get(name, "") or default)
@@ -310,6 +514,7 @@ class RouterConfig:
                 return default
 
         hedge = _num("M2KT_ROUTER_HEDGE_MS", 0.0, float)
+        deadline = _num("M2KT_DEADLINE_S", cls.deadline_s or 0.0, float)
         cfg = dict(
             affinity_tokens=_num("M2KT_ROUTER_AFFINITY_TOKENS",
                                  cls.affinity_tokens, int),
@@ -319,6 +524,11 @@ class RouterConfig:
                                    cls.spill_queue_depth, float),
             hedge_after_s=(hedge / 1e3) if hedge > 0 else None,
             disagg_threshold=_num("M2KT_FLEET_DISAGG_THRESHOLD", 0, int),
+            deadline_s=deadline if deadline > 0 else None,
+            probe_backoff_base_s=_num("M2KT_ROUTER_PROBE_BACKOFF_S",
+                                      cls.probe_backoff_base_s, float),
+            probe_backoff_cap_s=_num("M2KT_ROUTER_PROBE_BACKOFF_CAP_S",
+                                     cls.probe_backoff_cap_s, float),
         )
         cfg.update(overrides)
         return cls(**cfg)
@@ -341,10 +551,18 @@ class Router:
         # the replica down immediately without waiting for a probe
         self._up: dict[str, bool] = {r.name: True for r in self.replicas}
         self._rr = 0  # round-robin cursor over prefill replicas
+        # readmission-probe backoff: replica -> (consecutive failed
+        # probes, monotonic ts before which it is not probed again)
+        self._probe_state: dict[str, tuple[int, float]] = {}
         reg = self.registry
         self._requests = reg.counter(
             "m2kt_router_requests_total", "Routed requests by outcome",
             labels=("outcome",))
+        self._resumed = reg.counter(
+            "m2kt_router_resumed_total",
+            "Mid-stream requests resumed on a surviving replica with "
+            "their journaled tokens force-fed, by failure reason",
+            labels=("reason",))
         self._retries = reg.counter(
             "m2kt_router_retries_total", "Requests retried on another "
             "replica after a failure")
@@ -385,17 +603,42 @@ class Router:
     # placement
     # ------------------------------------------------------------------
 
+    def _probe_delay(self, name: str, fails: int) -> float:
+        """Exponential backoff with deterministic jitter for readmission
+        probes: base * 2^(fails-1), capped, +0..50% jitter hashed from
+        (replica, fails) — every router instance spreads its probes the
+        same way without sharing a clock or an RNG."""
+        base = self.config.probe_backoff_base_s
+        cap = self.config.probe_backoff_cap_s
+        delay = min(cap, base * (2 ** max(0, fails - 1)))
+        jitter = (_rendezvous_score(fails, name) % 1000) / 2000.0
+        return delay * (1.0 + jitter)
+
     def probe(self) -> dict:
-        """Poll every replica's health endpoint and refresh the up/queue
-        gauges. Recovered replicas rejoin the affinity ring here."""
+        """Poll replica health endpoints and refresh the up/queue gauges.
+        Recovered replicas rejoin the affinity ring here. A replica whose
+        last probe FAILED is skipped until its backoff lapses, so a fleet
+        of routers does not thundering-herd a replica that just
+        restarted; a freshly marked-down replica (no failed probe yet) is
+        still probed immediately."""
+        now = time.monotonic()
         out = {}
         for r in self.replicas:
+            fails, next_ts = self._probe_state.get(r.name, (0, 0.0))
+            if fails and now < next_ts:
+                out[r.name] = self._up.get(r.name, False)
+                continue
             up = bool(r.healthy())
             self._up[r.name] = up
             self._replica_up.labels(replica=r.name).set(1.0 if up else 0.0)
             if up:
+                self._probe_state.pop(r.name, None)
                 self._replica_queue.labels(replica=r.name).set(
                     r.queue_depth())
+            else:
+                fails += 1
+                self._probe_state[r.name] = (
+                    fails, now + self._probe_delay(r.name, fails))
             out[r.name] = up
         return out
 
@@ -444,10 +687,19 @@ class Router:
 
     def generate(self, prompt, max_new_tokens: int | None = None,
                  rid: str | None = None, tenant: str = "",
-                 traceparent: str | None = None) -> dict:
+                 traceparent: str | None = None,
+                 deadline_s: float | None = None) -> dict:
         prompt = list(prompt)
         tenant = clean_tenant(tenant)
         self._inflight.inc()
+        # ONE absolute deadline per request (caller's X-M2KT-Deadline
+        # remainder, else the configured default): the disagg attempt,
+        # its direct-path fallback, and every resume hop all spend from
+        # the same budget
+        budget = deadline_s if deadline_s is not None \
+            else self.config.deadline_s
+        deadline = (time.perf_counter() + budget
+                    if budget and budget > 0 else None)
         root = None
         if self.tracer is not None:
             # many requests route concurrently in one process: the root
@@ -463,13 +715,16 @@ class Router:
                     and self.prefill_replicas):
                 try:
                     out = self._generate_disagg(prompt, max_new_tokens,
-                                                rid, tenant, root)
+                                                rid, tenant, root,
+                                                deadline)
                     self._requests.labels(outcome="ok").inc()
                     return out
+                except DeadlineExceeded:
+                    raise  # no budget left for the direct fallback either
                 except Exception:  # noqa: BLE001 - fall back to direct path
                     pass
             out = self._generate_direct(prompt, max_new_tokens, rid,
-                                        tenant, root)
+                                        tenant, root, deadline)
             self._requests.labels(outcome="ok").inc()
             return out
         except Exception as err:
@@ -482,11 +737,44 @@ class Router:
                 self.tracer.end(root)
             self._inflight.dec()
 
+    @staticmethod
+    def _remaining(deadline: float | None) -> float | None:
+        return (deadline - time.perf_counter()
+                if deadline is not None else None)
+
     def _generate_direct(self, prompt, max_new_tokens, rid, tenant="",
-                         root=None) -> dict:
+                         root=None, deadline: float | None = None) -> dict:
         tried: list[ReplicaHandle] = []
         last_err: Exception | None = None
+        # the journal: every token any replica has emitted for this
+        # request, in order, fed by the engine's on_token hook. On a
+        # mid-stream death it is what makes the retry a RESUME — the
+        # journaled tokens ride the next attempt as a forced prompt
+        # suffix, and greedy decode regenerates the rest byte-identically
+        emitted: list[int] = []
+        max_new = max_new_tokens or EngineConfig.max_new_tokens
         for attempt in range(self.config.max_retries + 1):
+            journal = list(emitted)
+            resumed = bool(attempt and journal)
+            if journal and (len(journal) >= max_new
+                            or (self.config.eos_id is not None
+                                and journal[-1] == self.config.eos_id)):
+                # the dead replica had already emitted the final token;
+                # nothing left to decode — complete locally
+                reason = (failure_reason(last_err)
+                          if last_err is not None else "complete")
+                self._resumed.labels(reason=reason).inc()
+                return {"rid": rid, "replica": tried[-1].name if tried
+                        else "", "prompt_len": len(prompt),
+                        "tokens": journal, "resumed": True,
+                        "finish_reason": "length"
+                        if len(journal) >= max_new else "eos"}
+            remaining = self._remaining(deadline)
+            if remaining is not None and remaining <= 0:
+                if last_err is None:
+                    last_err = DeadlineExceeded(
+                        f"{rid or 'request'}: deadline spent at the router")
+                break
             replica = self.pick(prompt, exclude=tried)
             if replica is None:
                 break
@@ -495,14 +783,30 @@ class Router:
                 if last_err is not None:
                     self._retry_reasons.labels(
                         failure_reason(last_err)).inc()
+            if resumed:
+                self._resumed.labels(reason=failure_reason(last_err)
+                                     if last_err is not None
+                                     else "unknown").inc()
             tried.append(replica)
             try:
                 if self.config.hedge_after_s is not None:
-                    return self._call_hedged(replica, prompt,
-                                             max_new_tokens, rid, tried,
-                                             tenant, root)
-                return self._call_one(replica, prompt, max_new_tokens,
-                                      rid, tenant, root)
+                    out = self._call_hedged(
+                        replica, prompt + journal, max_new - len(journal),
+                        rid, tried, tenant, root, remaining)
+                else:
+                    out = self._call_one(
+                        replica, prompt + journal, max_new - len(journal),
+                        rid, tenant, root, remaining,
+                        on_token=emitted.append,
+                        hop="resume" if resumed else "generate")
+                if journal:
+                    out = dict(out)
+                    out["tokens"] = journal + list(out["tokens"])
+                    out["prompt_len"] = len(prompt)
+                    out["resumed"] = True
+                return out
+            except DeadlineExceeded:
+                raise  # the caller's problem; not the replica's fault
             except Exception as err:  # noqa: BLE001 - any failure fails over
                 last_err = err
                 self._mark_down(replica, failure_reason(err))
@@ -511,11 +815,14 @@ class Router:
         raise RuntimeError("router: no healthy replica available")
 
     def _call_one(self, replica, prompt, max_new_tokens, rid, tenant,
-                  root) -> dict:
-        span, header = self._open_call(root, replica, "generate")
+                  root, deadline_s: float | None = None, on_token=None,
+                  hop: str = "generate") -> dict:
+        span, header = self._open_call(root, replica, hop)
         try:
             return replica.generate(prompt, max_new_tokens, rid,
-                                    tenant=tenant, traceparent=header)
+                                    tenant=tenant, traceparent=header,
+                                    deadline_s=deadline_s,
+                                    on_token=on_token)
         except Exception as err:  # noqa: BLE001 - annotate, then re-raise
             if span is not None:
                 span.attrs["error"] = failure_reason(err)
@@ -525,7 +832,8 @@ class Router:
                 self.tracer.end(span)
 
     def _call_hedged(self, primary, prompt, max_new_tokens, rid,
-                     tried, tenant="", root=None) -> dict:
+                     tried, tenant="", root=None,
+                     deadline_s: float | None = None) -> dict:
         """Fire ``primary``; if it has not answered within the hedge
         deadline, fire the runner-up too and take whichever finishes
         first. The loser's work is wasted by design — hedging trades
@@ -536,8 +844,12 @@ class Router:
 
         def call(replica):
             try:
+                # hedges carry no journal feed: two replicas racing one
+                # request would interleave a single journal — hedging is
+                # its own redundancy, so the loser is simply discarded
                 results.append(self._call_one(
-                    replica, prompt, max_new_tokens, rid, tenant, root))
+                    replica, prompt, max_new_tokens, rid, tenant, root,
+                    deadline_s))
                 done.set()
             except Exception as err:  # noqa: BLE001 - collected below
                 errors.append(err)
@@ -563,14 +875,15 @@ class Router:
         raise errors[0] if errors else RuntimeError("hedge: no result")
 
     def _generate_disagg(self, prompt, max_new_tokens, rid, tenant="",
-                         root=None) -> dict:
+                         root=None, deadline: float | None = None) -> dict:
         """Long prompts route prefill->decode: round-robin a prefill
         replica for the KV handoff, then seat it on the prefix-affine
         decode replica (same placement as the direct path, so the
         decode side's cache locality is preserved). Both hops get their
         own router.call span; the handoff wire carries the install
         hop's traceparent so the decode replica's root stitches under
-        it even when the bytes travel through a queue."""
+        it even when the bytes travel through a queue. Both hops spend
+        from the request's one deadline budget."""
         prefill = self.prefill_replicas[self._rr
                                         % len(self.prefill_replicas)]
         self._rr += 1
@@ -579,7 +892,8 @@ class Router:
             handoff = prefill.prefill(Request(
                 rid=rid or f"disagg-{self._rr}", prompt=list(prompt),
                 max_new_tokens=max_new_tokens, tenant=tenant,
-                traceparent=pheader))
+                traceparent=pheader,
+                deadline_s=self._remaining(deadline)))
         finally:
             if pspan is not None:
                 self.tracer.end(pspan)
@@ -591,7 +905,8 @@ class Router:
         handoff.traceparent = dheader
         try:
             out = decode.install(handoff.to_bytes(), tenant=tenant,
-                                 traceparent=dheader)
+                                 traceparent=dheader,
+                                 deadline_s=self._remaining(deadline))
         finally:
             if dspan is not None:
                 self.tracer.end(dspan)
@@ -645,6 +960,9 @@ class RouterHTTPServer:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n).decode())
+                    raw_deadline = self.headers.get(DEADLINE_HEADER)
+                    deadline_s = (float(raw_deadline)
+                                  if raw_deadline else None)
                     out = outer.router.generate(
                         payload["prompt"],
                         payload.get("max_new_tokens",
@@ -652,8 +970,12 @@ class RouterHTTPServer:
                         payload.get("rid"),
                         tenant=self.headers.get(TENANT_HEADER, ""),
                         traceparent=self.headers.get(
-                            TRACEPARENT_HEADER))
+                            TRACEPARENT_HEADER),
+                        deadline_s=deadline_s)
                     self._send(200, json.dumps(out).encode())
+                except DeadlineExceeded as err:
+                    self._send(504, json.dumps(
+                        {"error": str(err)}).encode())
                 except Exception as err:  # noqa: BLE001 - surface as 500
                     self._send(500, json.dumps(
                         {"error": str(err)}).encode())

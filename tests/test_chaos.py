@@ -1,0 +1,638 @@
+"""Serving-fleet fault-tolerance tests: chaos injectors, token-exact
+mid-stream recovery, graceful drain, deadline shedding, probe backoff.
+
+The load-bearing property is the tentpole's: a replica killed while
+streaming token N must lose nothing — the router resumes the request on
+a survivor with the journaled tokens force-fed as a prompt suffix, and
+greedy decode makes the recovered stream byte-identical to an unfaulted
+run (asserted on tokens AND on logits, including under int8-kv). Around
+that core: the deterministic chaos injectors themselves (kill at entry /
+mid / last token, damaged KV handoffs, health flapping), drain
+semantics, engine-side deadline-shed accounting, readmission-probe
+backoff, trace continuity across the resume hop, and the Helm round
+trip of the PDB + drain wiring."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from move2kube_tpu.models.llama import Llama, llama_tiny
+from move2kube_tpu.serving.engine import (
+    DeadlineExceeded,
+    EngineConfig,
+    Request,
+    ServingEngine,
+)
+from move2kube_tpu.serving.fleet.chaos import (
+    ChaosConfig,
+    ChaosKill,
+    ServingChaos,
+    maybe_chaos,
+)
+from move2kube_tpu.serving.fleet.disagg import KVHandoff, PrefillReplica
+from move2kube_tpu.serving.fleet.router import (
+    InProcessReplica,
+    ReplicaDraining,
+    ReplicaHandle,
+    Router,
+    RouterConfig,
+    build_fleet,
+)
+
+
+@pytest.fixture(scope="module")
+def llama_parts():
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, **over) -> ServingEngine:
+    cfg = EngineConfig(**{**dict(max_batch=2, max_seq=64, block_size=8,
+                                 buckets=(16, 32)), **over})
+    return ServingEngine(model, variables, cfg)
+
+
+def _resumed_total(router: Router) -> float:
+    """Sum of m2kt_router_resumed_total across reason labels, read the
+    way an operator would: off the rendered exposition text."""
+    text = router.registry.render()
+    return sum(float(m) for m in re.findall(
+        r"m2kt_router_resumed_total\{[^}]*\} ([0-9.e+-]+)", text))
+
+
+def _close(router: Router) -> None:
+    for r in router.replicas:
+        r.close()
+
+
+# ----------------------------------------------------------------------
+# chaos injectors (no model)
+# ----------------------------------------------------------------------
+
+def test_chaos_config_from_env(monkeypatch):
+    for name in ("M2KT_CHAOS_KILL_TOKEN", "M2KT_CHAOS_KILL_RID",
+                 "M2KT_CHAOS_HANDOFF", "M2KT_CHAOS_SLOW_S",
+                 "M2KT_CHAOS_FLAP_N", "M2KT_CHAOS_MARKER"):
+        monkeypatch.delenv(name, raising=False)
+    assert not ChaosConfig.from_env().armed()
+    assert maybe_chaos() is None  # production pods pay nothing
+
+    monkeypatch.setenv("M2KT_CHAOS_KILL_TOKEN", "3")
+    monkeypatch.setenv("M2KT_CHAOS_KILL_RID", "req-7")
+    monkeypatch.setenv("M2KT_CHAOS_HANDOFF", "truncate")
+    monkeypatch.setenv("M2KT_CHAOS_SLOW_S", "0.25")
+    monkeypatch.setenv("M2KT_CHAOS_FLAP_N", "2")
+    monkeypatch.setenv("M2KT_CHAOS_MARKER", "/tmp/m2kt-marker")
+    cfg = ChaosConfig.from_env()
+    assert (cfg.kill_token, cfg.kill_rid, cfg.handoff, cfg.slow_s,
+            cfg.flap_n, cfg.marker) == (3, "req-7", "truncate", 0.25, 2,
+                                        "/tmp/m2kt-marker")
+    assert cfg.armed()
+    assert maybe_chaos() is not None
+    # overrides win over env, and garbage numerics fall back clean
+    assert ChaosConfig.from_env(kill_token=None, handoff="", slow_s=0.0,
+                                flap_n=0).armed() is False
+    monkeypatch.setenv("M2KT_CHAOS_KILL_TOKEN", "not-a-number")
+    assert ChaosConfig.from_env().kill_token is None
+
+
+def test_chaos_kill_marker_exactly_once(tmp_path):
+    marker = str(tmp_path / "killed")
+    chaos = ServingChaos(ChaosConfig(kill_token=2, marker=marker))
+    chaos.on_token("rep", "r1", 11)  # token 1: survives
+    with pytest.raises(ChaosKill):
+        chaos.on_token("rep", "r1", 12)  # token 2: dies, claims marker
+    # the recovered attempt sails past the same token count
+    chaos.on_token("rep", "r1", 11)
+    chaos.on_token("rep", "r1", 12)
+    chaos.on_token("rep", "r1", 13)
+    # rid filter: non-matching requests never die
+    filt = ServingChaos(ChaosConfig(kill_token=1, kill_rid="victim"))
+    filt.on_token("rep", "innocent-1", 5)
+    with pytest.raises(ChaosKill):
+        filt.on_token("rep", "victim-1", 5)
+
+
+def test_chaos_flap_and_slow():
+    chaos = ServingChaos(ChaosConfig(flap_n=2))
+    assert chaos.on_probe("rep") is False
+    assert chaos.on_probe("rep") is False
+    assert chaos.on_probe("rep") is True  # recovered
+    assert chaos.on_probe("rep") is True
+    # per-replica probe state: a second replica flaps independently
+    assert chaos.on_probe("other") is False
+
+    slow = ServingChaos(ChaosConfig(slow_s=0.05))
+    t0 = time.perf_counter()
+    slow.on_generate("rep", "r1")
+    assert time.perf_counter() - t0 >= 0.05
+    slow.on_generate("rep", "r2")  # not marker-gated: slowness persists
+
+
+def test_chaos_handoff_damage_and_ingestion_hardening(tmp_path):
+    rng = np.random.default_rng(3)
+    kv = [(rng.standard_normal((1, 16, 2, 8)).astype(np.float32),
+           rng.standard_normal((1, 16, 2, 8)).astype(np.float32))
+          for _ in range(2)]
+    blob = KVHandoff(rid="h", prompt=[1, 2], prompt_len=2, bucket=16,
+                     first_token=9, kv=kv, max_new_tokens=4).to_bytes()
+
+    drop = ServingChaos(ChaosConfig(handoff="drop",
+                                    marker=str(tmp_path / "drop")))
+    with pytest.raises(ChaosKill):
+        drop.on_handoff("rep", blob)
+    assert drop.on_handoff("rep", blob) == blob  # marker: fired once
+
+    trunc = ServingChaos(ChaosConfig(handoff="truncate"))
+    half = trunc.on_handoff("rep", blob)
+    assert len(half) == len(blob) // 2
+    # every malformation is a clean ValueError (a 4xx at the HTTP edge),
+    # never a zipfile/KeyError crash in the replica's worker thread
+    with pytest.raises(ValueError):
+        KVHandoff.from_bytes(half)
+    with pytest.raises(ValueError):
+        KVHandoff.from_bytes(b"this is not an npz archive")
+    with pytest.raises(ValueError):
+        KVHandoff.from_bytes(b"")
+
+
+# ----------------------------------------------------------------------
+# token-exact mid-stream recovery
+# ----------------------------------------------------------------------
+
+def _golden(model, variables, prompt, max_new):
+    router = build_fleet(model, variables, 1,
+                         engine_config=EngineConfig(
+                             max_batch=2, max_seq=64, block_size=8,
+                             buckets=(16, 32)))
+    try:
+        return router.generate(list(prompt), max_new)["tokens"]
+    finally:
+        _close(router)
+
+
+def test_resume_kill_mid_stream_token_exact(llama_parts, tmp_path):
+    model, variables = llama_parts
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(1, 200, size=10).tolist()
+    want = _golden(model, variables, prompt, 6)
+    assert len(want) == 6
+
+    router = build_fleet(model, variables, 2,
+                         engine_config=EngineConfig(
+                             max_batch=2, max_seq=64, block_size=8,
+                             buckets=(16, 32)))
+    try:
+        victim = router.pick(prompt)
+        marker = str(tmp_path / "mid")
+        victim.chaos = ServingChaos(ChaosConfig(kill_token=3,
+                                                marker=marker))
+        out = router.generate(list(prompt), 6, rid="mid-1")
+        assert out["tokens"] == want  # token-exact across the death
+        assert out["resumed"] is True
+        assert out["replica"] != victim.name
+        assert (tmp_path / "mid").exists()  # the kill really fired
+        assert _resumed_total(router) >= 1
+        assert router._up[victim.name] is False  # victim marked down
+    finally:
+        _close(router)
+
+
+def test_resume_kill_at_entry_is_plain_retry(llama_parts, tmp_path):
+    """kill_token=0 dies before any token: no journal, so the failover
+    is an ordinary retry — correct result, but NOT counted as a
+    resume."""
+    model, variables = llama_parts
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(1, 200, size=10).tolist()
+    want = _golden(model, variables, prompt, 4)
+
+    router = build_fleet(model, variables, 2,
+                         engine_config=EngineConfig(
+                             max_batch=2, max_seq=64, block_size=8,
+                             buckets=(16, 32)))
+    try:
+        victim = router.pick(prompt)
+        victim.chaos = ServingChaos(ChaosConfig(
+            kill_token=0, marker=str(tmp_path / "entry")))
+        out = router.generate(list(prompt), 4, rid="entry-1")
+        assert out["tokens"] == want
+        assert "resumed" not in out
+        assert _resumed_total(router) == 0
+        assert router._retries.value >= 1
+    finally:
+        _close(router)
+
+
+def test_resume_kill_at_last_token_completes_locally(llama_parts,
+                                                     tmp_path):
+    """The dead replica had already emitted every token: the journal IS
+    the answer — the router completes locally instead of asking a
+    survivor to decode zero tokens."""
+    model, variables = llama_parts
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, 200, size=10).tolist()
+    want = _golden(model, variables, prompt, 4)
+
+    router = build_fleet(model, variables, 2,
+                         engine_config=EngineConfig(
+                             max_batch=2, max_seq=64, block_size=8,
+                             buckets=(16, 32)))
+    try:
+        victim = router.pick(prompt)
+        victim.chaos = ServingChaos(ChaosConfig(
+            kill_token=4, marker=str(tmp_path / "last")))
+        out = router.generate(list(prompt), 4, rid="last-1")
+        assert out["tokens"] == want
+        assert out["resumed"] is True
+        assert out["finish_reason"] == "length"
+        assert _resumed_total(router) >= 1
+    finally:
+        _close(router)
+
+
+def test_resume_journal_ending_in_eos_completes_locally(llama_parts,
+                                                        tmp_path):
+    model, variables = llama_parts
+    rng = np.random.default_rng(10)
+    prompt = rng.integers(1, 200, size=10).tolist()
+    want = _golden(model, variables, prompt, 6)
+
+    router = build_fleet(
+        model, variables, 2,
+        engine_config=EngineConfig(max_batch=2, max_seq=64, block_size=8,
+                                   buckets=(16, 32)),
+        router_config=RouterConfig(eos_id=want[2]))
+    try:
+        victim = router.pick(prompt)
+        victim.chaos = ServingChaos(ChaosConfig(
+            kill_token=3, marker=str(tmp_path / "eos")))
+        out = router.generate(list(prompt), 6, rid="eos-1")
+        assert out["tokens"] == want[:3]  # journal already ends in eos
+        assert out["finish_reason"] == "eos"
+        assert out["resumed"] is True
+    finally:
+        _close(router)
+
+
+def _resume_logit_pair(model, variables, tmp_path, quant, rid):
+    """Golden logits from an unfaulted engine vs the survivor's logits
+    after a kill at token 3 — aligned on the post-journal tail (the
+    survivor never re-scores force-fed journal tokens)."""
+    kill_at, max_new = 3, 6
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(1, 200, size=10).tolist()
+
+    gold = _engine(model, variables, quant=quant)
+    gold.capture_logits = True
+    comp, = gold.run([Request(rid, list(prompt), max_new)])
+    gold_logits = gold.logit_log[rid]
+    assert len(gold_logits) == max_new
+
+    ecfg = EngineConfig(max_batch=2, max_seq=64, block_size=8,
+                        buckets=(16, 32), quant=quant)
+    router = build_fleet(model, variables, 2, engine_config=ecfg)
+    try:
+        for r in router.replicas:
+            r.engine.capture_logits = True
+        victim = router.pick(prompt)
+        victim.chaos = ServingChaos(ChaosConfig(
+            kill_token=kill_at, marker=str(tmp_path / f"q-{quant}")))
+        out = router.generate(list(prompt), max_new, rid=rid)
+        assert out["tokens"] == comp.tokens  # token-exact recovery
+        assert out["resumed"] is True
+        survivor = next(r for r in router.replicas
+                        if r.name == out["replica"])
+        got = survivor.engine.logit_log[rid]
+        assert len(got) == max_new - kill_at
+        return gold_logits[kill_at:], got
+    finally:
+        _close(router)
+
+
+def test_resume_logits_identical_fp32(llama_parts, tmp_path):
+    """In fp32 the resume is logit-identical, not just argmax-identical:
+    re-prefilling prompt+journal rebuilds the exact KV state the dead
+    replica had."""
+    model, variables = llama_parts
+    want, got = _resume_logit_pair(model, variables, tmp_path, "off",
+                                   "fp32-1")
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_resume_logits_equivalent_under_int8_kv(llama_parts, tmp_path):
+    """Under int8-kv the resume re-prefills the journal (prefill-time KV
+    quantization) while the golden run quantized the same tokens at
+    decode time; per-row scales keep the drift inside the repo's quant
+    gate (logit_gate, same 0.05 rel-err budget as the bench quant
+    phase) with full greedy agreement — so recovery stays token-exact."""
+    from move2kube_tpu.serving.quant import logit_gate
+
+    model, variables = llama_parts
+    want, got = _resume_logit_pair(model, variables, tmp_path, "int8-kv",
+                                   "kv-1")
+    for g, w in zip(got, want):
+        gate = logit_gate(np.asarray(w), np.asarray(g))
+        assert gate["top1_agreement"] == 1.0, gate
+        assert gate["max_rel_err"] < 0.05, gate
+
+
+def test_kill_during_disagg_install_falls_back_direct(llama_parts,
+                                                      tmp_path):
+    """A KV handoff lost (or truncated) in transit must not lose the
+    request: the disagg attempt fails cleanly and the router's direct
+    path serves the same tokens."""
+    model, variables = llama_parts
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, 200, size=12).tolist()
+    want = _golden(model, variables, prompt, 4)
+
+    for mode in ("drop", "truncate"):
+        prefill = PrefillReplica(model, variables,
+                                 EngineConfig(max_batch=2, max_seq=64,
+                                              block_size=8,
+                                              buckets=(16, 32)))
+        decode = InProcessReplica(
+            "decode-0", _engine(model, variables)).start()
+        decode.chaos = ServingChaos(ChaosConfig(
+            handoff=mode, marker=str(tmp_path / f"handoff-{mode}")))
+        router = Router([decode], prefill_replicas=[prefill],
+                        config=RouterConfig(disagg_threshold=8,
+                                            deadline_s=60.0))
+        try:
+            out = router.generate(list(prompt), 4, rid=f"dis-{mode}")
+            assert out["tokens"] == want
+            assert router._disagg.value == 0  # handoff never seated
+            assert router._requests.labels(outcome="ok").value == 1
+        finally:
+            decode.close()
+
+
+# ----------------------------------------------------------------------
+# graceful drain
+# ----------------------------------------------------------------------
+
+def test_drain_empty_queue_and_revive(llama_parts):
+    model, variables = llama_parts
+    rep = InProcessReplica("d0", _engine(model, variables)).start()
+    try:
+        assert rep.healthy()
+        assert rep.drain(grace_s=1.0) is True  # nothing in flight
+        assert not rep.healthy()  # out of the placement ring at once
+        with pytest.raises(ReplicaDraining):
+            rep.generate([1, 2, 3], 2)
+        rep.revive()
+        assert rep.healthy()
+        assert len(rep.generate([1, 2, 3], 2)["tokens"]) == 2
+    finally:
+        rep.close()
+
+
+def test_drain_waits_for_inflight_stream(llama_parts):
+    model, variables = llama_parts
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(1, 200, size=10).tolist()
+    rep = InProcessReplica("d1", _engine(model, variables)).start()
+    res: dict = {}
+
+    def go():
+        try:
+            res["out"] = rep.generate(prompt, 8, rid="infl-1")
+        except Exception as err:  # noqa: BLE001 - asserted below
+            res["err"] = err
+
+    t = threading.Thread(target=go, daemon=True)
+    try:
+        t.start()
+        deadline = time.perf_counter() + 10
+        while not rep.engine.has_work() and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        assert rep.drain(grace_s=30.0) is True  # waited, didn't cut
+        t.join(timeout=10)
+        assert "err" not in res, res.get("err")
+        assert len(res["out"]["tokens"]) == 8  # the stream finished
+    finally:
+        rep.close()
+
+
+# ----------------------------------------------------------------------
+# deadline shedding (engine side)
+# ----------------------------------------------------------------------
+
+def test_engine_deadline_shed_accounting(llama_parts):
+    model, variables = llama_parts
+    eng = _engine(model, variables)
+
+    # expired on arrival: refused at submit, reason-labeled
+    with pytest.raises(DeadlineExceeded):
+        eng.submit(Request("x1", [1, 2, 3], 4, deadline_s=0.0))
+    assert eng._deadline_shed.labels(reason="expired").value == 1
+
+    # queued_expired: admitted with budget, budget spent while queued
+    eng.submit(Request("x2", [1, 2, 3], 4, deadline_s=0.02))
+    time.sleep(0.05)
+    comps = []
+    for _ in range(50):
+        comps += eng.step()
+        if comps:
+            break
+    assert comps[0].rid == "x2" and comps[0].finish_reason == "shed"
+    assert eng._deadline_shed.labels(reason="queued_expired").value == 1
+
+    # unmeetable: with latency history, max_new * p50 > budget is shed
+    # up front instead of burning decode on an answer nobody will wait
+    # for (a fresh engine has no history and gets benefit of the doubt)
+    eng.run([Request("warm", [1, 2, 3], 4)])
+    with pytest.raises(DeadlineExceeded):
+        eng.submit(Request("x3", [1, 2, 3], 4, deadline_s=1e-6))
+    assert eng._deadline_shed.labels(reason="unmeetable").value == 1
+
+
+def test_router_deadline_raises_and_counts(llama_parts):
+    model, variables = llama_parts
+    router = build_fleet(model, variables, 1,
+                         engine_config=EngineConfig(
+                             max_batch=2, max_seq=64, block_size=8,
+                             buckets=(16, 32)))
+    try:
+        router.generate([1, 2, 3], 2)  # fill the latency histogram
+        with pytest.raises(DeadlineExceeded):
+            router.generate([1, 2, 3], 2, deadline_s=1e-6)
+        assert router._requests.labels(outcome="error").value == 1
+    finally:
+        _close(router)
+
+
+# ----------------------------------------------------------------------
+# readmission-probe backoff
+# ----------------------------------------------------------------------
+
+class _FlakyStub(ReplicaHandle):
+    def __init__(self, name):
+        self.name = name
+        self.up = False
+        self.probes = 0
+
+    def healthy(self):
+        self.probes += 1
+        return self.up
+
+    def queue_depth(self):
+        return 0.0
+
+
+def test_probe_backoff_deterministic_and_bounded():
+    router = Router([_FlakyStub("s0")])
+    # deterministic: same (replica, fails) -> same delay, no shared RNG
+    assert router._probe_delay("s0", 1) == router._probe_delay("s0", 1)
+    # exponential while under the cap
+    d = [router._probe_delay("s0", n) for n in range(1, 5)]
+    assert d[0] < d[1] < d[2] < d[3]
+    # capped (jitter adds at most 50%)
+    cap = router.config.probe_backoff_cap_s
+    assert router._probe_delay("s0", 50) <= cap * 1.5
+    # jitter spreads replicas apart
+    assert router._probe_delay("s0", 3) != router._probe_delay("s1", 3)
+
+
+def test_probe_backoff_skips_until_lapse():
+    stub = _FlakyStub("s0")
+    router = Router([stub])
+    assert router.probe() == {"s0": False}
+    assert stub.probes == 1
+    # inside the backoff window the replica is NOT re-probed
+    assert router.probe() == {"s0": False}
+    assert stub.probes == 1
+    # window lapses: probed again, recovery readmits and clears state
+    fails, _ = router._probe_state["s0"]
+    router._probe_state["s0"] = (fails, 0.0)
+    stub.up = True
+    assert router.probe() == {"s0": True}
+    assert stub.probes == 2
+    assert "s0" not in router._probe_state
+    assert router._up["s0"] is True
+    # a FRESH markdown (no failed probe yet) is still probed immediately
+    router._mark_down(stub, "connection")
+    assert router.probe() == {"s0": True}
+    assert stub.probes == 3
+
+
+# ----------------------------------------------------------------------
+# trace continuity across the resume hop
+# ----------------------------------------------------------------------
+
+def test_resume_hop_stays_in_request_trace(llama_parts, tmp_path):
+    from move2kube_tpu.obs.tracing import SpanRecorder
+
+    model, variables = llama_parts
+    rng = np.random.default_rng(14)
+    prompt = rng.integers(1, 200, size=10).tolist()
+    tracer = SpanRecorder(role="router")
+    replicas = [InProcessReplica(f"t{i}", _engine(model, variables)).start()
+                for i in range(2)]
+    router = Router(replicas, config=RouterConfig(deadline_s=60.0),
+                    tracer=tracer)
+    try:
+        victim = router.pick(prompt)
+        victim.chaos = ServingChaos(ChaosConfig(
+            kill_token=2, marker=str(tmp_path / "trace")))
+        out = router.generate(list(prompt), 4, rid="trace-1")
+        assert out["resumed"] is True
+        spans = tracer.snapshot()
+        roots = [s for s in spans if s["name"] == "router.request"]
+        calls = [s for s in spans if s["name"] == "router.call"]
+        assert len(roots) == 1
+        hops = [s["attrs"]["hop"] for s in calls]
+        assert hops == ["generate", "resume"]
+        # the resume hop parents under the SAME request root: one trace
+        # end to end, even across the replica death
+        assert all(s["trace_id"] == roots[0]["trace_id"] for s in calls)
+        assert all(s["parent_id"] == roots[0]["span_id"] for s in calls)
+        # the failed hop carries its failure; the resume hop is clean
+        assert "error" in calls[0]["attrs"]
+        assert "error" not in calls[1]["attrs"]
+    finally:
+        for r in replicas:
+            r.close()
+
+
+# ----------------------------------------------------------------------
+# PDB + drain emission round-trips Helm parameterization
+# ----------------------------------------------------------------------
+
+def _serving_ir():
+    from move2kube_tpu.types.ir import IR, Service
+    from move2kube_tpu.types.plan import AcceleratorInfo
+
+    svc = Service(
+        name="llm",
+        containers=[{
+            "name": "llm", "image": "llm:latest",
+            "ports": [{"containerPort": 8080},
+                      {"name": "metrics", "containerPort": 9090}],
+            "env": [{"name": "M2KT_METRICS_PORT", "value": "9090"}],
+        }],
+        accelerator=AcceleratorInfo(serving=True, serving_port=8080,
+                                    tpu_accelerator="tpu-v5-lite-podslice",
+                                    tpu_topology="2x2"),
+    )
+    return IR(services={"llm": svc}), svc
+
+
+def test_pdb_and_drain_wiring_helm_roundtrip(monkeypatch):
+    """The split contract end to end: with ``tpufleetminavailable``
+    seeded in chart values (the parameterizer ran), the emitted PDBs
+    bake the ``.Values`` ref — and the rendered chart is valid YAML that
+    k8s accepts (PDB minAvailable is IntOrString, so the quoted render
+    is legal)."""
+    import yaml
+
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    monkeypatch.setenv("M2KT_FLEET", "1")
+    monkeypatch.setenv("M2KT_FLEET_ROUTERS", "1")
+    monkeypatch.setenv("M2KT_FLEET_PREFILL", "1")
+    monkeypatch.setenv("M2KT_FLEET_DECODE", "3")
+    monkeypatch.setenv("M2KT_FLEET_MIN_AVAILABLE", "2")
+    ir, _svc = _serving_ir()
+    ir.values.global_variables["tpufleetminavailable"] = "2"
+    objs = DeploymentAPIResource().create_new_resources(
+        ir, {"Deployment", "JobSet"})
+
+    pdbs = [o for o in objs if o["kind"] == "PodDisruptionBudget"]
+    assert {o["metadata"]["name"] for o in pdbs} == \
+        {"llm-router", "llm-prefill", "llm-decode"}
+    for pdb in pdbs:
+        assert pdb["spec"]["minAvailable"] == \
+            "{{ .Values.tpufleetminavailable }}"
+
+    # render the chart the way helm would and load it back
+    text = yaml.safe_dump_all(objs)
+    rendered = text.replace("{{ .Values.tpufleetminavailable }}", "2")
+    docs = list(yaml.safe_load_all(rendered))
+    back = [d for d in docs if d["kind"] == "PodDisruptionBudget"]
+    assert len(back) == 3
+    assert all(int(d["spec"]["minAvailable"]) == 2 for d in back)
+    # drain wiring survives the round trip on every serving role
+    for d in docs:
+        if d["kind"] != "Deployment":
+            continue
+        tmpl = d["spec"]["template"]["spec"]
+        assert tmpl["terminationGracePeriodSeconds"] >= 30
+        hook = tmpl["containers"][0]["lifecycle"]["preStop"]["exec"]
+        if d["metadata"]["name"] == "llm-decode":
+            assert "/drain" in " ".join(hook["command"])

@@ -418,15 +418,20 @@ class FakeReplica(ReplicaHandle):
         self.up = True
 
     def generate(self, prompt, max_new_tokens=None, rid=None,
-                 tenant="", traceparent=""):
+                 tenant="", traceparent="", deadline_s=None,
+                 on_token=None):
         if self.fail_next > 0:
             self.fail_next -= 1
             raise RuntimeError(f"{self.name}: injected failure")
         if self.hold_s:
             time.sleep(self.hold_s)
         self.calls += 1
+        tokens = [1, 2]
+        if on_token is not None:
+            for t in tokens:
+                on_token(t)
         return {"rid": rid or "r", "replica": self.name,
-                "prompt_len": len(prompt), "tokens": [1, 2],
+                "prompt_len": len(prompt), "tokens": tokens,
                 "finish_reason": "length"}
 
     def queue_depth(self):
@@ -606,6 +611,9 @@ def test_fleet_deployment_emission(monkeypatch):
         ("HorizontalPodAutoscaler", "llm-router"),
         ("HorizontalPodAutoscaler", "llm-prefill"),
         ("HorizontalPodAutoscaler", "llm-decode"),
+        ("PodDisruptionBudget", "llm-router"),
+        ("PodDisruptionBudget", "llm-prefill"),
+        ("PodDisruptionBudget", "llm-decode"),
         ("Service", "llm-prefill"), ("Service", "llm-decode"),
     }
     # router pods keep the front Service's selector label; engines don't
@@ -639,6 +647,20 @@ def test_fleet_deployment_emission(monkeypatch):
     assert by[("Service", "llm-decode")]["spec"]["clusterIP"] == "None"
     assert by[("Service", "llm-decode")]["spec"]["selector"][
         "move2kube-tpu.io/service"] == "llm-decode"
+    # per-role PDBs select exactly the pods their Deployment manages
+    pdb = by[("PodDisruptionBudget", "llm-decode")]
+    assert pdb["apiVersion"] == "policy/v1"
+    assert pdb["spec"]["selector"]["matchLabels"] == \
+        decode["spec"]["selector"]["matchLabels"]
+    assert pdb["spec"]["minAvailable"] == 1
+    # drain wiring: grace period covers the drain budget, and the decode
+    # role's preStop POSTs /drain so in-flight streams finish first
+    tmpl = decode["spec"]["template"]["spec"]
+    assert tmpl["terminationGracePeriodSeconds"] >= 30
+    cmd = tmpl["containers"][0]["lifecycle"]["preStop"]["exec"]["command"]
+    assert "/drain" in " ".join(cmd)
+    rtmpl = router["spec"]["template"]["spec"]
+    assert rtmpl["terminationGracePeriodSeconds"] >= 30
 
 
 def test_fleet_off_keeps_single_workload(monkeypatch):
@@ -683,6 +705,9 @@ def test_fleet_optimizer_and_helm_lift(monkeypatch):
     assert env["M2KT_FLEET"] == "1"
     assert env["M2KT_FLEET_DECODE"] == "3"
     assert env["M2KT_SERVE_PREFIX_CACHE"] == "1"
+    assert env["M2KT_DEADLINE_S"] == "120"
+    assert env["M2KT_DRAIN_GRACE_S"] == "30"
+    assert env["M2KT_FLEET_MIN_AVAILABLE"] == "1"
     ir = tpu_fleet_parameterizer(ir)
     gv = ir.values.global_variables
     assert gv["tpufleet"] == "1"
@@ -690,6 +715,9 @@ def test_fleet_optimizer_and_helm_lift(monkeypatch):
     assert gv["tpufleetprefill"] == "1"
     assert gv["tpufleetdecode"] == "3"
     assert gv["tpufleetsalt"] == "blue"
+    assert gv["tpufleetdeadline"] == "120"
+    assert gv["tpufleetdraingrace"] == "30"
+    assert gv["tpufleetminavailable"] == "1"
     env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
     assert env["M2KT_FLEET_DECODE"] == "{{ .Values.tpufleetdecode }}"
     assert env["M2KT_FLEET_AFFINITY_SALT"] == "{{ .Values.tpufleetsalt }}"
