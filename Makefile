@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke slo-smoke chaos-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke slo-smoke chaos-smoke swap-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -174,6 +174,10 @@ slo-smoke:
 chaos-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_chaos.py -q
 	$(CPU_ENV) $(PY) bench.py --model chaos
+
+swap-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_weights.py -q
+	$(CPU_ENV) $(PY) bench.py --model swap
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -68,7 +68,7 @@ MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
 PHASES = ("resnet", "bert", "pallas", "llama", "translate", "goodput",
           "scaling", "serving", "fleet", "quant", "kernels", "obs",
-          "chaos")
+          "chaos", "swap")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
@@ -85,6 +85,7 @@ PHASE_METRICS = {
     "kernels": ("fused_paged_decode_speedup_vs_ref", "x"),
     "obs": ("telemetry_overhead_fraction", "fraction"),
     "chaos": ("chaos_recovered_token_exact_fraction", "fraction"),
+    "swap": ("swap_cold_join_ttft_speedup", "x"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
@@ -1468,6 +1469,416 @@ def run_chaos_probe() -> int:
     return 0
 
 
+def bench_swap(n: int) -> dict:
+    """Weight-plane phase on forced host devices, two halves in one
+    capture. (1) Cold-replica join TTFT: the same replica boot measured
+    twice — checkpoint restore + full XLA compile (the pre-weight-plane
+    path) vs P2P shard streaming from serving peers + prewarm-seeded
+    compile cache; the reported number is the speedup, gated at
+    M2KT_BENCH_SWAP_SPEEDUP_FLOOR. (2) Live swap under chaos: a threaded
+    zipfian replay is mid-flight while the new generation is fetched
+    P2P from peers where one peer corrupts a shard and another dies
+    mid-stream, then rolled across the fleet while chaos kills one
+    replica inside its swap. FAILS unless the fetch survives both
+    faults (digest re-fetch + different-peer finish), zero in-flight
+    requests are lost, every stream stays token-identical to the golden
+    replay across the swap, and the survivors converge on the new
+    generation. Own subprocess: the probe must own jax's platform env
+    and the M2KT_COMPILE_CACHE*/M2KT_PREWARM_DIR knobs before import."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--swap-probe"],
+        env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"swap probe rc={res.returncode}: {res.stderr[-300:]}")
+    probe = json.loads(res.stdout.strip().splitlines()[-1])
+    dt = time.perf_counter() - t0
+    print(f"[bench] swap: cold join {probe['ttft_store_s']:.2f}s "
+          f"store+compile vs {probe['ttft_p2p_s']:.2f}s P2P+prewarm "
+          f"(x{probe['cold_join_ttft_speedup']:.2f} >= "
+          f"x{probe['speedup_floor']:.1f}; {probe['prewarm_entries']} "
+          f"baked, {probe['seeded_entries']} seeded); live swap -> v"
+          f"{probe['swapped_version']}: {probe['swap_ok']} ok / "
+          f"{probe['swap_failed']} killed mid-swap, "
+          f"{probe['in_flight_at_swap']} in flight, token-exact "
+          f"{probe['swap_token_exact_fraction']:.3f} "
+          f"(digest_mismatch={probe['digest_mismatch_total']}, "
+          f"peer_deaths={probe['connection_total']}) in {dt:.1f}s",
+          file=sys.stderr)
+    metric, unit = PHASE_METRICS["swap"]
+    return {"phase": "swap", "metric": metric,
+            "value": probe["cold_join_ttft_speedup"], "unit": unit,
+            "vs_baseline": 0.0, "baseline": "none_published",
+            "ttft_store_s": probe["ttft_store_s"],
+            "ttft_p2p_s": probe["ttft_p2p_s"],
+            "speedup_floor": probe["speedup_floor"],
+            "prewarm_entries": probe["prewarm_entries"],
+            "seeded_entries": probe["seeded_entries"],
+            "replicas": probe["replicas"],
+            "requests": probe["requests"],
+            "swapped_version": probe["swapped_version"],
+            "swap_ok": probe["swap_ok"],
+            "swap_failed": probe["swap_failed"],
+            "in_flight_at_swap": probe["in_flight_at_swap"],
+            "swap_token_exact_fraction": probe["swap_token_exact_fraction"],
+            "digest_mismatch_total": probe["digest_mismatch_total"],
+            "connection_total": probe["connection_total"],
+            "wall_s": round(dt, 2)}
+
+
+def run_swap_boot_probe() -> int:
+    """Innermost swap-phase probe: ONE genuinely cold replica boot, in
+    its own fresh process so no in-memory jax cache can flatter the
+    measurement. ``M2KT_SWAP_BOOT`` picks the weight source — ``store``
+    restores from the checkpoint dir in ``M2KT_SWAP_CKPT_DIR``, ``p2p``
+    streams shards over HTTP from ``M2KT_WEIGHTS_PEERS`` — and the
+    compile cache / prewarm artifact ride the production env knobs
+    (``M2KT_COMPILE_CACHE_DIR`` / ``M2KT_PREWARM_DIR``). Prints one
+    JSON line with the boot-to-first-token time."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from move2kube_tpu.models import checkpoint as m2kt_ckpt
+    from move2kube_tpu.models.compile_cache import setup_compilation_cache
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.serving.engine import (EngineConfig, Request,
+                                              ServingEngine)
+    from move2kube_tpu.serving.fleet import weights as weightslib
+
+    mode = os.environ.get("M2KT_SWAP_BOOT", "store")
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    ecfg = EngineConfig(max_batch=2, max_seq=128, block_size=8,
+                        buckets=(64,), prefix_cache=True)
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, cfg.vocab_size, size=42).tolist()
+
+    t0 = time.perf_counter()
+    setup_compilation_cache()
+    template = model.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 8), jnp.int32))
+    if mode == "p2p":
+        got = weightslib.fetch_from_peers(weightslib.peers_from_env())
+        assert got is not None, "cold boot: P2P fetch failed"
+        variables, version = got
+    else:
+        variables = m2kt_ckpt.restore_variables(
+            os.environ["M2KT_SWAP_CKPT_DIR"], template)
+        version = 1
+    eng = ServingEngine(model, variables, ecfg)
+    eng.submit(Request(rid="cold-join", prompt=list(prompt),
+                       max_new_tokens=2))
+    while eng.has_work():
+        if eng.step():
+            break
+    print(json.dumps({"ttft_s": round(time.perf_counter() - t0, 3),
+                      "source": mode, "version": int(version)}),
+          flush=True)
+    return 0
+
+
+def run_swap_probe() -> int:
+    """In-process half of the swap phase (spawned by bench_swap with jax
+    forced onto host devices). The cold-join halves run as grandchild
+    processes (``--swap-boot-probe``) so each boot is honestly cold:
+    the store boot pays checkpoint restore + full XLA compile, the P2P
+    boot streams shards over real HTTP from this process's weight plane
+    and thaws executables from the prewarm artifact the store boot's
+    cache was baked into. The live-swap chaos drill then runs in-process
+    against the fleet. Prints one JSON line."""
+    import dataclasses
+    import http.server
+    import re
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.parse
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from move2kube_tpu.models import checkpoint as m2kt_ckpt
+    from move2kube_tpu.models.compile_cache import bake_prewarm
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.obs.metrics import Registry
+    from move2kube_tpu.serving.engine import EngineConfig
+    from move2kube_tpu.serving.fleet import weights as weightslib
+    from move2kube_tpu.serving.fleet.chaos import ChaosConfig, ServingChaos
+    from move2kube_tpu.serving.fleet.router import build_fleet
+
+    # the probe owns the cache/prewarm knobs: ambient developer settings
+    # must not leak into the before/after measurement
+    for key in ("M2KT_COMPILE_CACHE", "M2KT_COMPILE_CACHE_DIR",
+                "M2KT_PREWARM_DIR", "M2KT_WEIGHTS_PEERS"):
+        os.environ.pop(key, None)
+
+    n_replicas = int(os.environ.get("M2KT_BENCH_SWAP_REPLICAS", "4"))
+    assert n_replicas >= 3, "swap drill needs >= 3 replicas/peers"
+    n_tenants = int(os.environ.get("M2KT_BENCH_SWAP_TENANTS", "4"))
+    n_requests = int(os.environ.get("M2KT_BENCH_SWAP_REQUESTS", "16"))
+    max_new = 8
+    floor = float(os.environ.get("M2KT_BENCH_SWAP_SPEEDUP_FLOOR", "1.2"))
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    ecfg = EngineConfig(max_batch=2, max_seq=128, block_size=8,
+                        buckets=(64,), prefix_cache=True)
+
+    root = tempfile.mkdtemp(prefix="m2kt-swap-")
+    ckpt_dir = os.path.join(root, "ckpt")
+    prewarm_dir = os.path.join(root, "prewarm")
+    cache_store = os.path.join(root, "cache-store")
+    cache_p2p = os.path.join(root, "cache-p2p")
+
+    # the object store a cold replica restores from when no peer serves
+    mngr = m2kt_ckpt.CheckpointManager(ckpt_dir, every=1)
+    mngr.maybe_save(0, {"params": variables["params"]}, force=True)
+    mngr.wait()
+    mngr.close()
+
+    rng = np.random.default_rng(11)
+    prefixes = [rng.integers(1, cfg.vocab_size, size=40).tolist()
+                for _ in range(n_tenants)]
+    tenant_ids = np.minimum(rng.zipf(1.6, size=n_requests), n_tenants) - 1
+    prompts = [prefixes[t] + rng.integers(1, cfg.vocab_size,
+                                          size=2).tolist()
+               for t in tenant_ids]
+
+    def cold_boot(mode, **extra_env):
+        """One genuinely cold replica boot in a grandchild process."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   JAX_PLATFORM_NAME="cpu", PALLAS_AXON_POOL_IPS="",
+                   M2KT_SWAP_BOOT=mode, M2KT_SWAP_CKPT_DIR=ckpt_dir,
+                   **extra_env)
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--swap-boot-probe"],
+            env=env, capture_output=True, text=True,
+            timeout=CHILD_TIMEOUT_S)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"{mode} boot rc={res.returncode}: {res.stderr[-300:]}")
+        return json.loads(res.stdout.strip().splitlines()[-1])
+
+    # the loaded fleet the cold replica joins: serves traffic (golden
+    # replay for the drill) and weight shards over HTTP (the P2P boot's
+    # peer — the same listener contract as the serve template's
+    # weights port)
+    router_g = build_fleet(model, variables, n_replicas,
+                           engine_config=ecfg)
+    plane = weightslib.WeightPlane(
+        router_g.replicas[0].engine.variables,
+        router_g.replicas[0].engine.weights_version)
+
+    class WeightsHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            try:
+                if self.path == "/weights/manifest":
+                    body = plane.manifest().to_bytes()
+                else:
+                    tail = urllib.parse.unquote(
+                        self.path[len("/weights/"):])
+                    body = plane.shard_bytes(tail)
+            except ValueError:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    weights_srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                  WeightsHandler)
+    threading.Thread(target=weights_srv.serve_forever,
+                     daemon=True).start()
+    weights_port = weights_srv.server_address[1]
+    try:
+        for rep in router_g.replicas:
+            rep.generate(prompts[0][:10], max_new_tokens=4)
+        golden = [list(router_g.generate(list(p), max_new_tokens=max_new,
+                                         tenant=f"tenant-{t}")["tokens"])
+                  for p, t in zip(prompts, tenant_ids)]
+
+        # boot 1 — the pre-weight-plane path: checkpoint restore + full
+        # compile into an empty cache dir (which the bake then snapshots)
+        boot_store = cold_boot("store",
+                               M2KT_COMPILE_CACHE_DIR=cache_store)
+        ttft_store = float(boot_store["ttft_s"])
+
+        baked = bake_prewarm(prewarm_dir, cache_dir=cache_store)
+        assert baked > 0, "bake_prewarm produced an empty artifact"
+
+        # boot 2 — the weight-plane path: shards streamed over HTTP
+        # from the serving fleet, executables thawed from the prewarm
+        # artifact into a fresh empty cache dir
+        boot_p2p = cold_boot(
+            "p2p", M2KT_COMPILE_CACHE_DIR=cache_p2p,
+            M2KT_PREWARM_DIR=prewarm_dir,
+            M2KT_WEIGHTS_PEERS=f"127.0.0.1:{weights_port}")
+        ttft_p2p = float(boot_p2p["ttft_s"])
+        assert boot_p2p["version"] == 1
+    finally:
+        weights_srv.shutdown()
+        for rep in router_g.replicas:
+            rep.close()
+
+    seeded = len([f for f in os.listdir(cache_p2p)
+                  if f.endswith("-cache")])
+    assert seeded > 0, "prewarm seeded nothing into the cold cache"
+    speedup = ttft_store / max(1e-9, ttft_p2p)
+    assert speedup >= floor, (
+        f"cold join via P2P+prewarm ({ttft_p2p:.2f}s) is not "
+        f"x{floor} faster than store+compile ({ttft_store:.2f}s): "
+        f"x{speedup:.2f}")
+
+    # ---- live swap under chaos: threaded replay mid-flight while the
+    # new generation streams P2P past a corrupting peer and a dying
+    # peer, then rolls across the fleet killing one replica mid-swap
+    router_c = build_fleet(model, variables, n_replicas,
+                           engine_config=ecfg)
+    reg_b = Registry()
+    try:
+        for rep in router_c.replicas:
+            rep.generate(prompts[0][:10], max_new_tokens=4)
+
+        results: list = [None] * n_requests
+        done_lock = threading.Lock()
+        done_count = [0]
+
+        def one(i):
+            out = router_c.generate(list(prompts[i]),
+                                    max_new_tokens=max_new,
+                                    tenant=f"tenant-{tenant_ids[i]}")
+            with done_lock:
+                done_count[0] += 1
+            results[i] = list(out["tokens"])
+
+        planes = [weightslib.WeightPlane(rep.engine.variables,
+                                         rep.engine.weights_version)
+                  for rep in router_c.replicas]
+        # separate exactly-once markers: a shared marker would let the
+        # first fault claim it and disarm the second
+        chaos_peers = [
+            weightslib.InProcessWeightPeer(
+                "peer-0", planes[0], chaos=ServingChaos(ChaosConfig(
+                    shard_kill_n=2,
+                    marker=os.path.join(root, "peer-kill-fired")))),
+            weightslib.InProcessWeightPeer(
+                "peer-1", planes[1], chaos=ServingChaos(ChaosConfig(
+                    shard="corrupt",
+                    marker=os.path.join(root, "corrupt-fired")))),
+        ] + [weightslib.InProcessWeightPeer(f"peer-{i}", planes[i])
+             for i in range(2, n_replicas)]
+
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            futs = [pool.submit(one, i) for i in range(n_requests)]
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                with done_lock:
+                    done_at_swap = done_count[0]
+                if done_at_swap >= max(1, n_requests // 3):
+                    break
+                time.sleep(0.01)
+            in_flight = n_requests - done_at_swap
+            assert in_flight >= 1, "replay drained before the swap fired"
+
+            fetched = weightslib.fetch_from_peers(chaos_peers,
+                                                  registry=reg_b)
+            assert fetched is not None, (
+                "P2P fetch did not survive shard corruption + peer death")
+            new_vars, _ = fetched
+
+            router_c.replicas[-1].chaos = ServingChaos(ChaosConfig(
+                swap="kill",
+                marker=os.path.join(root, "swap-kill-fired")))
+            swap_out = router_c.swap(variables=new_vars, version=2)
+            for f in futs:
+                f.result(timeout=120)
+
+        assert all(r is not None for r in results), (
+            "requests were lost across the live swap")
+        exact = sum(1 for a, b in zip(results, golden) if a == b)
+        frac = exact / n_requests
+        assert frac == 1.0, (
+            f"only {exact}/{n_requests} streams token-identical across "
+            f"the live swap")
+        for name in ("peer-kill-fired", "corrupt-fired",
+                     "swap-kill-fired"):
+            assert os.path.exists(os.path.join(root, name)), (
+                f"chaos fault {name} never fired")
+
+        def total(text, pat):
+            return sum(float(m.group(1)) for m in re.finditer(pat, text))
+
+        fetch_text = reg_b.render()
+        mismatches = total(
+            fetch_text, r'm2kt_weights_fetch_total\{[^}]*'
+                        r'reason="digest_mismatch"[^}]*\} ([0-9.e+-]+)')
+        deaths = total(
+            fetch_text, r'm2kt_weights_fetch_total\{[^}]*'
+                        r'reason="connection"[^}]*\} ([0-9.e+-]+)')
+        assert mismatches >= 1, "corrupted shard was not digest-caught"
+        assert deaths >= 1, "peer death left no connection trace"
+
+        assert swap_out["weights_version"] == 2
+        assert swap_out["failed"] == 1, (
+            f"expected exactly the chaos victim to fail its swap: "
+            f"{swap_out}")
+        assert swap_out["swapped"] == n_replicas - 1, (
+            f"swap did not roll across the survivors: {swap_out}")
+        router_text = router_c.registry.render()
+        swap_ok = total(
+            router_text, r'm2kt_router_swap_total\{[^}]*'
+                         r'outcome="ok"[^}]*\} ([0-9.e+-]+)')
+        assert swap_ok == n_replicas - 1
+        survivors = [rep for rep in router_c.replicas if rep.healthy()]
+        assert survivors and all(
+            rep.engine.weights_version == 2 for rep in survivors), (
+            "a surviving replica did not converge on the new generation")
+    finally:
+        for rep in router_c.replicas:
+            rep.close()
+
+    print(json.dumps({
+        "replicas": n_replicas, "requests": n_requests,
+        "ttft_store_s": round(ttft_store, 3),
+        "ttft_p2p_s": round(ttft_p2p, 3),
+        "cold_join_ttft_speedup": round(speedup, 3),
+        "speedup_floor": floor,
+        "prewarm_entries": int(baked),
+        "seeded_entries": int(seeded),
+        "swapped_version": 2,
+        "swap_ok": int(swap_out["swapped"]),
+        "swap_failed": int(swap_out["failed"]),
+        "in_flight_at_swap": int(in_flight),
+        "swap_token_exact_fraction": round(frac, 3),
+        "digest_mismatch_total": int(mismatches),
+        "connection_total": int(deaths),
+    }), flush=True)
+    return 0
+
+
 def bench_quant(n: int) -> dict:
     """Low-precision serving phase on forced host devices: the serving
     probe's mixed-length stream decoded at fp32, int8 weights, int8
@@ -2124,7 +2535,7 @@ def run_child(phases: list[str]) -> int:
            "scaling": bench_scaling, "serving": bench_serving,
            "fleet": bench_fleet, "quant": bench_quant,
            "kernels": bench_kernels, "obs": bench_obs,
-           "chaos": bench_chaos}
+           "chaos": bench_chaos, "swap": bench_swap}
     ok = True
     for phase in phases:
         try:
@@ -2452,7 +2863,19 @@ def main() -> int:
                         help="internal: kill/drain/deadline fault drill "
                              "with token-exact recovery gates (spawned by "
                              "the chaos phase)")
+    parser.add_argument("--swap-probe", action="store_true",
+                        help="internal: P2P cold-join TTFT vs "
+                             "store+compile, plus live-weight-swap chaos "
+                             "drill (spawned by the swap phase)")
+    parser.add_argument("--swap-boot-probe", action="store_true",
+                        help="internal: one cold replica boot to first "
+                             "token (spawned by the swap probe; "
+                             "M2KT_SWAP_BOOT picks the weight source)")
     args = parser.parse_args()
+    if args.swap_boot_probe:
+        return run_swap_boot_probe()
+    if args.swap_probe:
+        return run_swap_probe()
     if args.chaos_probe:
         return run_chaos_probe()
     if args.scaling_probe:

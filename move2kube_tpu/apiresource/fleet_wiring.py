@@ -11,7 +11,8 @@ contract, and autoscaling targets:
 - :func:`fleet_knobs` — the ``m2kt.services.<name>.serve.fleet.*`` QA
   problems (env wins: ``M2KT_FLEET`` / ``M2KT_FLEET_ROUTERS`` /
   ``M2KT_FLEET_PREFILL`` / ``M2KT_FLEET_DECODE`` /
-  ``M2KT_FLEET_AFFINITY_SALT``), asked once and cached so the optimizer
+  ``M2KT_FLEET_AFFINITY_SALT`` / ``M2KT_FLEET_SWAP`` /
+  ``M2KT_WEIGHTS_PORT``), asked once and cached so the optimizer
   pass baking the pod env, the parameterizer lifting it into chart
   values, and the emitters sizing the role workloads cannot disagree;
 - :func:`role_service` — clones the IR service into one role
@@ -149,6 +150,38 @@ def fleet_knobs(svc_name: str) -> dict | None:
                         answer, name)
             minavail = 1
     counts["minavailable"] = minavail
+    # weight plane: P2P shard streaming for joining replicas plus the
+    # zero-downtime live weight swap (serving/fleet/weights.py). On by
+    # default — with no healthy peer the fetch falls back to the store,
+    # so the knob only exists to turn the extra listener off entirely.
+    raw = os.environ.get("M2KT_FLEET_SWAP", "")
+    if raw in ("0", "1"):
+        counts["swap"] = raw == "1"
+    else:
+        counts["swap"] = qa.fetch_bool(
+            f"m2kt.services.{name}.serve.fleet.swap",
+            f"Enable [{name}]'s fleet weight plane (P2P weight "
+            "streaming + live swap)?",
+            ["Joining replicas stream parameter shards from serving "
+             "peers instead of the checkpoint store, and POST /swap "
+             "rolls new weights across the fleet without dropping "
+             "in-flight streams; override via M2KT_FLEET_SWAP"],
+            True)
+    wport = _int_env("M2KT_WEIGHTS_PORT")
+    if counts["swap"] and wport is None:
+        answer = qa.fetch_input(
+            f"m2kt.services.{name}.serve.fleet.weightsport",
+            f"Weight-plane port for [{name}]'s engine replicas",
+            ["The per-pod listener peers fetch shards from — its own "
+             "named Service port, separate from serving and metrics "
+             "traffic; override via M2KT_WEIGHTS_PORT"], "8981")
+        try:
+            wport = max(1, int(answer))
+        except (TypeError, ValueError):
+            log.warning("invalid weightsport answer %r for %s; using "
+                        "8981", answer, name)
+            wport = 8981
+    counts["weightsport"] = wport if counts["swap"] else 0
     salt = os.environ.get("M2KT_FLEET_AFFINITY_SALT", "")
     if not salt:
         salt = str(qa.fetch_input(
@@ -211,6 +244,16 @@ def role_service(svc: Service, role: str, knobs: dict) -> Service:
             # decode replicas own the refcounted prefix cache; the
             # router's session affinity only pays off if it is on
             _set_env(c, "M2KT_SERVE_PREFIX_CACHE", "1")
+        if role != ROUTER_ROLE:
+            # weight plane: every engine replica serves shards on the
+            # weights port and fetches through the decode role's
+            # headless DNS (one name fans out to every pod IP) before
+            # falling back to the checkpoint store
+            wport = int(knobs.get("weightsport", 0) or 0)
+            _set_env(c, "M2KT_WEIGHTS_PORT", str(wport))
+            if wport > 0:
+                _set_env(c, "M2KT_WEIGHTS_PEERS",
+                         f"{svc.name}-{DECODE_ROLE}:{wport}")
     if role == ROUTER_ROLE:
         clone.accelerator = None
         clone.node_selector = {
@@ -235,18 +278,28 @@ def fleet_roles(knobs: dict) -> list[str]:
 
 
 def role_headless_service(svc: Service, role: str, selector_label: str,
-                          port: int) -> dict:
+                          port: int, weights_port: int = 0) -> dict:
     """Headless Service for a backend role: DNS on ``<name>-<role>``
     answers with the *pod* IPs, which is what the router's rendezvous
     hashing needs — a ClusterIP VIP would pick a random pod per request
-    and the prefix caches would never warm."""
+    and the prefix caches would never warm.
+
+    ``weights_port`` > 0 publishes the weight plane as its own *named*
+    port: peer discovery (``M2KT_WEIGHTS_PEERS`` resolves this Service)
+    and the prometheus scrape annotations each get a distinct name
+    instead of both being inferred off the unnamed-extra-port/metrics
+    convention — an unnamed second port is also simply invalid k8s once
+    a Service has more than one."""
     name = f"{svc.name}-{role}"
     obj = make_obj("Service", "v1", name, {selector_label: svc.name,
                                            ROLE_LABEL: role})
+    ports = [{"name": "serve", "port": port}]
+    if weights_port and int(weights_port) != port:
+        ports.append({"name": "weights", "port": int(weights_port)})
     obj["spec"] = {
         "clusterIP": "None",
         "selector": {selector_label: name},
-        "ports": [{"name": "serve", "port": port}],
+        "ports": ports,
     }
     return obj
 
@@ -410,7 +463,8 @@ def maybe_fleet_objects(deployer, svc: Service,
         objs.append(dep)
         if role != ROUTER_ROLE:
             objs.append(role_headless_service(
-                svc, role, SELECTOR_LABEL, port))
+                svc, role, SELECTOR_LABEL, port,
+                weights_port=int(knobs.get("weightsport", 0) or 0)))
         objs.append(role_hpa(svc, role, clone.replicas))
         objs.append(role_pdb(svc, role, selector, min_available))
     log.info("%s: fleet mode — %d objects across roles (%s)", svc.name,

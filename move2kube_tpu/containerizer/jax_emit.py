@@ -482,6 +482,9 @@ def emit_container(service: PlanService, plan=None) -> Container:
                     "slo_max_tenants": slo_knobs["max_tenants"],
                     "compile_cache_dir": "/app/.jax-cache",
                     "metrics_port": metrics_port,
+                    # weight-plane listener default; the fleet wiring
+                    # overrides per-pod via M2KT_WEIGHTS_PORT
+                    "weights_port": 8981,
                 }))
     else:
         with open(os.path.join(_ASSETS, "train_tpu.py"),

@@ -211,6 +211,52 @@ class CheckpointManager:
         self._mngr.close()
 
 
+def _flatten_paths(tree, prefix: str = "") -> dict:
+    """``{"params/layer_0/.../kernel": leaf}`` for a plain-dict pytree —
+    the path naming the bad-array diagnostics below use."""
+    out = {}
+    if isinstance(tree, dict):
+        for key, child in tree.items():
+            out.update(_flatten_paths(
+                child, f"{prefix}/{key}" if prefix else str(key)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def _name_bad_arrays(mngr, step: int, abstract: dict) -> str:
+    """Best-effort: which array(s) made ``step`` unrestorable? The
+    checkpoint's own metadata (cheap — no array reads) is diffed against
+    the tree the caller wants: a partial write is missing leaves, a
+    stale/foreign checkpoint mismatches shapes. Empty string when the
+    metadata itself is unreadable — the caller falls back to the raw
+    restore error."""
+    try:
+        meta = mngr.item_metadata(step)
+    except Exception:  # noqa: BLE001 - metadata as corrupt as the data
+        return ""
+    if not isinstance(meta, dict):
+        meta = getattr(meta, "tree", None)
+        if not isinstance(meta, dict):
+            return ""
+    want = _flatten_paths(abstract)
+    have = _flatten_paths(meta)
+    missing = sorted(set(want) - set(have))
+    if missing:
+        return (f"missing array(s) {missing[:3]}"
+                + (f" (+{len(missing) - 3} more)" if len(missing) > 3
+                   else ""))
+    mismatched = sorted(
+        path for path in want
+        if tuple(getattr(have[path], "shape", None) or ())
+        != tuple(want[path].shape))
+    if mismatched:
+        return (f"shape-mismatched array(s) {mismatched[:3]}"
+                + (f" (+{len(mismatched) - 3} more)"
+                   if len(mismatched) > 3 else ""))
+    return ""
+
+
 def restore_variables(ckpt_dir: str, variables: dict) -> dict:
     """Restore model weights into an inference ``variables`` pytree (the
     serving entrypoint has no TrainState — just the model's init output).
@@ -218,26 +264,42 @@ def restore_variables(ckpt_dir: str, variables: dict) -> dict:
     Accepts the same checkpoint shapes the trainer writes: a full
     TrainState (its ``params`` leaf is grafted) or a params-only dict from
     ``port_weights.py``. Same corrupt-latest fallback as
-    ``restore_or_init``; with no restorable step the fresh variables come
-    back unchanged (loudly)."""
+    ``restore_or_init``: an unreadable newest step falls back to older
+    retained steps. An *empty* checkpoint dir returns the fresh variables
+    unchanged (first boot); but a dir that HAS retained steps none of
+    which restore is a corrupted store, and serving randomly initialized
+    weights behind a healthy /readyz would be silent garbage — that case
+    raises a clean ``ValueError`` naming the bad array (same contract as
+    ``KVHandoff.from_bytes``: damage surfaces as ValueError, never a raw
+    numpy/zip/orbax error from a worker thread)."""
     import orbax.checkpoint as ocp
 
-    mngr = _manager(ckpt_dir)
+    try:
+        mngr = _manager(ckpt_dir)
+        steps = sorted(mngr.all_steps(), reverse=True)
+    except Exception as err:  # noqa: BLE001 - orbax raises many types
+        raise ValueError(
+            f"checkpoint dir {ckpt_dir!r} is unreadable: "
+            f"{type(err).__name__}: {err}") from err
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
                             {"params": variables["params"]})
-    steps = sorted(mngr.all_steps(), reverse=True)
+    failures: list[tuple[int, str]] = []
     for step in steps:
         try:
             restored = mngr.restore(step, args=ocp.args.StandardRestore(abstract))
         except Exception as e:  # noqa: BLE001 - orbax raises many types
-            log.warning("checkpoint step %d unreadable (%s: %s)", step,
-                        type(e).__name__, e)
+            detail = (_name_bad_arrays(mngr, step, abstract)
+                      or f"{type(e).__name__}: {e}")
+            failures.append((step, detail))
+            log.warning("checkpoint step %d unreadable (%s)", step, detail)
             continue
         log.info("serving weights restored from checkpoint step %d", step)
         return {**variables, "params": restored["params"]}
     if steps:
-        log.error("no retained checkpoint under %r is restorable; serving "
-                  "randomly initialized weights", ckpt_dir)
+        step, detail = failures[0]
+        raise ValueError(
+            f"no retained checkpoint under {ckpt_dir!r} is restorable; "
+            f"newest step {step}: {detail}")
     return variables
 
 

@@ -15,6 +15,10 @@ Knobs:
 - ``M2KT_COMPILE_CACHE_DIR``    cache directory (wins over the caller's
   default — emitted images bake in ``/app/.jax-cache`` but operators can
   redirect to a mounted volume without editing the program)
+- ``M2KT_PREWARM_DIR``          read-only prewarm artifact: executables
+  baked into the image (or an init-container volume) under the same
+  topology-fingerprint layout; a cold replica's empty cache dir is
+  seeded from it before jax looks, so scale-up skips the compile step
 
 Executables compiled for different meshes are NOT interchangeable: the
 same train step lowered on a 1x8 fsdp mesh and a 4x2 dp x tp mesh are
@@ -85,6 +89,7 @@ def setup_compilation_cache(default_dir: str | None = None,
         os.makedirs(path, exist_ok=True)
     except OSError:
         return None  # read-only filesystem etc: run uncached, don't crash
+    seed_from_prewarm(path, fp)
 
     import jax  # deferred: the bench parent imports nothing jax-ish
 
@@ -97,4 +102,100 @@ def setup_compilation_cache(default_dir: str | None = None,
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:  # noqa: BLE001 - a jax without the knobs: uncached
         return None
+    try:
+        # keep entries RELOCATABLE: by default jax nests an XLA autotune
+        # cache inside the cache dir and bakes that absolute path into
+        # the compile options — and so into every cache key — which
+        # silently invalidates the whole cache whenever the directory
+        # path differs (a prewarm artifact baked at translate time and
+        # thawed under /app/.jax-cache, a volume remount, bench dirs)
+        jax.config.update("jax_persistent_cache_enable_xla_caches",
+                          "none")
+    except Exception:  # noqa: BLE001 - older jax: path-pinned keys
+        pass
+    try:
+        # the persistent cache initializes lazily ONCE: if anything
+        # compiled before this call (or an earlier call pointed at a
+        # different dir — the trainers' early-then-with-mesh pattern),
+        # the dir update above is silently ignored until a reset
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 - older jax: first dir sticks
+        pass
     return path
+
+
+def seed_from_prewarm(cache_dir: str, fingerprint: str = "",
+                      prewarm_dir: str | None = None) -> int:
+    """Copy baked executables into a (possibly empty) live cache dir.
+
+    The prewarm artifact (``M2KT_PREWARM_DIR``; the emitted serving
+    images bake ``/app/.jax-prewarm``) mirrors the cache layout: entries
+    for a fingerprinted topology live under ``<prewarm>/<fingerprint>``,
+    unfingerprinted ones at the top level. Only missing entries are
+    copied — the live cache (a mounted volume that already compiled) is
+    never overwritten — and any filesystem trouble degrades to an
+    ordinary cold compile. Returns the number of entries seeded."""
+    src = (prewarm_dir if prewarm_dir is not None
+           else os.environ.get("M2KT_PREWARM_DIR", ""))
+    if not src:
+        return 0
+    src = os.path.abspath(os.path.expanduser(src))
+    if fingerprint:
+        src = os.path.join(src, fingerprint)
+    if not os.path.isdir(src) or os.path.realpath(src) == \
+            os.path.realpath(cache_dir):
+        return 0
+    import shutil
+
+    seeded = 0
+    try:
+        for fname in sorted(os.listdir(src)):
+            s = os.path.join(src, fname)
+            d = os.path.join(cache_dir, fname)
+            if not os.path.isfile(s) or os.path.exists(d):
+                continue
+            shutil.copyfile(s, d)
+            seeded += 1
+    except OSError:
+        return seeded  # partial seed is still a head start
+    return seeded
+
+
+def bake_prewarm(prewarm_dir: str, mesh=None, num_slices: int = 1,
+                 cache_dir: str | None = None) -> int:
+    """The translate-time half of the prewarm story: snapshot a live,
+    populated compile cache into a prewarm artifact directory (what the
+    emitted image's ``jax-prewarm/`` build-context layer or an
+    init-container volume is filled from). Entries land under the same
+    topology fingerprint ``seed_from_prewarm`` reads, so the artifact
+    only ever thaws on matching hardware+mesh. Returns entries baked."""
+    if cache_dir is None:
+        import jax
+
+        cache_dir = jax.config.jax_compilation_cache_dir
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return 0
+    dst = os.path.abspath(os.path.expanduser(prewarm_dir))
+    fp = topology_fingerprint(mesh, num_slices=num_slices)
+    if fp:
+        dst = os.path.join(dst, fp)
+    import shutil
+
+    try:
+        os.makedirs(dst, exist_ok=True)
+    except OSError:
+        return 0
+    baked = 0
+    try:
+        for fname in sorted(os.listdir(cache_dir)):
+            s = os.path.join(cache_dir, fname)
+            d = os.path.join(dst, fname)
+            if not os.path.isfile(s) or os.path.exists(d):
+                continue
+            shutil.copyfile(s, d)
+            baked += 1
+    except OSError:
+        return baked
+    return baked

@@ -291,6 +291,19 @@ def grafana_dashboard(name: str, selector_label: str,
         panels.append(_panel(
             13, "Tenant attainment",
             f"m2kt_slo_tenant_attainment{sel}", 0, 48, "percentunit"))
+        # weight-plane row (serving/fleet/weights.py): the generation
+        # every replica is decoding with (a swap shows as a fleet-wide
+        # step; a straggler stuck on the old generation stands out), and
+        # the fetch outcomes — digest_mismatch / store fallback spikes
+        # mean peers are serving damaged shards or nobody is healthy
+        panels.append(_panel(
+            14, "Weights generation by replica",
+            f"m2kt_weights_version{sel}", 12, 48))
+        panels.append(_panel(
+            15, "Weight fetches by source / reason",
+            "sum(rate("
+            f"m2kt_weights_fetch_total{sel}[5m])) by (source, reason)",
+            0, 56))
     return {
         "title": f"move2kube-tpu: {name}",
         "uid": f"m2kt-{name}",

@@ -272,6 +272,10 @@ def tpu_fleet_optimizer(ir: IR) -> IR:
             ("M2KT_DEADLINE_S", f"{knobs['deadline']:g}"),
             ("M2KT_DRAIN_GRACE_S", f"{knobs['draingrace']:g}"),
             ("M2KT_FLEET_MIN_AVAILABLE", str(knobs["minavailable"])),
+            # weight plane: P2P shard streaming for joining replicas
+            # plus the POST /swap rolling live weight swap
+            ("M2KT_FLEET_SWAP", "1" if knobs.get("swap") else "0"),
+            ("M2KT_WEIGHTS_PORT", str(knobs.get("weightsport", 0) or 0)),
         ]
         if knobs.get("salt"):
             entries.append(("M2KT_FLEET_AFFINITY_SALT", str(knobs["salt"])))

@@ -125,7 +125,10 @@ def tpu_fleet_parameterizer(ir: IR) -> IR:
               # PodDisruptionBudgets bake the .Values ref)
               "M2KT_DEADLINE_S": "tpufleetdeadline",
               "M2KT_DRAIN_GRACE_S": "tpufleetdraingrace",
-              "M2KT_FLEET_MIN_AVAILABLE": "tpufleetminavailable"}
+              "M2KT_FLEET_MIN_AVAILABLE": "tpufleetminavailable",
+              # weight plane (P2P streaming + live swap)
+              "M2KT_FLEET_SWAP": "tpufleetswap",
+              "M2KT_WEIGHTS_PORT": "tpufleetweightsport"}
     for svc in ir.services.values():
         acc = getattr(svc, "accelerator", None)
         if acc is None or not getattr(acc, "serving", False):

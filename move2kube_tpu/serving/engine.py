@@ -228,6 +228,9 @@ class Completion:
     prompt_len: int
     tokens: list[int]
     finish_reason: str  # "eos" | "length" | "shed"
+    # the engine's weight generation at release time — a stream that
+    # rode across a live swap finishes stamped with the NEW version
+    weights_version: int = 0
 
 
 @dataclasses.dataclass
@@ -269,6 +272,9 @@ class ServingEngine:
             # are the int8 tensors
             variables = quantlib.quantize_variables(variables)
         self.variables = variables
+        # weight generation: bumped by install_weights (live swap);
+        # stamped into completions, spans, and m2kt_weights_version
+        self.weights_version = 1
         self._dq = (quantlib.dequantize_variables
                     if self.quant.quantize_weights else (lambda v: v))
         self.buckets = self.config.resolved_buckets()
@@ -293,6 +299,7 @@ class ServingEngine:
         if self.spec_k:
             draft_cfg = quantlib.draft_config(
                 model.cfg, self.config.spec_draft_factor)
+            self._draft_cfg = draft_cfg
             self._draft_model = type(model)(draft_cfg)
             self.draft_variables = quantlib.draft_variables_from(
                 self.variables, draft_cfg)
@@ -430,6 +437,10 @@ class ServingEngine:
             "m2kt_serve_quant_mode",
             "Serving quant policy (0=off, 1=int8, 2=int8-kv)")
         self._quant_mode.set(quantlib.QUANT_OPTIONS.index(self.quant.name))
+        self._weights_version_gauge = reg.gauge(
+            "m2kt_weights_version",
+            "Weight generation currently installed in the engine")
+        self._weights_version_gauge.set(self.weights_version)
         self._total_pages = max(1, self.cache_cfg.num_pages - 1)  # page 0 reserved
         self._update_occupancy()
 
@@ -860,6 +871,63 @@ class ServingEngine:
                 stall = 0
         return completions
 
+    def install_weights(self, variables, version: int | None = None) -> int:
+        """Live weight swap: replace the parameters *between* decode
+        steps without dropping in-flight requests. Every jitted step
+        (prefill/decode/verify and the draft pair) takes ``variables``
+        as a traced argument — the closures capture only the model — so
+        a same-shape tree swaps in with ZERO recompiles; the next step
+        simply decodes with the new weights. A tree whose structure,
+        shape, or dtype differs from the resident one raises
+        ``ValueError`` naming the offending shard (half-installing a
+        mismatched tree would corrupt every in-flight stream and force
+        a recompile storm).
+
+        Not safe concurrently with :meth:`step` — the fleet layer
+        serializes the swap under the replica's step lock. Returns the
+        installed version (explicit ``version`` for fleet-wide
+        agreement, else the resident version + 1)."""
+        from move2kube_tpu.serving.fleet import weights as weightslib
+
+        if self.quant.quantize_weights:
+            # same policy as construction: the executables' parameter
+            # buffers are int8 (+ scales), so that is what swaps in
+            variables = quantlib.quantize_variables(variables)
+        old = weightslib.flatten_variables(self.variables)
+        new = weightslib.flatten_variables(variables)
+        if set(old) != set(new):
+            missing = sorted(set(old) - set(new))[:3]
+            extra = sorted(set(new) - set(old))[:3]
+            raise ValueError(
+                f"install_weights: parameter tree mismatch — "
+                f"missing {missing}, unexpected {extra}")
+        for path in sorted(old):
+            if (old[path].shape != new[path].shape
+                    or old[path].dtype != new[path].dtype):
+                raise ValueError(
+                    f"install_weights: shard {path!r} is "
+                    f"{new[path].dtype}{list(new[path].shape)}; the "
+                    f"resident executables want "
+                    f"{old[path].dtype}{list(old[path].shape)}")
+        self.variables = jax.tree_util.tree_map(jnp.asarray, variables)
+        if self.spec_k:
+            # the draft shares the target's embeddings/head by pruning:
+            # re-derive so the proposer speaks the new checkpoint too
+            self.draft_variables = quantlib.draft_variables_from(
+                self.variables, self._draft_cfg)
+        if self._prefix is not None:
+            # cached prefix KV was computed under the OLD weights; a
+            # post-swap admission hitting it would decode against a KV
+            # history the new checkpoint never produced. Drop the cache
+            # (pages still borrowed by in-flight slots survive until
+            # those streams release them — that is the COW contract)
+            self._prefix.clear()
+            self._prefix_pages.set(self._prefix.total_pages)
+        self.weights_version = (int(version) if version is not None
+                                else self.weights_version + 1)
+        self._weights_version_gauge.set(self.weights_version)
+        return self.weights_version
+
     def _finish_reason(self, slot: _Slot, tok: int) -> str | None:
         if self.config.eos_id is not None and tok == self.config.eos_id:
             return "eos"
@@ -878,10 +946,12 @@ class ServingEngine:
             root = self._req_spans.pop(slot.req.rid, None)
             if root is not None:
                 self.tracer.end(root, attrs={
-                    "finish_reason": reason, "tokens": len(slot.tokens)})
+                    "finish_reason": reason, "tokens": len(slot.tokens),
+                    "weights_version": self.weights_version})
         self._update_occupancy()
         return Completion(rid=slot.req.rid, prompt_len=len(slot.req.prompt),
-                          tokens=list(slot.tokens), finish_reason=reason)
+                          tokens=list(slot.tokens), finish_reason=reason,
+                          weights_version=self.weights_version)
 
     def _bucket_for(self, plen: int) -> int:
         for b in self.buckets:

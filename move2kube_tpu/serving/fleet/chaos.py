@@ -26,10 +26,20 @@ Knobs (docs/USAGE.md):
   (a straggling replica; not marker-gated — slowness persists)
 - ``M2KT_CHAOS_FLAP_N``     — the replica's first N health probes
   report down, then it recovers (readmission/backoff drills)
+- ``M2KT_CHAOS_SHARD``      — weight-plane shard damage on the serving
+  peer: ``corrupt`` (valid wire, tampered payload — the fetcher's
+  digest check must catch it) | ``truncate`` (half the npz — must
+  surface as a clean ValueError, not a zipfile crash)
+- ``M2KT_CHAOS_SHARD_KILL_N`` — the peer dies after serving its Nth
+  weight shard (a pod SIGKILLed mid-fan-out; the fetcher must finish
+  from the surviving peers)
+- ``M2KT_CHAOS_SWAP``       — ``kill`` kills the replica inside its
+  live weight swap (mid-rolling-update death; the router marks it down
+  and the swap continues across the survivors)
 - ``M2KT_CHAOS_MARKER``     — exactly-once marker file shared with the
-  training faults' semantics: kill/handoff faults fire only while the
-  marker is absent and create it first, so the recovered attempt
-  survives. Without a marker they fire every time.
+  training faults' semantics: kill/handoff/shard/swap faults fire only
+  while the marker is absent and create it first, so the recovered
+  attempt survives. Without a marker they fire every time.
 """
 
 from __future__ import annotations
@@ -56,6 +66,9 @@ class ChaosConfig:
     handoff: str = ""              # "" | "drop" | "truncate"
     slow_s: float = 0.0            # injected latency per generate
     flap_n: int = 0                # first N probes report down
+    shard: str = ""                # "" | "corrupt" | "truncate"
+    shard_kill_n: int = 0          # peer dies after Nth shard served
+    swap: str = ""                 # "" | "kill" (die mid-weight-swap)
     marker: str = ""               # exactly-once marker path
 
     @classmethod
@@ -73,6 +86,9 @@ class ChaosConfig:
             handoff=os.environ.get("M2KT_CHAOS_HANDOFF", ""),
             slow_s=_num("M2KT_CHAOS_SLOW_S", 0.0, float),
             flap_n=_num("M2KT_CHAOS_FLAP_N", 0, int),
+            shard=os.environ.get("M2KT_CHAOS_SHARD", ""),
+            shard_kill_n=_num("M2KT_CHAOS_SHARD_KILL_N", 0, int),
+            swap=os.environ.get("M2KT_CHAOS_SWAP", ""),
             marker=os.environ.get("M2KT_CHAOS_MARKER", ""),
         )
         cfg.update(overrides)
@@ -80,7 +96,9 @@ class ChaosConfig:
 
     def armed(self) -> bool:
         return (self.kill_token is not None or bool(self.handoff)
-                or self.slow_s > 0 or self.flap_n > 0)
+                or self.slow_s > 0 or self.flap_n > 0
+                or bool(self.shard) or self.shard_kill_n > 0
+                or bool(self.swap))
 
 
 class ServingChaos:
@@ -92,6 +110,7 @@ class ServingChaos:
         self.config = config or ChaosConfig.from_env()
         self._emitted: dict[str, int] = {}   # rid -> tokens seen
         self._probes: dict[str, int] = {}    # replica -> probes seen
+        self._shards: dict[str, int] = {}    # peer -> shards served
 
     def _matches(self, rid: str) -> bool:
         return not self.config.kill_rid or self.config.kill_rid in rid
@@ -140,6 +159,45 @@ class ServingChaos:
         if mode == "truncate":
             return data[:max(1, len(data) // 2)]
         return data
+
+    def on_shard(self, peer: str, path: str, data: bytes) -> bytes:
+        """Weight-plane faults on the SERVING side of a P2P fetch: kill
+        the peer after its Nth shard, or damage one shard in flight.
+        ``corrupt`` re-encodes a tampered payload — valid wire bytes
+        with the wrong content, the exact failure only the fetcher's
+        sha256 check can catch (truncation already dies in decode)."""
+        n = self.config.shard_kill_n
+        if n > 0:
+            served = self._shards.get(peer, 0) + 1
+            self._shards[peer] = served
+            if served >= n and self._fire_once():
+                log.warning("chaos: killing peer %s after shard %d (%s)",
+                            peer, served, path)
+                print(f"[m2kt] CHAOS: peer {peer} died after "
+                      f"{served} shards", flush=True)
+                raise ChaosKill(f"{peer}: died serving shard {path}")
+        mode = self.config.shard
+        if not mode or not self._fire_once():
+            return data
+        log.warning("chaos: %s weight shard %s from %s (%d bytes)", mode,
+                    path, peer, len(data))
+        if mode == "truncate":
+            return data[:max(1, len(data) // 2)]
+        if mode == "corrupt":
+            from move2kube_tpu.serving.fleet import weights as weightslib
+
+            spath, arr = weightslib.decode_shard(data)
+            flipped = arr.copy()
+            flipped.flat[0] = -flipped.flat[0] if flipped.flat[0] else 1
+            return weightslib.encode_shard(spath, flipped)
+        return data
+
+    def on_swap(self, replica: str) -> None:
+        """Called at the top of a replica's live weight swap."""
+        if self.config.swap == "kill" and self._fire_once():
+            log.warning("chaos: killing %s mid-weight-swap", replica)
+            print(f"[m2kt] CHAOS: killed {replica} mid-swap", flush=True)
+            raise ChaosKill(f"{replica}: killed mid-weight-swap")
 
     def on_probe(self, replica: str) -> bool:
         """False while the replica should flap unhealthy."""

@@ -332,6 +332,27 @@ class InProcessReplica(ReplicaHandle):
                 "prompt_len": comp.prompt_len, "tokens": comp.tokens,
                 "finish_reason": comp.finish_reason}
 
+    def swap(self, variables=None, version: int | None = None) -> int:
+        """Live weight swap: install a new parameter tree *between*
+        decode steps — the step lock guarantees no jitted step is in
+        flight while the swap lands, and every in-flight stream simply
+        decodes its next token with the new weights. A chaos ``on_swap``
+        kill crashes the replica exactly as a pod dying mid-rolling-
+        update would: waiters fail over to the router's journal/resume
+        path, so even a swap death loses nothing."""
+        from move2kube_tpu.serving.fleet.chaos import ChaosKill
+
+        if variables is None:
+            raise ValueError(f"{self.name}: no weight source for swap")
+        try:
+            if self.chaos is not None:
+                self.chaos.on_swap(self.name)
+        except ChaosKill as err:
+            self._crash(err)
+            raise
+        with self._lock:
+            return self.engine.install_weights(variables, version)
+
     def install(self, handoff_bytes: bytes, tenant: str = "",
                 traceparent: str = "",
                 deadline_s: float | None = None) -> dict:
@@ -451,6 +472,19 @@ class HttpReplica(ReplicaHandle):
             "/install", handoff_bytes, "application/octet-stream",
             tenant=tenant, traceparent=traceparent,
             deadline_s=deadline_s).decode())
+
+    def swap(self, variables=None, version: int | None = None) -> int:
+        """POST /swap: the pod re-pulls its own weights (peers first,
+        checkpoint-store fallback) and live-installs them. A parameter
+        tree cannot ride this hop — remote swaps are pull-based."""
+        if variables is not None:
+            raise ValueError(
+                f"{self.name}: HTTP replicas pull weights themselves; "
+                "swap(variables=...) is in-process only")
+        body = json.dumps({"version": version}).encode()
+        out = json.loads(self._post(
+            "/swap", body, "application/json").decode())
+        return int(out.get("weights_version", 0))
 
     def prefill(self, request):
         """Disagg prefill over HTTP: POST the prompt, get back the
@@ -596,6 +630,13 @@ class Router:
         self._disagg = reg.counter(
             "m2kt_router_disagg_total",
             "Requests served via prefill->decode handoff")
+        self._swaps = reg.counter(
+            "m2kt_router_swap_total",
+            "Live weight-swap fan-out, by per-replica outcome",
+            labels=("outcome",))
+        # optional pull source for POST /swap with no inline tree:
+        # a callable returning (variables, version)
+        self.weight_source = None
         for r in self.replicas:
             self._replica_up.labels(replica=r.name).set(1.0)
 
@@ -668,6 +709,43 @@ class Router:
         self._up[replica.name] = False
         self._replica_up.labels(replica=replica.name).set(0.0)
         self._markdowns.labels(replica=replica.name, reason=reason).inc()
+
+    # ------------------------------------------------------------------
+    # weight plane
+    # ------------------------------------------------------------------
+
+    def swap(self, variables=None, version: int | None = None) -> dict:
+        """Roll a live weight swap across the fleet, one replica at a
+        time — the in-process analogue of a PDB-respecting rolling
+        update: at most one replica is ever inside its swap, every
+        other replica keeps serving, and unhealthy replicas are skipped
+        (they re-pull on readmission). A replica that dies mid-swap
+        (chaos ``M2KT_CHAOS_SWAP=kill``) is marked down and the roll
+        continues — its in-flight streams resume on survivors via the
+        journal, so a swap under chaos drops zero requests."""
+        if variables is None and self.weight_source is not None:
+            variables, version = self.weight_source()
+        swapped = failed = skipped = 0
+        installed = None
+        for replica in list(self.replicas):
+            if not self._up.get(replica.name, True):
+                skipped += 1
+                self._swaps.labels(outcome="skipped").inc()
+                continue
+            try:
+                installed = replica.swap(variables, version)
+                if version is None:
+                    # first success pins the generation the rest of the
+                    # roll installs, so the fleet converges on one number
+                    version = installed
+                swapped += 1
+                self._swaps.labels(outcome="ok").inc()
+            except Exception as err:  # noqa: BLE001 - keep rolling
+                self._mark_down(replica, failure_reason(err))
+                failed += 1
+                self._swaps.labels(outcome="failed").inc()
+        return {"weights_version": installed, "swapped": swapped,
+                "failed": failed, "skipped": skipped}
 
     # ------------------------------------------------------------------
     # request path
@@ -954,6 +1032,21 @@ class RouterHTTPServer:
                     self._send(404, b'{"error":"not found"}')
 
             def do_POST(self):
+                if self.path == "/swap":
+                    # fan a rolling live weight swap across the fleet;
+                    # the body may pin the generation number
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        doc = json.loads(self.rfile.read(n) or b"{}")
+                        raw = doc.get("version")
+                        out = outer.router.swap(
+                            version=int(raw) if raw is not None else None)
+                        code = 200 if out["swapped"] else 503
+                        self._send(code, json.dumps(out).encode())
+                    except Exception as err:  # noqa: BLE001
+                        self._send(500, json.dumps(
+                            {"error": str(err)}).encode())
+                    return
                 if self.path != "/generate":
                     self._send(404, b'{"error":"not found"}')
                     return

@@ -1,0 +1,651 @@
+"""Fleet weight-plane tests: P2P shard streaming, live weight swap,
+compile-cache prewarm, and their emission/Helm wiring.
+
+The load-bearing properties: (1) a joining replica streams a complete,
+digest-verified weight set from serving peers — a corrupted or
+truncated shard is re-fetched from a DIFFERENT peer, a peer killed
+mid-stream is dropped and the fetch finishes on survivors, and total
+failure degrades to ``None`` (checkpoint-store fallback) rather than
+installing damaged weights; (2) ``install_weights`` swaps a same-shape
+tree between decode steps with zero recompiles and zero effect on
+in-flight streams — asserted token- and logit-exact against an
+unfaulted run, including under int8 and with the prefix cache warm
+(whose old-weights KV must be dropped at swap time); (3) the router
+rolls a swap one replica at a time, and a replica that dies mid-swap is
+marked down while the rest of the fleet converges on the new version.
+Around that core: the npz wire framing's malformation contract (damage
+is always a clean ``ValueError``), ``restore_variables`` hardening, the
+prewarm bake/seed round trip, and the weights-port Service + Helm
+parameterization."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from move2kube_tpu.models.checkpoint import (
+    CheckpointManager,
+    restore_variables,
+)
+from move2kube_tpu.models.compile_cache import bake_prewarm, seed_from_prewarm
+from move2kube_tpu.models.llama import Llama, llama_tiny
+from move2kube_tpu.obs.metrics import Registry
+from move2kube_tpu.serving import quant as quantlib
+from move2kube_tpu.serving.engine import EngineConfig, Request, ServingEngine
+from move2kube_tpu.serving.fleet import router as routerlib
+from move2kube_tpu.serving.fleet import weights as weightslib
+from move2kube_tpu.serving.fleet.chaos import ChaosConfig, ServingChaos
+from move2kube_tpu.serving.fleet.router import build_fleet
+from move2kube_tpu.serving.fleet.weights import (
+    InProcessWeightPeer,
+    WeightManifest,
+    WeightPlane,
+    decode_shard,
+    encode_shard,
+    fetch_from_peers,
+    flatten_variables,
+    shard_digest,
+    unflatten_variables,
+)
+
+
+@pytest.fixture(scope="module")
+def llama_parts():
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, **over) -> ServingEngine:
+    cfg = EngineConfig(**{**dict(max_batch=2, max_seq=64, block_size=8,
+                                 buckets=(16, 32)), **over})
+    return ServingEngine(model, variables, cfg)
+
+
+def _tiny_tree() -> dict:
+    """A small synthetic variables tree, including a quantized-style
+    {"q8","scale"} leaf — what a peer already serving int8 would hand
+    over."""
+    rng = np.random.default_rng(7)
+    return {"params": {
+        "embed": rng.normal(size=(11, 4)).astype(np.float32),
+        "dense": {"kernel": rng.normal(size=(4, 4)).astype(np.float32),
+                  "bias": np.zeros((4,), np.float32)},
+        "head": {"q8": rng.integers(-127, 127, size=(4, 11),
+                                    dtype=np.int8),
+                 "scale": rng.uniform(0.01, 1, size=(11,))
+                 .astype(np.float32)},
+    }}
+
+
+def _assert_trees_equal(a: dict, b: dict) -> None:
+    fa, fb = flatten_variables(a), flatten_variables(b)
+    assert set(fa) == set(fb)
+    for path in fa:
+        assert fa[path].dtype == fb[path].dtype, path
+        np.testing.assert_array_equal(fa[path], fb[path], err_msg=path)
+
+
+def _fetch_count(reg: Registry, reason: str) -> float:
+    text = reg.render()
+    pat = (r'm2kt_weights_fetch_total\{[^}]*reason="' + reason
+           + r'"[^}]*\} ([0-9.e+-]+)')
+    return sum(float(m) for m in re.findall(pat, text))
+
+
+# ----------------------------------------------------------------------
+# wire format: shards, digests, manifests
+# ----------------------------------------------------------------------
+
+def test_flatten_unflatten_roundtrip():
+    tree = _tiny_tree()
+    flat = flatten_variables(tree)
+    assert "params/dense/kernel" in flat
+    assert "params/head/q8" in flat and flat["params/head/q8"].dtype \
+        == np.int8
+    _assert_trees_equal(tree, unflatten_variables(flat))
+
+
+def test_shard_roundtrip_preserves_digest():
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    path, got = decode_shard(encode_shard("params/w", arr))
+    assert path == "params/w"
+    assert got.dtype == arr.dtype and got.shape == arr.shape
+    np.testing.assert_array_equal(got, arr)
+    # digest is over decoded content, so it survives the wire
+    assert shard_digest(path, got) == shard_digest("params/w", arr)
+
+
+def test_shard_digest_sensitivity():
+    arr = np.ones((3, 3), np.float32)
+    base = shard_digest("params/w", arr)
+    tampered = arr.copy()
+    tampered[0, 0] = 2.0
+    assert shard_digest("params/w", tampered) != base
+    assert shard_digest("params/other", arr) != base
+    assert shard_digest("params/w", arr.astype(np.float64)) != base
+
+
+def test_shard_malformations_are_value_errors():
+    with pytest.raises(ValueError):
+        decode_shard(b"not an npz at all")
+    wire = encode_shard("params/w", np.ones((8, 8), np.float32))
+    with pytest.raises(ValueError):
+        decode_shard(wire[: len(wire) // 2])
+    with pytest.raises(ValueError):
+        decode_shard(b"")
+
+
+def test_manifest_roundtrip():
+    tree = _tiny_tree()
+    man = WeightManifest.of(tree, version=3)
+    back = WeightManifest.from_bytes(man.to_bytes())
+    assert back.version == 3
+    assert set(back.shards) == set(flatten_variables(tree))
+    for path, arr in flatten_variables(tree).items():
+        assert back.shards[path]["sha256"] == shard_digest(path, arr)
+        assert back.shards[path]["dtype"] == str(arr.dtype)
+        assert tuple(back.shards[path]["shape"]) == arr.shape
+
+
+def test_manifest_malformations_are_value_errors():
+    with pytest.raises(ValueError):
+        WeightManifest.from_bytes(b"garbage")
+    # a manifest with no shards is damage, not an empty fleet
+    empty = WeightManifest(version=1, shards={})
+    with pytest.raises(ValueError, match="no shards"):
+        WeightManifest.from_bytes(empty.to_bytes())
+    good = WeightManifest.of(_tiny_tree(), version=1).to_bytes()
+    with pytest.raises(ValueError):
+        WeightManifest.from_bytes(good[: len(good) // 2])
+
+
+def test_plane_unknown_shard_is_value_error():
+    plane = WeightPlane(_tiny_tree(), version=1)
+    with pytest.raises(ValueError, match="unknown weight shard"):
+        plane.shard_bytes("params/nope")
+
+
+def test_deadline_header_shared_with_router():
+    # the weight plane rides the SAME deadline budget header as every
+    # other fleet hop — drift here silently drops deadline propagation
+    assert weightslib.DEADLINE_HEADER == routerlib.DEADLINE_HEADER
+
+
+# ----------------------------------------------------------------------
+# P2P fetch: clean path, per-fault retries, fallback
+# ----------------------------------------------------------------------
+
+def test_fetch_clean_roundtrip_counts_ok():
+    tree = _tiny_tree()
+    plane = WeightPlane(tree, version=4)
+    peers = [InProcessWeightPeer("p0", plane),
+             InProcessWeightPeer("p1", plane)]
+    reg = Registry()
+    got = fetch_from_peers(peers, registry=reg)
+    assert got is not None
+    fetched, version = got
+    assert version == 4
+    _assert_trees_equal(tree, fetched)
+    assert _fetch_count(reg, "ok") == 1
+    assert _fetch_count(reg, "digest_mismatch") == 0
+
+
+def test_fetch_corrupt_shard_refetched_from_other_peer(tmp_path):
+    tree = _tiny_tree()
+    plane = WeightPlane(tree, version=1)
+    chaos = ServingChaos(ChaosConfig(shard="corrupt",
+                                     marker=str(tmp_path / "corrupt")))
+    peers = [InProcessWeightPeer("evil", plane, chaos=chaos),
+             InProcessWeightPeer("good", plane)]
+    reg = Registry()
+    got = fetch_from_peers(peers, registry=reg)
+    assert got is not None
+    _assert_trees_equal(tree, got[0])
+    # the tampered payload decoded fine — only the sha256 caught it
+    assert _fetch_count(reg, "digest_mismatch") >= 1
+    assert (tmp_path / "corrupt").exists()
+
+
+def test_fetch_truncated_shard_counts_malformed(tmp_path):
+    tree = _tiny_tree()
+    plane = WeightPlane(tree, version=1)
+    chaos = ServingChaos(ChaosConfig(shard="truncate",
+                                     marker=str(tmp_path / "trunc")))
+    peers = [InProcessWeightPeer("evil", plane, chaos=chaos),
+             InProcessWeightPeer("good", plane)]
+    reg = Registry()
+    got = fetch_from_peers(peers, registry=reg)
+    assert got is not None
+    _assert_trees_equal(tree, got[0])
+    assert _fetch_count(reg, "malformed") >= 1
+
+
+def test_fetch_peer_killed_mid_stream_finishes_on_survivor(tmp_path):
+    tree = _tiny_tree()
+    plane = WeightPlane(tree, version=1)
+    chaos = ServingChaos(ChaosConfig(shard_kill_n=2,
+                                     marker=str(tmp_path / "kill")))
+    dying = InProcessWeightPeer("dying", plane, chaos=chaos)
+    peers = [dying, InProcessWeightPeer("survivor", plane)]
+    reg = Registry()
+    got = fetch_from_peers(peers, registry=reg)
+    assert got is not None
+    _assert_trees_equal(tree, got[0])
+    assert dying._dead  # SIGKILLed pods answer nothing, not garbage
+    assert _fetch_count(reg, "connection") >= 1
+    assert _fetch_count(reg, "ok") == 1
+
+
+def test_fetch_all_peers_dead_returns_none(tmp_path):
+    plane = WeightPlane(_tiny_tree(), version=1)
+    chaos = ServingChaos(ChaosConfig(shard_kill_n=1,
+                                     marker=str(tmp_path / "kill")))
+    reg = Registry()
+    got = fetch_from_peers([InProcessWeightPeer("only", plane,
+                                                chaos=chaos)],
+                           registry=reg)
+    assert got is None  # caller falls back to the checkpoint store
+    assert _fetch_count(reg, "connection") >= 1
+    assert _fetch_count(reg, "exhausted") == 1
+    assert _fetch_count(reg, "ok") == 0
+
+
+def test_fetch_no_peers_returns_none():
+    reg = Registry()
+    assert fetch_from_peers([], registry=reg) is None
+    assert _fetch_count(reg, "no_peer") == 1
+
+
+def test_fetch_expired_deadline_returns_none():
+    plane = WeightPlane(_tiny_tree(), version=1)
+    reg = Registry()
+    got = fetch_from_peers([InProcessWeightPeer("p0", plane)],
+                           registry=reg, deadline_s=0.0)
+    assert got is None
+    assert _fetch_count(reg, "deadline") == 1
+
+
+def test_fetch_want_version_skips_stale_peers():
+    tree = _tiny_tree()
+    stale = InProcessWeightPeer("stale", WeightPlane(tree, version=1))
+    reg = Registry()
+    # rolling swap, first pod: every peer still on the old generation
+    assert fetch_from_peers([stale], registry=reg,
+                            want_version=2) is None
+    assert _fetch_count(reg, "stale") == 1
+    # later pod: an already-swapped peer serves the new generation
+    fresh = InProcessWeightPeer("fresh", WeightPlane(tree, version=2))
+    got = fetch_from_peers([stale, fresh], registry=reg, want_version=2)
+    assert got is not None and got[1] == 2
+
+
+# ----------------------------------------------------------------------
+# live weight swap: validation, stream continuity, prefix cache
+# ----------------------------------------------------------------------
+
+def test_install_weights_rejects_tree_mismatch(llama_parts):
+    model, variables = llama_parts
+    eng = _engine(model, variables)
+    flat = flatten_variables(variables)
+    victim = sorted(flat)[0]
+    del flat[victim]
+    with pytest.raises(ValueError, match="parameter tree mismatch"):
+        eng.install_weights(unflatten_variables(flat))
+    assert eng.weights_version == 1  # nothing half-installed
+
+
+def test_install_weights_rejects_shape_mismatch(llama_parts):
+    model, variables = llama_parts
+    eng = _engine(model, variables)
+    flat = flatten_variables(variables)
+    victim = sorted(flat)[0]
+    flat[victim] = np.zeros((2, 2), np.float32)
+    with pytest.raises(ValueError) as err:
+        eng.install_weights(unflatten_variables(flat))
+    assert victim in str(err.value)  # names the offending shard
+
+
+def _swap_mid_decode(model, variables, new_variables, *, quant="off",
+                     swap_after=3, version=9):
+    """Run one greedy stream, install ``new_variables`` after
+    ``swap_after`` tokens, return (tokens, stacked logits)."""
+    eng = _engine(model, variables, quant=quant)
+    eng.capture_logits = True
+    rng = np.random.default_rng(3)
+    req = Request("swap-req", rng.integers(1, 200, size=12).tolist(), 8)
+    eng.submit(req)
+    comps = []
+    swapped = False
+    for _ in range(64):
+        comps.extend(eng.step())
+        slot = next((s for s in eng._slots if s is not None), None)
+        if (not swapped and slot is not None
+                and len(slot.tokens) >= swap_after):
+            assert eng.install_weights(new_variables,
+                                       version=version) == version
+            swapped = True
+        if comps:
+            break
+    assert swapped and len(comps) == 1
+    assert eng.weights_version == version
+    return comps[0].tokens, np.stack(eng.logit_log["swap-req"])
+
+
+def test_swap_mid_decode_fp32_stream_exact(llama_parts):
+    """The zero-downtime contract at its sharpest: installing the SAME
+    weights mid-decode must be invisible — token- and logit-identical
+    to an uninterrupted run (same-shape swap, zero recompiles)."""
+    model, variables = llama_parts
+    gold = _engine(model, variables)
+    gold.capture_logits = True
+    rng = np.random.default_rng(3)
+    req = Request("swap-req", rng.integers(1, 200, size=12).tolist(), 8)
+    [gc] = gold.run([req])
+    gold_logits = np.stack(gold.logit_log["swap-req"])
+
+    tokens, logits = _swap_mid_decode(model, variables, variables)
+    assert tokens == gc.tokens
+    np.testing.assert_allclose(logits, gold_logits, atol=1e-5, rtol=1e-5)
+
+
+def test_swap_mid_decode_int8_logit_gated(llama_parts):
+    """Same continuity under the int8 policy: the engine re-quantizes
+    the incoming fp32 tree with the construction-time policy, so a
+    mid-decode swap of the same checkpoint stays stream-exact — gated
+    on logits through the quant harness, like the bench."""
+    model, variables = llama_parts
+    gold = _engine(model, variables, quant="int8")
+    gold.capture_logits = True
+    rng = np.random.default_rng(3)
+    req = Request("swap-req", rng.integers(1, 200, size=12).tolist(), 8)
+    [gc] = gold.run([req])
+    gold_logits = np.stack(gold.logit_log["swap-req"])
+
+    tokens, logits = _swap_mid_decode(model, variables, variables,
+                                      quant="int8")
+    assert tokens == gc.tokens
+    gate = quantlib.logit_gate(gold_logits, logits)
+    assert gate["top1_agreement"] == 1.0
+    assert gate["max_rel_err"] < 0.05
+
+
+def test_swap_accepts_already_quantized_tree(llama_parts):
+    """Peers serve their RESIDENT tree — under int8 that is q8+scale
+    leaves. Installing it into another int8 engine must work as-is
+    (quantize_variables is idempotent on quantized leaves)."""
+    model, variables = llama_parts
+    src = _engine(model, variables, quant="int8")
+    dst = _engine(model, variables, quant="int8")
+    resident = unflatten_variables(flatten_variables(src.variables))
+    assert dst.install_weights(resident, version=5) == 5
+    rng = np.random.default_rng(3)
+    req = Request("r", rng.integers(1, 200, size=10).tolist(), 4)
+    [a] = src.run([req])
+    [b] = dst.run([req])
+    assert a.tokens == b.tokens
+
+
+def test_swap_flushes_prefix_cache(llama_parts):
+    """KV cached under the old weights is wrong under the new ones: a
+    swap must drop the prefix cache, and a post-swap request sharing
+    the old prompt prefix must decode as if freshly prefitted with the
+    new checkpoint — not against stale cached KV."""
+    model, variables = llama_parts
+    new_vars = jax.tree_util.tree_map(
+        lambda a: (np.asarray(a) * 1.25).astype(np.asarray(a).dtype),
+        variables)
+    eng = _engine(model, variables, prefix_cache=True)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, 200, size=16).tolist()
+    eng.run([Request("warm", prompt, 4)])
+    assert eng._prefix.total_pages > 0  # the prefix is cached
+    eng.install_weights(new_vars, version=2)
+    assert eng._prefix.total_pages == 0  # ...and dropped at swap time
+    [post] = eng.run([Request("post", prompt, 4)])
+
+    fresh = _engine(model, new_vars, prefix_cache=True)
+    [ref] = fresh.run([Request("post", prompt, 4)])
+    assert post.tokens == ref.tokens
+
+
+def test_router_swap_rolls_fleet_under_chaos(llama_parts, tmp_path):
+    """The rolling swap: one replica at a time, a mid-swap death is
+    marked down (its streams resume via the journal elsewhere), the
+    survivors converge on the pinned version, and a later roll skips
+    the downed replica instead of failing on it again."""
+    model, variables = llama_parts
+    router = build_fleet(model, variables, 3,
+                         engine_config=EngineConfig(
+                             max_batch=2, max_seq=64, block_size=8,
+                             buckets=(16, 32)))
+    try:
+        marker = tmp_path / "swap-kill"
+        router.replicas[-1].chaos = ServingChaos(
+            ChaosConfig(swap="kill", marker=str(marker)))
+        out = router.swap(variables=variables, version=5)
+        assert out == {"weights_version": 5, "swapped": 2, "failed": 1,
+                       "skipped": 0}
+        assert marker.exists()
+        text = router.registry.render()
+        assert re.search(
+            r'm2kt_router_swap_total\{[^}]*outcome="ok"[^}]*\} 2', text)
+        assert re.search(
+            r'm2kt_router_swap_total\{[^}]*outcome="failed"[^}]*\} 1',
+            text)
+        for rep in router.replicas[:-1]:
+            assert rep.engine.weights_version == 5
+        # the dead replica never installed the new generation
+        assert router.replicas[-1].engine.weights_version == 1
+        out2 = router.swap(variables=variables, version=6)
+        assert out2["skipped"] == 1 and out2["failed"] == 0
+    finally:
+        for r in router.replicas:
+            r.close()
+
+
+# ----------------------------------------------------------------------
+# checkpoint-store fallback hardening
+# ----------------------------------------------------------------------
+
+def test_restore_variables_empty_dir_is_first_boot(llama_parts, tmp_path):
+    _model, variables = llama_parts
+    out = restore_variables(str(tmp_path / "empty"), variables)
+    _assert_trees_equal(variables, out)
+
+
+def test_restore_variables_unreadable_dir(llama_parts, tmp_path):
+    _model, variables = llama_parts
+    bogus = tmp_path / "not-a-dir"
+    bogus.write_text("i am a file where a directory should be")
+    with pytest.raises(ValueError, match="unreadable"):
+        restore_variables(str(bogus / "ckpt"), variables)
+
+
+@pytest.mark.parametrize("mode", ["truncate", "remove"])
+def test_restore_variables_corrupt_store_names_damage(
+        llama_parts, tmp_path, mode):
+    from move2kube_tpu.resilience import faults
+
+    _model, variables = llama_parts
+    ckpt = str(tmp_path / "ckpt")
+    mngr = CheckpointManager(ckpt, every=1)
+    mngr.maybe_save(0, {"params": variables["params"]}, force=True)
+    mngr.wait()
+    mngr.close()
+    faults.corrupt_latest(ckpt, mode=mode)
+    with pytest.raises(ValueError, match="restorable") as err:
+        restore_variables(ckpt, variables)
+    # serving random init behind a healthy /readyz would be silent
+    # garbage; the error must say WHICH step is damaged
+    assert "step 0" in str(err.value)
+
+
+# ----------------------------------------------------------------------
+# compile-cache prewarm: bake at translate time, seed at boot
+# ----------------------------------------------------------------------
+
+def test_prewarm_bake_and_seed_roundtrip(tmp_path):
+    cache = tmp_path / "cache"
+    cache.mkdir()
+    (cache / "jit_decode-deadbeef-cache").write_bytes(b"x" * 32)
+    (cache / "jit_prefill-cafef00d-cache").write_bytes(b"y" * 32)
+    prewarm = tmp_path / "prewarm"
+    assert bake_prewarm(str(prewarm), cache_dir=str(cache)) == 2
+    # re-bake copies nothing: the artifact is never overwritten
+    assert bake_prewarm(str(prewarm), cache_dir=str(cache)) == 0
+
+    cold = tmp_path / "cold"
+    cold.mkdir()
+    (cold / "jit_decode-deadbeef-cache").write_bytes(b"local" * 8)
+    assert seed_from_prewarm(str(cold), "", str(prewarm)) == 1
+    # a live cache entry (already compiled) is never clobbered
+    assert (cold / "jit_decode-deadbeef-cache").read_bytes() \
+        == b"local" * 8
+    assert (cold / "jit_prefill-cafef00d-cache").read_bytes() == b"y" * 32
+    # second seed: everything present, nothing copied
+    assert seed_from_prewarm(str(cold), "", str(prewarm)) == 0
+
+
+def test_prewarm_seed_missing_artifact_is_noop(tmp_path):
+    cold = tmp_path / "cold"
+    cold.mkdir()
+    assert seed_from_prewarm(str(cold), "", str(tmp_path / "absent")) == 0
+
+
+# ----------------------------------------------------------------------
+# emission: weights port Service wiring + Helm parameterization
+# ----------------------------------------------------------------------
+
+def _serving_ir():
+    from move2kube_tpu.types.ir import IR, Service
+    from move2kube_tpu.types.plan import AcceleratorInfo
+
+    svc = Service(
+        name="llm",
+        containers=[{
+            "name": "llm", "image": "llm:latest",
+            "ports": [{"containerPort": 8080},
+                      {"name": "metrics", "containerPort": 9090}],
+            "env": [{"name": "M2KT_METRICS_PORT", "value": "9090"}],
+        }],
+        accelerator=AcceleratorInfo(serving=True, serving_port=8080,
+                                    tpu_accelerator="tpu-v5-lite-podslice",
+                                    tpu_topology="2x2"),
+    )
+    return IR(services={"llm": svc}), svc
+
+
+def _fleet_env(monkeypatch, swap="1", wport="8981"):
+    monkeypatch.setenv("M2KT_FLEET", "1")
+    monkeypatch.setenv("M2KT_FLEET_ROUTERS", "1")
+    monkeypatch.setenv("M2KT_FLEET_PREFILL", "1")
+    monkeypatch.setenv("M2KT_FLEET_DECODE", "3")
+    monkeypatch.setenv("M2KT_FLEET_AFFINITY_SALT", "blue")
+    monkeypatch.setenv("M2KT_FLEET_SWAP", swap)
+    monkeypatch.setenv("M2KT_WEIGHTS_PORT", wport)
+
+
+def test_headless_service_names_weights_port():
+    from move2kube_tpu.apiresource.fleet_wiring import role_headless_service
+
+    _ir, svc = _serving_ir()
+    obj = role_headless_service(svc, "decode", "m2kt/svc", 8080,
+                                weights_port=8981)
+    assert obj["spec"]["clusterIP"] == "None"
+    ports = {p["name"]: p["port"] for p in obj["spec"]["ports"]}
+    assert ports == {"serve": 8080, "weights": 8981}
+    # weights sharing the serve port collapses to one port (a second
+    # entry with a duplicate port number is invalid k8s)
+    obj = role_headless_service(svc, "decode", "m2kt/svc", 8080,
+                                weights_port=8080)
+    assert [p["name"] for p in obj["spec"]["ports"]] == ["serve"]
+    obj = role_headless_service(svc, "decode", "m2kt/svc", 8080)
+    assert [p["name"] for p in obj["spec"]["ports"]] == ["serve"]
+
+
+def test_fleet_emission_publishes_weights_plane(monkeypatch):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    _fleet_env(monkeypatch)
+    ir, _svc = _serving_ir()
+    objs = DeploymentAPIResource().create_new_resources(
+        ir, {"Deployment", "JobSet"})
+    by = {(o["kind"], o["metadata"]["name"]): o for o in objs}
+    dsvc = by[("Service", "llm-decode")]
+    ports = {p["name"]: p["port"] for p in dsvc["spec"]["ports"]}
+    assert ports["weights"] == 8981
+    decode_env = {e["name"]: e["value"] for e in
+                  by[("Deployment", "llm-decode")]["spec"]["template"]
+                  ["spec"]["containers"][0]["env"]}
+    assert decode_env["M2KT_WEIGHTS_PORT"] == "8981"
+    # joining replicas resolve peers through decode's headless DNS
+    assert decode_env["M2KT_WEIGHTS_PEERS"] == "llm-decode:8981"
+    router_env = {e["name"]: e["value"] for e in
+                  by[("Deployment", "llm-router")]["spec"]["template"]
+                  ["spec"]["containers"][0]["env"]}
+    assert "M2KT_WEIGHTS_PEERS" not in router_env
+
+
+def test_fleet_emission_swap_off_drops_weights_port(monkeypatch):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    _fleet_env(monkeypatch, swap="0")
+    ir, _svc = _serving_ir()
+    objs = DeploymentAPIResource().create_new_resources(
+        ir, {"Deployment", "JobSet"})
+    by = {(o["kind"], o["metadata"]["name"]): o for o in objs}
+    assert [p["name"] for p in
+            by[("Service", "llm-decode")]["spec"]["ports"]] == ["serve"]
+    decode_env = {e["name"]: e["value"] for e in
+                  by[("Deployment", "llm-decode")]["spec"]["template"]
+                  ["spec"]["containers"][0]["env"]}
+    assert decode_env.get("M2KT_WEIGHTS_PORT", "0") == "0"
+
+
+def test_swap_knobs_helm_lift_roundtrip(monkeypatch):
+    import yaml
+
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+    from move2kube_tpu.passes.optimize import tpu_fleet_optimizer
+    from move2kube_tpu.passes.parameterize import tpu_fleet_parameterizer
+
+    _fleet_env(monkeypatch)
+    ir, svc = _serving_ir()
+    ir = tpu_fleet_optimizer(ir)
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_FLEET_SWAP"] == "1"
+    assert env["M2KT_WEIGHTS_PORT"] == "8981"
+    ir = tpu_fleet_parameterizer(ir)
+    gv = ir.values.global_variables
+    assert gv["tpufleetswap"] == "1"
+    assert gv["tpufleetweightsport"] == "8981"
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_FLEET_SWAP"] == "{{ .Values.tpufleetswap }}"
+    assert env["M2KT_WEIGHTS_PORT"] == \
+        "{{ .Values.tpufleetweightsport }}"
+    # idempotent: a second pass must not double-wrap the refs
+    ir = tpu_fleet_parameterizer(ir)
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_WEIGHTS_PORT"] == \
+        "{{ .Values.tpufleetweightsport }}"
+
+    # the emitted chart renders back to valid YAML with the values
+    # substituted the way `helm install --set tpufleetweightsport=9000`
+    # would hand them over
+    objs = DeploymentAPIResource().create_new_resources(
+        ir, {"Deployment", "JobSet"})
+    text = yaml.safe_dump_all(objs)
+    rendered = text.replace("{{ .Values.tpufleetswap }}", "1") \
+        .replace("{{ .Values.tpufleetweightsport }}", "9000")
+    assert "{{" not in rendered.replace("{{ .Values.tpufleet", "XX")
+    docs = list(yaml.safe_load_all(rendered))
+    assert any(d["kind"] == "Deployment" for d in docs)
