@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke slo-smoke chaos-smoke swap-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -178,6 +178,15 @@ chaos-smoke:
 swap-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_weights.py -q
 	$(CPU_ENV) $(PY) bench.py --model swap
+
+# numerics plane in isolation (CPU-mode): in-graph tensor-health
+# summaries + non-finite forensics drill + quant-drift auditor + the
+# translation numerics-diff harness, then the bench numerics phase
+# (in-graph recording overhead gated at <= 3% of step time + one live
+# drift audit on a clean int8 engine)
+numerics-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_numerics.py -q
+	$(CPU_ENV) $(PY) bench.py --model numerics
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -68,7 +68,7 @@ MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
 PHASES = ("resnet", "bert", "pallas", "llama", "translate", "goodput",
           "scaling", "serving", "fleet", "quant", "kernels", "obs",
-          "chaos", "swap")
+          "chaos", "swap", "numerics")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
@@ -86,6 +86,7 @@ PHASE_METRICS = {
     "obs": ("telemetry_overhead_fraction", "fraction"),
     "chaos": ("chaos_recovered_token_exact_fraction", "fraction"),
     "swap": ("swap_cold_join_ttft_speedup", "x"),
+    "numerics": ("numerics_telemetry_overhead_fraction", "fraction"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
@@ -2487,6 +2488,179 @@ def run_obs_probe() -> int:
     return 0
 
 
+def bench_numerics(n: int) -> dict:
+    """Tensor-health-plane guard (PR 15): the tiny-LM step with the
+    in-graph per-layer-group numerics summaries recording + StepTelemetry
+    read-back vs the same chain with recording off, plus one live
+    quant-drift audit on an int8 engine. FAILS when the numerics plane
+    costs more than OBS_OVERHEAD_MAX of step time, when the auditor
+    never fires, or when a *clean* int8 engine already reads as drifted
+    (the alert floor would be noise, not signal). Own subprocess for the
+    same platform-env reason as the obs phase."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--numerics-probe"],
+        env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"numerics probe rc={res.returncode}: {res.stderr[-300:]}")
+    probe = json.loads(res.stdout.strip().splitlines()[-1])
+    dt = time.perf_counter() - t0
+    overhead = probe["numerics_overhead_fraction"]
+    if overhead > OBS_OVERHEAD_MAX:
+        raise RuntimeError(
+            f"numerics-plane overhead {overhead:.1%} exceeds the "
+            f"{OBS_OVERHEAD_MAX:.0%} budget "
+            f"(base {probe['baseline_step_ms']:.2f}ms vs instrumented "
+            f"{probe['instrumented_step_ms']:.2f}ms per step)")
+    if probe["drift_audits"] < 1:
+        raise RuntimeError("quant-drift auditor never fired at rate=1.0")
+    if probe["drift_clean_rel"] >= probe["drift_threshold"]:
+        raise RuntimeError(
+            f"clean int8 engine reads as drifted "
+            f"({probe['drift_clean_rel']:.4f} >= "
+            f"{probe['drift_threshold']} alert floor)")
+    print(f"[bench] numerics overhead {overhead:.2%} "
+          f"({probe['baseline_step_ms']:.2f}ms -> "
+          f"{probe['instrumented_step_ms']:.2f}ms/step, "
+          f"{probe['groups']} layer groups), clean int8 drift "
+          f"{probe['drift_clean_rel']:.4f} in {dt:.1f}s",
+          file=sys.stderr)
+    metric, unit = PHASE_METRICS["numerics"]
+    # no published baseline: the phase is an overhead budget guard
+    return {"phase": "numerics", "metric": metric, "value": overhead,
+            "unit": unit, "vs_baseline": 0.0, "baseline": "none_published",
+            "overhead_budget": OBS_OVERHEAD_MAX,
+            "baseline_step_ms": probe["baseline_step_ms"],
+            "instrumented_step_ms": probe["instrumented_step_ms"],
+            "steps_per_run": probe["steps"],
+            "layer_groups": probe["groups"],
+            "drift_clean_rel": probe["drift_clean_rel"],
+            "drift_audits": probe["drift_audits"],
+            "wall_s": round(dt, 2)}
+
+
+def run_numerics_probe() -> int:
+    """In-process half of the numerics phase. Times the tiny-LM step
+    with the tensor-health recorder ON (in-graph summaries + per-step
+    StepTelemetry read-back into the gauges) vs OFF — the identity-state
+    chain, so both sides compile the same opt-state pytree — interleaved
+    min-of-4 like the obs probe. Then runs one audited prefill on a
+    clean int8 engine and prints one JSON line."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from move2kube_tpu.models import train as m2kt_train
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.obs import numerics as numericslib
+    from move2kube_tpu.obs.metrics import Registry
+    from move2kube_tpu.obs.rules import THRESHOLDS
+    from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32)
+    model = Llama(cfg)
+    mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    # larger token budget than the obs probe: the summaries' cost is
+    # PARAM-bound (a few fixed passes over weights+grads, ~3ms here)
+    # while step cost is TOKEN-bound, so a toy 4x64 batch makes a
+    # constant cost read as a fat fraction (+8% measured) that no real
+    # workload would see. 8x256 is still tiny but token-shaped enough
+    # for the fraction to be honest.
+    batch, seq, steps = 8, 256, 10
+    ids = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
+                             cfg.vocab_size)
+
+    def make_state(record):
+        tx = optax.chain(m2kt_train.grad_norm_recorder(),
+                         numericslib.health_recorder(record=record),
+                         optax.adamw(3e-4))
+        return m2kt_train.create_sharded_state(
+            jax.random.PRNGKey(1), model, {"input_ids": ids}, tx, mesh)
+
+    step = m2kt_train.make_lm_train_step(mesh, remat=False)
+
+    def make_telem(numerics_on):
+        # StepTelemetry resolves M2KT_NUMERICS at construction
+        os.environ["M2KT_NUMERICS"] = "1" if numerics_on else "0"
+        return m2kt_train.StepTelemetry(registry=Registry(),
+                                        items_per_step=batch * seq,
+                                        tracer=False)
+
+    def run(record):
+        state = make_state(record)
+        telem = make_telem(record)
+        state, loss = step(state, {"input_ids": ids})  # compile
+        jax.block_until_ready(loss)
+        per_step = []
+        for i in range(1, steps + 1):
+            ts = time.perf_counter()
+            state, loss = step(state, {"input_ids": ids})
+            loss = jax.block_until_ready(loss)
+            dt = time.perf_counter() - ts
+            # worst case: EVERY step reads the health vectors back (the
+            # emitted trainer syncs every 10th step)
+            telem.record_step(i, dt, loss=float(loss), state=state)
+            per_step.append(time.perf_counter() - ts)
+        return per_step
+
+    # interleaved rounds, then min over PER-STEP durations (read-back
+    # included): loop totals on a loaded host are dominated by scheduler
+    # noise — a 10-step block absorbs whole load spikes and min-of-4
+    # totals still mis-measured this plane by 30+ms/step — while the
+    # fastest single step each variant ever achieves is the honest
+    # unloaded cost (see run_obs_probe for the interleaving rationale)
+    base_steps: list[float] = []
+    inst_steps: list[float] = []
+    for r in range(4):
+        # alternate order each round so load ramping WITHIN a round
+        # can't systematically tax one variant
+        for rec in ((False, True) if r % 2 == 0 else (True, False)):
+            (inst_steps if rec else base_steps).extend(run(rec))
+    base = min(base_steps) * steps
+    instrumented = min(inst_steps) * steps
+    overhead = max(0.0, instrumented / base - 1.0)
+    groups = len(numericslib.group_index(
+        make_state(False).params)[0])
+
+    # live quant-drift audit: every cold admission on a clean int8
+    # engine re-runs through the fp reference; the drift must sit well
+    # under the alert floor or M2KTQuantDriftHigh is unusable
+    from move2kube_tpu.serving.engine import (
+        EngineConfig, Request, ServingEngine,
+    )
+
+    svars = model.init(jax.random.PRNGKey(2),
+                       jnp.zeros((1, 8), jnp.int32))
+    eng = ServingEngine(model, svars, EngineConfig(
+        max_batch=2, max_seq=32, block_size=8, buckets=(8,),
+        quant="int8", quant_audit_rate=1.0))
+    eng.run([Request("audit", [1, 2, 3, 4], 2)])
+    stats = eng.stats()
+
+    print(json.dumps({
+        "numerics_overhead_fraction": round(overhead, 4),
+        "baseline_step_ms": round(base / steps * 1e3, 3),
+        "instrumented_step_ms": round(instrumented / steps * 1e3, 3),
+        "steps": steps,
+        "groups": groups,
+        "drift_audits": stats.get("quant_audits", 0),
+        "drift_clean_rel": round(stats.get("quant_drift_max_rel", 0.0), 5),
+        "drift_threshold": float(THRESHOLDS["tpunumdriftmax"]),
+    }), flush=True)
+    return 0
+
+
 def _setup_compile_cache() -> None:
     """Persistent XLA compile cache for this child: a re-spawned child
     (retry, OOM batch-halving) deserializes the previous child's
@@ -2535,7 +2709,8 @@ def run_child(phases: list[str]) -> int:
            "scaling": bench_scaling, "serving": bench_serving,
            "fleet": bench_fleet, "quant": bench_quant,
            "kernels": bench_kernels, "obs": bench_obs,
-           "chaos": bench_chaos, "swap": bench_swap}
+           "chaos": bench_chaos, "swap": bench_swap,
+           "numerics": bench_numerics}
     ok = True
     for phase in phases:
         try:
@@ -2859,6 +3034,10 @@ def main() -> int:
     parser.add_argument("--obs-probe", action="store_true",
                         help="internal: telemetry overhead + exposition "
                              "scrape measurement (spawned by the obs phase)")
+    parser.add_argument("--numerics-probe", action="store_true",
+                        help="internal: tensor-health-plane overhead + "
+                             "live quant-drift audit (spawned by the "
+                             "numerics phase)")
     parser.add_argument("--chaos-probe", action="store_true",
                         help="internal: kill/drain/deadline fault drill "
                              "with token-exact recovery gates (spawned by "
@@ -2890,6 +3069,8 @@ def main() -> int:
         return run_kernels_probe()
     if args.obs_probe:
         return run_obs_probe()
+    if args.numerics_probe:
+        return run_numerics_probe()
     if args.child:
         return run_child(args.child.split(","))
     if args.opportunistic:

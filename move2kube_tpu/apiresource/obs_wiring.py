@@ -110,6 +110,50 @@ def plan_report_enabled(svc_name: str) -> bool:
         False)
 
 
+def numerics_enabled(svc_name: str) -> bool:
+    """The ``m2kt.services.<name>.obs.numerics`` QA knob — asked with
+    the same id by ``tpu_numerics_optimizer`` (baking ``M2KT_NUMERICS``
+    into the pod env) and jax_emit (baking the template default), so
+    one cached answer keeps env and emitted source agreed. Default on:
+    the in-graph summaries are fused into the compiled step and the
+    bench ``numerics`` phase bounds the overhead at <= 3%."""
+    from move2kube_tpu import qa
+    from move2kube_tpu.utils import common
+
+    name = common.make_dns_label(svc_name)
+    return qa.fetch_bool(
+        f"m2kt.services.{name}.obs.numerics",
+        f"Enable the tensor-health numerics plane for [{name}]?",
+        ["Per-layer-group rms/max-abs/non-finite gauges, skipped-step "
+         "accounting, and NaN forensics into the flight recorder "
+         "(training); sampled fp-reference quant-drift audits "
+         "(serving). <= 3% step overhead, gated in the bench"],
+        True)
+
+
+def numerics_audit_rate(svc_name: str) -> str:
+    """The ``m2kt.services.<name>.obs.numerics.auditrate`` QA knob:
+    fraction of cold serving admissions replayed through the fp
+    reference path (``M2KT_QUANT_AUDIT_RATE``). Only meaningful for
+    quantized serving; the engine ignores it otherwise."""
+    from move2kube_tpu import qa
+    from move2kube_tpu.utils import common
+
+    name = common.make_dns_label(svc_name)
+    raw = qa.fetch_input(
+        f"m2kt.services.{name}.obs.numerics.auditrate",
+        f"Quant-drift audit rate for [{name}] (0 disables)?",
+        ["Fraction of cold admissions whose prefill is replayed through "
+         "retained fp weights, exporting max-rel logit error as "
+         "m2kt_serve_quant_drift; the fp copy roughly doubles resident "
+         "params, so keep the rate small"],
+        "0.01")
+    try:
+        return str(min(1.0, max(0.0, float(raw))))
+    except (TypeError, ValueError):
+        return "0.01"
+
+
 def maybe_rules_objects(svc: Service, ir: IR,
                         selector_label: str) -> list[dict]:
     """PrometheusRule + Grafana dashboard ConfigMap next to the

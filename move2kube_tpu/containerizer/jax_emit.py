@@ -327,6 +327,22 @@ def _ask_slo_knobs(name: str) -> dict:
     return knobs
 
 
+def _ask_numerics_knobs(name: str, serving: bool) -> dict:
+    """Numerics-plane knobs, via the SAME cached QA ids
+    ``passes/optimize.py``'s tpu_numerics_optimizer asks
+    (``apiresource.obs_wiring.numerics_enabled`` / ``_audit_rate``) —
+    the template's baked-in default and the pod env always agree."""
+    from move2kube_tpu.apiresource.obs_wiring import (
+        numerics_audit_rate,
+        numerics_enabled,
+    )
+
+    knobs = {"numerics": "1" if numerics_enabled(name) else "0"}
+    knobs["quant_audit_rate"] = (numerics_audit_rate(name)
+                                 if serving else "0")
+    return knobs
+
+
 def _ask_obs_port(name: str) -> int:
     """Telemetry (/metrics) port as a QA problem. Same ID as
     ``passes/optimize.py``'s tpu_observability_optimizer — asked once,
@@ -456,6 +472,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
     num_slices = max(1, acc.num_slices)
     elastic, elastic_min_slices = (
         (False, 1) if serving else _ask_elastic_knobs(name, num_slices))
+    numerics_knobs = _ask_numerics_knobs(name, serving)
     if serving:
         acc.serving_port = serve_port
         serve_knobs = _ask_serving_knobs(name)
@@ -480,6 +497,8 @@ def emit_container(service: PlanService, plan=None) -> Container:
                     "slo_ttft_p95": slo_knobs["ttft_p95"],
                     "slo_availability": slo_knobs["availability"],
                     "slo_max_tenants": slo_knobs["max_tenants"],
+                    "numerics": numerics_knobs["numerics"],
+                    "quant_audit_rate": numerics_knobs["quant_audit_rate"],
                     "compile_cache_dir": "/app/.jax-cache",
                     "metrics_port": metrics_port,
                     # weight-plane listener default; the fleet wiring
@@ -514,6 +533,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
                 "precision": precision,
                 "grad_accum": grad_accum,
                 "moe_experts": moe_experts,
+                "numerics": numerics_knobs["numerics"],
                 # in-image default; pods that mount a durable volume point
                 # M2KT_COMPILE_CACHE_DIR at it to survive restarts
                 "compile_cache_dir": "/app/.jax-cache",

@@ -102,6 +102,47 @@ def policy(name: str) -> PrecisionPolicy:
         ) from None
 
 
+def find_apply_if_finite_state(state):
+    """The ``optax.apply_if_finite`` state inside an (arbitrarily
+    nested) optimizer state, or None when no loss-scaled wrapper is
+    active. Duck-typed on the state's field names rather than the optax
+    class so an optax rename can't silently kill telemetry; recursion
+    covers ``chain`` tuples and wrapper ``inner_state`` fields."""
+
+    def find(node):
+        if (hasattr(node, "total_notfinite")
+                and hasattr(node, "notfinite_count")):
+            return node
+        if isinstance(node, (tuple, list)):
+            for item in node:
+                hit = find(item)
+                if hit is not None:
+                    return hit
+        inner = getattr(node, "inner_state", None)
+        if inner is not None:
+            return find(inner)
+        return None
+
+    return find(getattr(state, "opt_state", state))
+
+
+def skipped_updates(state) -> int | None:
+    """Cumulative updates ``apply_if_finite`` swallowed because the
+    (scaled) gradients went non-finite — the number StepTelemetry
+    surfaces as ``m2kt_train_skipped_steps_total`` instead of letting
+    those steps vanish silently. None when no wrapper is active."""
+    hit = find_apply_if_finite_state(state)
+    return int(hit.total_notfinite) if hit is not None else None
+
+
+def notfinite_streak(state) -> int | None:
+    """Consecutive non-finite updates so far (resets on a finite one);
+    ``apply_if_finite`` raises after its ``max_consecutive_errors``, so
+    a climbing streak is the early warning."""
+    hit = find_apply_if_finite_state(state)
+    return int(hit.notfinite_count) if hit is not None else None
+
+
 def from_env(default: str = "bf16", env=None) -> PrecisionPolicy:
     """``M2KT_PRECISION`` names the policy; ``M2KT_LOSS_SCALE`` (float)
     overrides its loss scale. Unknown names fall back to ``default``

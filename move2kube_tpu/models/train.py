@@ -9,6 +9,7 @@ state, sharding-constrained batches, and loss in float32.
 from __future__ import annotations
 
 import functools
+import math
 import time
 from typing import Any, Callable, NamedTuple
 
@@ -505,11 +506,17 @@ def grad_norm_recorder() -> optax.GradientTransformation:
 
 def instrument_optimizer(
         tx: optax.GradientTransformation) -> optax.GradientTransformation:
-    """Chain the grad-norm recorder in front of ``tx``. NOTE: this changes
-    the opt-state pytree structure — wrap unconditionally (not gated on a
-    telemetry flag) so checkpoints stay restorable when telemetry is
-    toggled between runs."""
-    return optax.chain(grad_norm_recorder(), tx)
+    """Chain the grad-norm and tensor-health recorders in front of
+    ``tx``. NOTE: this changes the opt-state pytree structure — wrap
+    unconditionally (not gated on a telemetry flag) so checkpoints stay
+    restorable when telemetry is toggled between runs; the health
+    recorder's state shape is likewise identical whether ``M2KT_NUMERICS``
+    is on or off. Both sit OUTSIDE any ``apply_if_finite`` wrapper ``tx``
+    carries, so a skipped non-finite update is still recorded — that is
+    the step the forensics exist for."""
+    from move2kube_tpu.obs import numerics
+
+    return optax.chain(grad_norm_recorder(), numerics.health_recorder(), tx)
 
 
 def grad_norm_from_state(state) -> float | None:
@@ -582,6 +589,40 @@ class StepTelemetry:
         self._compile_seconds = reg.counter(
             "m2kt_train_compile_seconds_total",
             "Wall seconds spent in observed compile events")
+        # tensor-health plane (obs/numerics.py): per-layer-group gauges
+        # fed from the health recorder's opt-state slot at sync points.
+        # Cardinality is groups x {grad, param}, bounded by the same
+        # max_series overflow contract the tenant families use.
+        from move2kube_tpu.obs import numerics as numericslib
+        self._numerics = numericslib
+        self._numerics_on = numericslib.enabled()
+        cap = 2 * numericslib.max_groups()
+        self._tensor_rms = reg.gauge(
+            "m2kt_train_tensor_rms",
+            "Per-layer-group rms over finite entries",
+            labels=("group", "kind"), max_series=cap + 1)
+        self._tensor_max_abs = reg.gauge(
+            "m2kt_train_tensor_max_abs",
+            "Per-layer-group max |x| (Inf shows as Inf)",
+            labels=("group", "kind"), max_series=cap + 1)
+        self._tensor_nonfinite = reg.gauge(
+            "m2kt_train_tensor_nonfinite",
+            "Per-layer-group non-finite entry count (last recorded step)",
+            labels=("group", "kind"), max_series=cap + 1)
+        self._nonfinite_steps = reg.counter(
+            "m2kt_train_nonfinite_steps_total",
+            "Recorded steps carrying a non-finite gradient, parameter, "
+            "or loss")
+        self._skipped_steps = reg.counter(
+            "m2kt_train_skipped_steps_total",
+            "Updates apply_if_finite skipped over non-finite (scaled) "
+            "gradients")
+        self._loss_scale_gauge = reg.gauge(
+            "m2kt_train_loss_scale",
+            "Active loss scale (0 = no scaling)")
+        self._group_names: list[str] | None = None
+        self._skipped_seen = 0
+        self._last_bad_group: str | None = None
         # filled by record_cost_model; record_step then keeps the MFU
         # gauge live from measured wall times
         self._cost_report = None
@@ -641,6 +682,11 @@ class StepTelemetry:
             norm = grad_norm_from_state(state)
             if norm is not None:
                 self._grad_norm.set(norm)
+            if self._numerics_on:
+                try:
+                    self._record_numerics(step, state, loss)
+                except Exception:  # noqa: BLE001 - never kill a run
+                    pass
         if (self._cost_report is not None and self._chip_spec is not None
                 and seconds > 0):
             mfu = self._cost_report.mfu(seconds, self._chip_spec)
@@ -651,6 +697,66 @@ class StepTelemetry:
                     "(0 = unknown)").set(mfu)
         if step % self.mem_every == 0:
             self.record_device_memory()
+
+    def record_precision(self, policy) -> None:
+        """Export the resolved precision policy's loss scale — call once
+        at loop start; the skipped-step counter then tracks what
+        ``apply_if_finite`` does with it."""
+        try:
+            self._loss_scale_gauge.set(float(policy.loss_scale))
+        except (AttributeError, TypeError, ValueError):
+            pass
+
+    def _record_numerics(self, step: int, state, loss) -> None:
+        """Tensor-health read-back (sync points only — ``record_step``
+        gates on ``state is not None``): six small vectors cross to
+        host, the gauges update per group, and a non-finite step dumps
+        the ``<flight>.numerics`` forensics sidecar naming the first bad
+        layer group."""
+        from move2kube_tpu.models import precision as precisionlib
+        numerics = self._numerics
+        health = numerics.health_from_state(state)
+        if health is None:
+            return
+        if self._group_names is None:
+            self._group_names = numerics.group_index(state.params)[0]
+        doc = numerics.summary(self._group_names, health)
+        for group, fields in doc.items():
+            for kind in ("grad", "param"):
+                self._tensor_rms.labels(group, kind).set(
+                    fields[f"{kind}_rms"])
+                self._tensor_max_abs.labels(group, kind).set(
+                    fields[f"{kind}_max_abs"])
+                self._tensor_nonfinite.labels(group, kind).set(
+                    fields[f"{kind}_nonfinite"])
+        skipped = precisionlib.skipped_updates(state)
+        if skipped is not None and skipped > self._skipped_seen:
+            self._skipped_steps.inc(skipped - self._skipped_seen)
+            self._skipped_seen = skipped
+        loss_bad = False
+        if loss is not None:
+            try:
+                loss_bad = not math.isfinite(float(loss))
+            except (TypeError, ValueError):
+                loss_bad = False
+        bad = numerics.first_bad_group(doc)
+        if bad is None and not loss_bad:
+            self._last_bad_group = None
+            return
+        self._nonfinite_steps.inc()
+        self._last_bad_group = bad or "loss"
+        if self.tracer is not None:
+            now = time.perf_counter()
+            self.tracer.record("train.numerics.nonfinite", now, now,
+                               attrs={"step": step,
+                                      "group": self._last_bad_group})
+        numerics.write_sidecar({
+            "step": step,
+            "first_bad_group": self._last_bad_group,
+            "loss_nonfinite": loss_bad,
+            "skipped_updates": skipped or 0,
+            "groups": doc,
+        })
 
     def record_device_memory(self) -> None:
         try:

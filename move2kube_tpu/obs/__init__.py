@@ -26,6 +26,11 @@ and role tagging (:mod:`tracing`), the ``/traces`` drain endpoint
 stitching (:mod:`fleetview`), and the per-tenant SLO/burn-rate ledger
 (:mod:`slo`).
 
+PR 15 adds the numerics plane (:mod:`numerics`): in-graph per-layer-
+group tensor-health summaries recorded into the optimizer state,
+non-finite forensics with a first-bad-layer sidecar the flight recorder
+folds in, and the serving quant-drift audit knobs.
+
 Stdlib-only on import (jax is loaded lazily, only for profiling and
 device-memory reads) so the whole package vendors into emitted images.
 """
@@ -62,6 +67,21 @@ from move2kube_tpu.obs.metrics import (
     Registry,
     default_registry,
 )
+from move2kube_tpu.obs.numerics import (
+    HEALTH_FIELDS,
+    TensorHealthState,
+    first_bad_group,
+    group_index,
+    health_from_state,
+    health_recorder,
+    read_sidecar,
+    sidecar_path,
+    summarize_tree,
+    write_sidecar,
+)
+from move2kube_tpu.obs.numerics import audit_rate as quant_audit_rate
+from move2kube_tpu.obs.numerics import enabled as numerics_enabled
+from move2kube_tpu.obs.numerics import summary as numerics_summary
 from move2kube_tpu.obs.slo import (
     SLOSpec,
     SLOTracker,
@@ -134,4 +154,17 @@ __all__ = [
     "normalize_accelerator",
     "write_memory_snapshot",
     "write_plan_report",
+    "HEALTH_FIELDS",
+    "TensorHealthState",
+    "first_bad_group",
+    "group_index",
+    "health_from_state",
+    "health_recorder",
+    "numerics_enabled",
+    "numerics_summary",
+    "quant_audit_rate",
+    "read_sidecar",
+    "sidecar_path",
+    "summarize_tree",
+    "write_sidecar",
 ]

@@ -36,6 +36,10 @@ THRESHOLDS = {
     "tpuslofastburn": "14.4",      # fast-burn page threshold
     "tpusloslowburn": "6",         # slow-burn ticket threshold
     "tpuslottftp95": "0.5",        # per-tenant TTFT p95 objective, s
+    # numerics plane (obs/numerics.py): max-rel logit error the serving
+    # quant-drift auditor may report before alarming — matches the
+    # int8 tier of the build-time logit gates
+    "tpunumdriftmax": "0.05",
 }
 
 
@@ -143,8 +147,50 @@ def prometheus_rule(name: str, selector_label: str,
                     "plan report's fsdp re-split suggestion."),
             },
         },
+        {
+            "alert": "M2KTNonFiniteSteps",
+            # any skipped update or recorded non-finite step in the
+            # window: apply_if_finite absorbs a handful silently, but a
+            # training run producing NaNs is diverging — read the
+            # numerics block of m2kt-flight.json for the first bad
+            # layer group. No threshold knob: zero is the budget.
+            "expr": (
+                f"increase(m2kt_train_skipped_steps_total{sel}[30m]) > 0 "
+                f"or increase(m2kt_train_nonfinite_steps_total{sel}"
+                "[30m]) > 0"),
+            "for": "0m",
+            "labels": {"severity": "warning", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: non-finite training steps",
+                "description": (
+                    "Gradients, parameters, or the loss went NaN/Inf. "
+                    "m2kt_train_tensor_nonfinite names the layer group; "
+                    "the <flight>.numerics sidecar (folded into "
+                    "m2kt-flight.json) holds the full per-group tensor "
+                    "health of the bad step. Check the loss scale "
+                    "(m2kt_train_loss_scale) before blaming the data."),
+            },
+        },
     ]
     if serving:
+        rules.append({
+            "alert": "M2KTQuantDriftHigh",
+            "expr": (f"m2kt_serve_quant_drift{sel} "
+                     f"> {th['tpunumdriftmax']}"),
+            "for": "5m",
+            "labels": {"severity": "critical", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: quantized logits drifting from fp",
+                "description": (
+                    "The runtime quant-drift audit (sampled cold "
+                    "prefills replayed through the fp reference "
+                    "weights) exceeds the build-time logit-gate "
+                    "budget — an int8 scale pool is corrupted or a "
+                    "weight swap installed a damaged shard. Roll back "
+                    "the weights generation (m2kt_weights_version) or "
+                    "disable quantization."),
+            },
+        })
         rules.append({
             "alert": "M2KTServeQueueDeep",
             "expr": (f"m2kt_serve_queue_depth{sel} "
@@ -265,6 +311,19 @@ def grafana_dashboard(name: str, selector_label: str,
                f"m2kt_train_mfu{sel}", 0, 16, "percentunit"),
         _panel(8, "Peak HBM by category",
                f"m2kt_hbm_peak_bytes{sel}", 12, 16, "bytes"),
+        # numerics row (obs/numerics.py): per-layer-group tensor health
+        # and what apply_if_finite is doing with the loss scale
+        _panel(16, "Gradient rms by layer group",
+               f'm2kt_train_tensor_rms{{kind="grad",{sel[1:-1]}}}',
+               0, 64),
+        _panel(17, "Non-finite entries by layer group",
+               f"m2kt_train_tensor_nonfinite{sel}", 12, 64),
+        _panel(18, "Skipped / non-finite steps (30m)",
+               f"increase(m2kt_train_skipped_steps_total{sel}[30m]) "
+               f"or increase(m2kt_train_nonfinite_steps_total{sel}"
+               "[30m])", 0, 72),
+        _panel(19, "Loss scale",
+               f"m2kt_train_loss_scale{sel}", 12, 72),
     ]
     if serving:
         panels.append(_panel(
@@ -304,6 +363,9 @@ def grafana_dashboard(name: str, selector_label: str,
             "sum(rate("
             f"m2kt_weights_fetch_total{sel}[5m])) by (source, reason)",
             0, 56))
+        panels.append(_panel(
+            20, "Quant drift (max-rel logit error, audited prefills)",
+            f"m2kt_serve_quant_drift{sel}", 12, 56))
     return {
         "title": f"move2kube-tpu: {name}",
         "uid": f"m2kt-{name}",

@@ -435,6 +435,40 @@ def tpu_planreport_optimizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_numerics_optimizer(ir: IR) -> IR:
+    """Bake the numerics-plane env into accelerated services behind the
+    ``m2kt.services.<name>.obs.numerics`` QA knob
+    (``apiresource.obs_wiring.numerics_enabled`` — shared + cached, so
+    jax_emit's template default and the pod env agree). Training pods
+    get ``M2KT_NUMERICS``; serving pods additionally get the
+    quant-drift audit rate (``M2KT_QUANT_AUDIT_RATE``, its own sub-knob
+    — the fp reference copy is a deliberate memory spend). A knob
+    answered off bakes ``M2KT_NUMERICS=0`` explicitly rather than
+    omitting it: the runtime default is on, and the pod env must record
+    the decision. Existing env entries are never overwritten."""
+    from move2kube_tpu.apiresource.obs_wiring import (
+        numerics_audit_rate,
+        numerics_enabled,
+    )
+
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None:
+            continue
+        entries = [("M2KT_NUMERICS",
+                    "1" if numerics_enabled(svc.name) else "0")]
+        if getattr(acc, "serving", False):
+            entries.append(("M2KT_QUANT_AUDIT_RATE",
+                            numerics_audit_rate(svc.name)))
+        for container in svc.containers:
+            env = container.setdefault("env", [])
+            existing = {e.get("name") for e in env}
+            for env_name, value in entries:
+                if env_name not in existing:
+                    env.append({"name": env_name, "value": value})
+    return ir
+
+
 OPTIMIZERS = [
     normalize_character_optimizer,
     ingress_optimizer,
@@ -448,6 +482,7 @@ OPTIMIZERS = [
     tpu_observability_optimizer,
     tpu_slo_optimizer,
     tpu_planreport_optimizer,
+    tpu_numerics_optimizer,
 ]
 
 

@@ -223,6 +223,35 @@ def tpu_slo_parameterizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_numerics_parameterizer(ir: IR) -> IR:
+    """Lift the numerics-plane env the numerics optimizer injected into
+    chart values: ``M2KT_NUMERICS`` -> ``tpunumerics`` (any accelerated
+    service) and ``M2KT_QUANT_AUDIT_RATE`` -> ``tpuquantauditrate``
+    (serving), so a Helm install can kill the plane or retune the audit
+    sampling (``--set tpuquantauditrate=0.1``) without a rebuild. The
+    alert floor for the drift the audits report lives with the other
+    rule thresholds (``tpunumdriftmax``, seeded by
+    ``tpu_rules_parameterizer`` off obs/rules.py THRESHOLDS)."""
+    lifted = {
+        "M2KT_NUMERICS": "tpunumerics",
+        "M2KT_QUANT_AUDIT_RATE": "tpuquantauditrate",
+    }
+    for svc in ir.services.values():
+        if getattr(svc, "accelerator", None) is None:
+            continue
+        for container in svc.containers:
+            for env in container.get("env", []) or []:
+                key = lifted.get(env.get("name"))
+                if key is None:
+                    continue
+                value = env.get("value")
+                if value is None or "{{" in str(value):
+                    continue
+                ir.values.global_variables.setdefault(key, str(value))
+                env["value"] = "{{ .Values.%s }}" % key
+    return ir
+
+
 def tpu_rules_parameterizer(ir: IR) -> IR:
     """Lift the alert-rule thresholds (obs/rules.py ``THRESHOLDS``) into
     chart values for every service whose ``m2kt.services.<name>.obs.rules``
@@ -257,7 +286,7 @@ PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
                   tpu_serving_parameterizer, tpu_fleet_parameterizer,
                   tpu_elastic_parameterizer,
                   tpu_obs_parameterizer, tpu_slo_parameterizer,
-                  tpu_rules_parameterizer]
+                  tpu_numerics_parameterizer, tpu_rules_parameterizer]
 
 
 def parameterize(ir: IR) -> IR:

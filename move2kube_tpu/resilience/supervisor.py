@@ -370,6 +370,18 @@ class Supervisor:
                 memory = json.load(f)
         except (OSError, ValueError):
             pass
+        # numerics forensics (obs/numerics.py): the child's
+        # <flight>.numerics sidecar names the first layer group that
+        # went non-finite plus the per-group tensor health of that step
+        # — written by StepTelemetry the moment a NaN/Inf was recorded,
+        # so it survives even a child that died before the next sync.
+        numerics_doc: dict = {}
+        try:
+            with open(tracing.flight_path() + ".numerics",
+                      encoding="utf-8") as f:
+                numerics_doc = json.load(f)
+        except (OSError, ValueError):
+            pass
         tail = self._attempts[-1].get("stderr_tail", "") \
             if self._attempts else ""
         flight = {
@@ -385,6 +397,7 @@ class Supervisor:
                       "ring_seconds", "dropped")} if ring else {},
             "spans": ring.get("spans", []),
             "memory": memory,
+            "numerics": numerics_doc,
         }
         path = tracing.flight_path()
         try:

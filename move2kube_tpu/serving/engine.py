@@ -78,6 +78,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from move2kube_tpu.obs import numerics as numericslib
 from move2kube_tpu.obs import slo as slolib
 from move2kube_tpu.obs import tracing
 from move2kube_tpu.obs.metrics import Registry
@@ -145,6 +146,12 @@ class EngineConfig:
     prefix_cache: bool = False
     prefix_max_suffix: int = 0  # 0 -> 2 * block_size
     quant: str = "off"         # off | int8 | int8-kv (serving/quant.py)
+    # quant-drift audit: fraction of cold admissions whose prefill is
+    # re-run through the retained fp reference weights, exporting
+    # max-rel logit error as m2kt_serve_quant_drift — the runtime
+    # counterpart of the build-time logit gates (catches a corrupted
+    # int8 scale pool in production). 0 = off, no fp copy kept.
+    quant_audit_rate: float = 0.0
     spec_k: int = 0            # draft proposals per step; 0 = no spec decode
     # draft depth divisor: num_layers // factor layers (1 = full-depth
     # draft — acceptance 1.0, useful as a correctness anchor)
@@ -184,6 +191,7 @@ class EngineConfig:
                                    cls.prefix_max_suffix),
             quant=(lambda q: q if q in quantlib.QUANT_OPTIONS else "off")(
                 os.environ.get("M2KT_SERVE_QUANT", "") or cls.quant),
+            quant_audit_rate=numericslib.audit_rate(),
             spec_k=max(0, _int("M2KT_SPEC_K", cls.spec_k)),
         )
         cfg.update(overrides)
@@ -266,6 +274,18 @@ class ServingEngine:
         self.mesh = mesh
         self.decode_matmul = select_decode_matmul(mesh)
         self.quant = quantlib.policy(self.config.quant)
+        # quant-drift auditor: retain the pre-quant fp weights so a
+        # sampled fraction of cold prefills can be replayed through the
+        # reference path at runtime. Only when quantizing AND auditing —
+        # the fp copy roughly doubles resident parameters, a price paid
+        # knowingly via M2KT_QUANT_AUDIT_RATE.
+        self._audit_rate = (max(0.0, min(1.0, self.config.quant_audit_rate))
+                            if self.quant.quantize_weights else 0.0)
+        self._audit_fp_variables = variables if self._audit_rate else None
+        self._audit_apply = None   # lazily jitted fp prefill
+        self._audit_accum = 0.0    # deterministic rate accumulator
+        self._drift_last = 0.0
+        self._drift_max = 0.0
         if self.quant.quantize_weights:
             # once, at construction: the jitted steps dequantize INSIDE
             # the compiled program, so the executables' parameter buffers
@@ -437,6 +457,13 @@ class ServingEngine:
             "m2kt_serve_quant_mode",
             "Serving quant policy (0=off, 1=int8, 2=int8-kv)")
         self._quant_mode.set(quantlib.QUANT_OPTIONS.index(self.quant.name))
+        self._quant_drift = reg.gauge(
+            "m2kt_serve_quant_drift",
+            "Max-rel logit error of the last audited prefill vs the fp "
+            "reference weights (0 until a request is audited)")
+        self._quant_audits = reg.counter(
+            "m2kt_serve_quant_audit_total",
+            "Cold admissions replayed through the fp reference path")
         self._weights_version_gauge = reg.gauge(
             "m2kt_weights_version",
             "Weight generation currently installed in the engine")
@@ -890,6 +917,10 @@ class ServingEngine:
         from move2kube_tpu.serving.fleet import weights as weightslib
 
         if self.quant.quantize_weights:
+            if self._audit_rate:
+                # the drift auditor must reference the NEW checkpoint,
+                # or every post-swap audit would report false drift
+                self._audit_fp_variables = variables
             # same policy as construction: the executables' parameter
             # buffers are int8 (+ scales), so that is what swaps in
             variables = quantlib.quantize_variables(variables)
@@ -1084,6 +1115,45 @@ class ServingEngine:
         self._update_occupancy()
         return True, []
 
+    def _maybe_audit_quant(self, rid: str, ids: np.ndarray, plen: int,
+                           logits0) -> None:
+        """Quant-drift audit of a cold prefill: replay the padded prompt
+        through the retained fp reference weights and compare the prompt
+        rows' logits (serving/quant.py's ``logit_gate`` — the same
+        metric the build-time tiers gate on). Sampling is a
+        deterministic rate accumulator, not an RNG: an audit rate of
+        0.1 audits exactly every 10th cold admission, so tests and
+        replays see identical audit schedules. Best-effort — the audit
+        must never fail a request it rides on."""
+        self._audit_accum += self._audit_rate
+        if self._audit_accum < 1.0:
+            return
+        self._audit_accum -= 1.0
+        try:
+            t0 = time.perf_counter()
+            if self._audit_apply is None:
+                model = self.model
+                self._audit_apply = jax.jit(
+                    lambda v, x: model.apply(v, x))
+            ref = self._audit_apply(self._audit_fp_variables,
+                                    jnp.asarray(ids))
+            gate = quantlib.logit_gate(np.asarray(ref[0, :plen]),
+                                       np.asarray(logits0[:plen]))
+            drift = float(gate["max_rel_err"])
+        except Exception:  # noqa: BLE001 - telemetry never fails serving
+            return
+        self._drift_last = drift
+        self._drift_max = max(self._drift_max, drift)
+        self._quant_drift.set(drift)
+        self._quant_audits.inc()
+        root = self._req_spans.get(rid)
+        if self.tracer is not None and root is not None:
+            self.tracer.record(
+                "serve.quant_audit", t0, time.perf_counter(),
+                attrs={"max_rel_err": drift,
+                       "top1_agreement": float(gate["top1_agreement"])},
+                trace_id=root.trace_id, parent_id=root.span_id)
+
     def _admit_cold(self, req: Request, slot_idx: int, plen: int,
                     max_new: int) -> tuple[bool, list[Completion]]:
         bs = self.cache_cfg.block_size
@@ -1151,6 +1221,8 @@ class ServingEngine:
         if self.capture_logits:
             self.logit_log.setdefault(req.rid, []).append(
                 np.asarray(logits0[plen - 1]).copy())
+        if self._audit_rate:
+            self._maybe_audit_quant(req.rid, ids, plen, logits0)
         slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
                      max_new=max_new)
         self._slots[slot_idx] = slot
@@ -1461,6 +1533,10 @@ class ServingEngine:
             out["prefix_hit_tokens"] = int(self._prefix_hit_tokens.value)
             out["prefix_cache_pages"] = self._prefix.total_pages
             out["cow_copies"] = int(self._cow_copies.value)
+        if self._audit_rate:
+            out["quant_audits"] = int(self._quant_audits.value)
+            out["quant_drift_last_rel"] = self._drift_last
+            out["quant_drift_max_rel"] = self._drift_max
         if self.spec_k:
             prop = self._spec_proposed.value
             acc = self._spec_accepted.value

@@ -214,4 +214,32 @@ class Gpu2TpuTranslator(Translator):
                 svc.restart_policy = declared or "Never"
             svc.containers.append(container_def)
             ir.add_service(svc)
+            if not serving:
+                self._maybe_validate_numerics(plan_svc)
         return ir
+
+    @staticmethod
+    def _maybe_validate_numerics(plan_svc: PlanService) -> None:
+        """Opt-in (``M2KT_NUMERICS_VALIDATE=1``) translate-time numerics
+        diff: run the translated trainer semantics against the source's
+        declared ones on synthetic batches and drop
+        ``m2kt-numerics-report.{json,md}`` next to the source. Best
+        effort — a translate box without jax skips, it never blocks the
+        translation itself (the report is the trust artifact, the gate
+        is the harness CLI / CI)."""
+        if os.environ.get("M2KT_NUMERICS_VALIDATE", "0") != "1":
+            return
+        src_dirs = plan_svc.source_artifacts.get(
+            PlanService.SOURCE_DIR_ARTIFACT, [])
+        if not src_dirs:
+            return
+        try:
+            from move2kube_tpu.source import validate
+
+            report = validate.validate_translation(
+                src_dir=src_dirs[0], out_dir=src_dirs[0])
+            log.info("gpu2tpu %s: numerics validation %s",
+                     plan_svc.service_name, report["verdict"])
+        except Exception as e:  # noqa: BLE001
+            log.warning("gpu2tpu %s: numerics validation skipped: %s",
+                        plan_svc.service_name, e)

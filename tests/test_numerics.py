@@ -1,0 +1,454 @@
+"""Numerics observability plane: in-graph tensor-health summaries and
+their optax recorder, the non-finite forensics drill (first bad layer
+group named in the ``<flight>.numerics`` sidecar + supervisor fold),
+skipped-step / loss-scale accounting, the serving quant-drift auditor,
+the translation numerics-diff harness's pass/fail gates, and the QA
+knob -> optimizer pass -> Helm parameterization wiring."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from move2kube_tpu.models import precision as precisionlib
+from move2kube_tpu.models.llama import Llama, llama_tiny
+from move2kube_tpu.models.train import StepTelemetry, instrument_optimizer
+from move2kube_tpu.obs import numerics
+from move2kube_tpu.obs.metrics import Registry
+from move2kube_tpu.obs.rules import (
+    THRESHOLDS,
+    grafana_dashboard,
+    prometheus_rule,
+)
+from move2kube_tpu.qa import engine as qaengine
+from move2kube_tpu.serving.engine import EngineConfig, Request, ServingEngine
+from move2kube_tpu.types.ir import IR, Service
+from move2kube_tpu.types.plan import AcceleratorInfo
+
+
+def _params():
+    return {
+        "embed": {"w": jnp.asarray([1.0, -2.0, 2.0], jnp.float32)},
+        "blocks_0": {"k": jnp.asarray([[3.0, -3.0]], jnp.float32)},
+        "blocks_1": {"k": jnp.asarray([0.5], jnp.float32)},
+    }
+
+
+# ----------------------------------------------------------------------
+# in-graph summaries
+# ----------------------------------------------------------------------
+
+
+def test_group_index_skips_collection_wrappers():
+    names, leaf_groups = numerics.group_index({"params": _params()})
+    assert names == ["blocks_0", "blocks_1", "embed"]  # flatten order
+    assert len(leaf_groups) == 3
+
+
+def test_summarize_tree_matches_jnp_reference():
+    tree = _params()
+    names, leaf_groups = numerics.group_index(tree)
+    rms, max_abs, nonfinite = numerics.summarize_tree(
+        tree, leaf_groups, len(names))
+    by = dict(zip(names, range(len(names))))
+    embed = np.asarray([1.0, -2.0, 2.0])
+    assert rms[by["embed"]] == pytest.approx(
+        float(np.sqrt((embed ** 2).mean())))
+    assert float(max_abs[by["embed"]]) == 2.0
+    assert float(max_abs[by["blocks_0"]]) == 3.0
+    assert np.asarray(nonfinite).sum() == 0
+
+
+def test_summarize_tree_nonfinite_and_integer_leaves():
+    tree = {
+        "a": {"w": jnp.asarray([1.0, jnp.inf, jnp.nan], jnp.float32)},
+        "b": {"ids": jnp.asarray([7, 8], jnp.int32),  # skipped: integer
+              "w": jnp.asarray([4.0], jnp.float32)},
+    }
+    names, leaf_groups = numerics.group_index(tree)
+    rms, max_abs, nonfinite = numerics.summarize_tree(
+        tree, leaf_groups, len(names))
+    by = dict(zip(names, range(len(names))))
+    # rms over the FINITE entries only — the magnitude signal survives
+    assert rms[by["a"]] == pytest.approx(math.sqrt(1.0 / 3.0))
+    assert math.isinf(float(max_abs[by["a"]]))  # raw |x|: Inf shows
+    assert int(nonfinite[by["a"]]) == 2
+    assert int(nonfinite[by["b"]]) == 0
+    assert float(max_abs[by["b"]]) == 4.0
+
+
+def test_first_bad_group_names_earliest_in_tree_order():
+    doc = {
+        "blocks_0": {"grad_nonfinite": 0.0, "param_nonfinite": 0.0},
+        "blocks_1": {"grad_nonfinite": 3.0, "param_nonfinite": 0.0},
+        "embed": {"grad_nonfinite": 1.0, "param_nonfinite": 0.0},
+    }
+    assert numerics.first_bad_group(doc) == "blocks_1"
+    clean = {k: {"grad_nonfinite": 0.0, "param_nonfinite": 0.0}
+             for k in doc}
+    assert numerics.first_bad_group(clean) is None
+
+
+# ----------------------------------------------------------------------
+# optimizer-state recorder
+# ----------------------------------------------------------------------
+
+
+def test_health_recorder_through_instrumented_chain():
+    params = _params()
+    tx = instrument_optimizer(optax.sgd(0.1))
+    opt_state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    _, opt_state = tx.update(grads, opt_state, params)
+    state = types.SimpleNamespace(params=params, opt_state=opt_state)
+    health = numerics.health_from_state(state)
+    assert health is not None
+    names, _ = numerics.group_index(params)
+    doc = numerics.summary(names, health)
+    assert set(doc) == {"embed", "blocks_0", "blocks_1"}
+    assert doc["embed"]["grad_rms"] == pytest.approx(1.0)
+    assert doc["embed"]["param_max_abs"] == pytest.approx(2.0)
+    assert doc["blocks_0"]["param_max_abs"] == pytest.approx(3.0)
+
+
+def test_health_recorder_off_keeps_state_shape():
+    """record=False must keep the opt-state pytree identical to the
+    recording chain — toggling M2KT_NUMERICS can never strand a
+    checkpoint."""
+    params = _params()
+    on = optax.chain(numerics.health_recorder(record=True), optax.sgd(0.1))
+    off = optax.chain(numerics.health_recorder(record=False), optax.sgd(0.1))
+    s_on, s_off = on.init(params), off.init(params)
+    assert (jax.tree_util.tree_structure(s_on)
+            == jax.tree_util.tree_structure(s_off))
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    _, s_off = off.update(grads, s_off, params)
+    health = numerics.health_from_state(types.SimpleNamespace(
+        params=params, opt_state=s_off))
+    assert float(np.asarray(health.grad_rms).sum()) == 0.0  # stayed zeros
+
+
+# ----------------------------------------------------------------------
+# non-finite forensics + skipped-step accounting (StepTelemetry)
+# ----------------------------------------------------------------------
+
+
+def _telemetry_state(grads, policy=None):
+    params = _params()
+    tx = optax.sgd(0.1)
+    if policy is not None:
+        tx = policy.wrap_optimizer(tx)
+    tx = instrument_optimizer(tx)
+    opt_state = tx.init(params)
+    _, opt_state = tx.update(grads, opt_state, params)
+    return types.SimpleNamespace(params=params, opt_state=opt_state)
+
+
+def test_nonfinite_drill_names_layer_group_in_sidecar(tmp_path,
+                                                     monkeypatch):
+    """The acceptance drill: inject Inf into ONE layer group's gradients
+    and the forensics sidecar must name that group."""
+    flight = tmp_path / "m2kt-flight.json"
+    monkeypatch.setenv("M2KT_FLIGHT_PATH", str(flight))
+    monkeypatch.setenv("M2KT_NUMERICS", "1")
+    params = _params()
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    grads["blocks_1"]["k"] = jnp.asarray([jnp.inf], jnp.float32)
+    reg = Registry()
+    telem = StepTelemetry(registry=reg)
+    telem.record_step(7, 0.1, loss=2.5, state=_telemetry_state(grads))
+    doc = numerics.read_sidecar()
+    assert doc is not None
+    assert doc["first_bad_group"] == "blocks_1"
+    assert doc["step"] == 7
+    assert doc["loss_nonfinite"] is False
+    assert doc["groups"]["blocks_1"]["grad_nonfinite"] == 1.0
+    text = reg.render()
+    assert "m2kt_train_nonfinite_steps_total 1" in text
+    assert ('m2kt_train_tensor_nonfinite{group="blocks_1",kind="grad"} 1'
+            in text)
+    # the supervisor folds the sidecar into the crash flight recorder
+    from move2kube_tpu.resilience.supervisor import Supervisor
+    sup = Supervisor(["true"], max_retries=0, backoff_s=0.0,
+                     exit_file=str(tmp_path / "exit.json"))
+    sup._write_flight("crash", 1, 1, {})
+    flight_doc = json.loads(flight.read_text())
+    assert flight_doc["numerics"]["first_bad_group"] == "blocks_1"
+
+
+def test_clean_step_writes_no_sidecar(tmp_path, monkeypatch):
+    monkeypatch.setenv("M2KT_FLIGHT_PATH",
+                       str(tmp_path / "m2kt-flight.json"))
+    params = _params()
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    telem = StepTelemetry(registry=Registry())
+    telem.record_step(1, 0.1, loss=2.0, state=_telemetry_state(grads))
+    assert numerics.read_sidecar() is None
+
+
+def test_skipped_step_accounting_and_loss_scale_gauge(tmp_path,
+                                                      monkeypatch):
+    """Satellite regression: a NaN update under the loss-scaled policy
+    is skipped by ``apply_if_finite``, surfaces through
+    ``skipped_updates``, and StepTelemetry turns the delta into
+    ``m2kt_train_skipped_steps_total``; ``record_precision`` exports the
+    active loss scale."""
+    monkeypatch.setenv("M2KT_FLIGHT_PATH",
+                       str(tmp_path / "m2kt-flight.json"))
+    policy = precisionlib.policy("bf16-scaled")
+    params = _params()
+    grads = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, jnp.nan), params)
+    state = _telemetry_state(grads, policy=policy)
+    assert precisionlib.skipped_updates(state) == 1
+    assert precisionlib.notfinite_streak(state) == 1
+    reg = Registry()
+    telem = StepTelemetry(registry=reg)
+    telem.record_precision(policy)
+    telem.record_step(3, 0.1, loss=1.0, state=state)
+    telem.record_step(4, 0.1, loss=1.0, state=state)  # no new skip
+    text = reg.render()
+    assert "m2kt_train_skipped_steps_total 1" in text
+    assert "m2kt_train_loss_scale 1024" in text
+    # all grads NaN: the first group in tree order takes the blame
+    doc = numerics.read_sidecar()
+    assert doc["first_bad_group"] == "blocks_0"
+
+
+# ----------------------------------------------------------------------
+# serving quant-drift auditor
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_llama_parts():
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+def _audited_engine(model, variables, rate=1.0):
+    cfg = EngineConfig(max_batch=2, max_seq=32, block_size=8,
+                       buckets=(8,), quant="int8", quant_audit_rate=rate)
+    return ServingEngine(model, variables, cfg)
+
+
+def test_quant_drift_audit_clean_engine(tiny_llama_parts):
+    model, variables = tiny_llama_parts
+    eng = _audited_engine(model, variables)
+    eng.run([Request("a", [1, 2, 3, 4], 2)])
+    stats = eng.stats()
+    assert stats["quant_audits"] == 1
+    assert 0.0 < stats["quant_drift_max_rel"] < float(
+        THRESHOLDS["tpunumdriftmax"])
+
+
+def test_quant_drift_audit_catches_corrupted_scale_pool(tiny_llama_parts):
+    """Corrupt one int8 scale pool x64 — the fp-reference diff must blow
+    past the alert threshold while serving keeps running."""
+    model, variables = tiny_llama_parts
+    eng = _audited_engine(model, variables)
+
+    def corrupt(node):
+        if isinstance(node, dict):
+            if "q8" in node and "scale" in node:
+                node["scale"] = node["scale"] * 64.0
+                return True
+            return any(corrupt(v) for v in node.values())
+        return False
+
+    assert corrupt(eng.variables)
+    comps = eng.run([Request("bad", [1, 2, 3, 4], 2)])
+    assert len(comps) == 1  # audit never blocks completion
+    stats = eng.stats()
+    assert stats["quant_audits"] == 1
+    assert stats["quant_drift_last_rel"] > float(
+        THRESHOLDS["tpunumdriftmax"])
+
+
+def test_audit_rate_zero_keeps_no_fp_copy(tiny_llama_parts):
+    model, variables = tiny_llama_parts
+    eng = _audited_engine(model, variables, rate=0.0)
+    assert eng._audit_fp_variables is None
+    assert "quant_audits" not in eng.stats()
+
+
+def test_audit_rate_env_parsing(monkeypatch):
+    monkeypatch.setenv("M2KT_QUANT_AUDIT_RATE", "0.25")
+    assert numerics.audit_rate() == 0.25
+    monkeypatch.setenv("M2KT_QUANT_AUDIT_RATE", "7")
+    assert numerics.audit_rate() == 1.0  # clamped
+    monkeypatch.setenv("M2KT_QUANT_AUDIT_RATE", "junk")
+    assert numerics.audit_rate() == 0.0
+    monkeypatch.setenv("M2KT_NUMERICS", "off")
+    assert not numerics.enabled()
+    monkeypatch.setenv("M2KT_NUMERICS_MAX_GROUPS", "4")
+    assert numerics.max_groups() == 4
+
+
+# ----------------------------------------------------------------------
+# translation numerics-diff harness
+# ----------------------------------------------------------------------
+
+
+def test_validation_harness_pass_and_fail(tmp_path):
+    """Acceptance round-trip: the stock semantics pass every gate; a
+    deliberately-broken translation (constant updates — a wrong
+    optimizer mapping in miniature) must FAIL."""
+    from move2kube_tpu.source import validate
+
+    report = validate.validate_translation(
+        family="llama", steps=3, out_dir=str(tmp_path))
+    assert report["verdict"] == "pass"
+    assert (tmp_path / "m2kt-numerics-report.json").exists()
+    md = (tmp_path / "m2kt-numerics-report.md").read_text()
+    assert "PASS" in md and "loss_max_rel" in md
+
+    broken = validate.validate_translation(
+        family="llama", steps=3,
+        perturb=lambda u: jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, 100.0), u))
+    assert broken["verdict"] == "fail"
+    failed = {c["name"] for c in broken["checks"] if not c["ok"]}
+    assert "loss_max_rel" in failed
+
+
+def test_declared_semantics_reads_source_tree():
+    from move2kube_tpu.source import validate
+
+    sem = validate.declared_semantics(
+        os.path.join(os.path.dirname(__file__), "..", "samples",
+                     "gpu-training", "gpt2"))
+    assert sem["optimizer"] in ("adamw", "adam", "sgd")
+    assert sem["lr"] > 0
+    assert sem["family"].startswith("gpt")
+
+
+# ----------------------------------------------------------------------
+# QA knob -> optimizer pass -> Helm parameterization
+# ----------------------------------------------------------------------
+
+
+class _AnswerEngine(qaengine.Engine):
+    def __init__(self, answers):
+        self.answers = answers
+
+    def fetch_answer(self, problem):
+        if problem.id in self.answers:
+            problem.set_answer(self.answers[problem.id])
+        return problem
+
+
+def _qa(answers=None):
+    qaengine.reset_engines()
+    if answers:
+        qaengine.add_engine(_AnswerEngine(answers))
+    qaengine.start_engine(qa_skip=True)
+
+
+def _accel_ir(serving=False):
+    svc = Service(name="trainer")
+    svc.accelerator = AcceleratorInfo(
+        gpu_count=4, tpu_accelerator="tpu-v5p-slice", tpu_topology="2x2x1",
+        serving=serving, serving_port=8000 if serving else 0)
+    svc.job = not serving
+    svc.containers.append({"name": "trainer", "image": "r/t:latest"})
+    ir = IR(name="p")
+    ir.add_service(svc)
+    return ir, svc
+
+
+def test_numerics_optimizer_injects_env_by_default():
+    from move2kube_tpu.passes.optimize import tpu_numerics_optimizer
+
+    ir, svc = _accel_ir(serving=True)
+    _qa()
+    try:
+        ir = tpu_numerics_optimizer(ir)
+        ir = tpu_numerics_optimizer(ir)  # idempotent
+    finally:
+        qaengine.reset_engines()
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_NUMERICS"] == "1"
+    assert env["M2KT_QUANT_AUDIT_RATE"] == "0.01"
+    assert len([e for e in svc.containers[0]["env"]
+                if e["name"] == "M2KT_NUMERICS"]) == 1
+
+
+def test_numerics_optimizer_knob_off_bakes_explicit_zero():
+    from move2kube_tpu.passes.optimize import tpu_numerics_optimizer
+
+    ir, svc = _accel_ir()
+    _qa({"m2kt.services.trainer.obs.numerics": False})
+    try:
+        ir = tpu_numerics_optimizer(ir)
+    finally:
+        qaengine.reset_engines()
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    # runtime default is ON, so "off" must be baked explicitly
+    assert env["M2KT_NUMERICS"] == "0"
+    assert "M2KT_QUANT_AUDIT_RATE" not in env  # training: no auditor
+
+
+def test_numerics_parameterizer_lifts_to_helm_values():
+    from move2kube_tpu.passes.parameterize import tpu_numerics_parameterizer
+
+    ir, svc = _accel_ir(serving=True)
+    svc.containers[0]["env"] = [
+        {"name": "M2KT_NUMERICS", "value": "1"},
+        {"name": "M2KT_QUANT_AUDIT_RATE", "value": "0.05"},
+    ]
+    ir = tpu_numerics_parameterizer(ir)
+    assert ir.values.global_variables["tpunumerics"] == "1"
+    assert ir.values.global_variables["tpuquantauditrate"] == "0.05"
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_NUMERICS"] == "{{ .Values.tpunumerics }}"
+    assert env["M2KT_QUANT_AUDIT_RATE"] == "{{ .Values.tpuquantauditrate }}"
+
+
+# ----------------------------------------------------------------------
+# alert rules + dashboard
+# ----------------------------------------------------------------------
+
+
+def test_numerics_alert_rules_and_threshold():
+    assert "tpunumdriftmax" in THRESHOLDS
+    doc = prometheus_rule("svc", "app", serving=False)
+    alerts = {r["alert"]: r
+              for g in doc["spec"]["groups"] for r in g["rules"]}
+    assert "M2KTNonFiniteSteps" in alerts
+    assert "M2KTQuantDriftHigh" not in alerts  # serving-only
+    doc = prometheus_rule("svc", "app", serving=True)
+    alerts = {r["alert"]: r
+              for g in doc["spec"]["groups"] for r in g["rules"]}
+    drift = alerts["M2KTQuantDriftHigh"]
+    assert THRESHOLDS["tpunumdriftmax"] in drift["expr"]
+    # Helm path: threshold overrides flow into the PromQL
+    doc = prometheus_rule(
+        "svc", "app", serving=True,
+        thresholds={"tpunumdriftmax": "{{ .Values.tpunumdriftmax }}"})
+    alerts = {r["alert"]: r
+              for g in doc["spec"]["groups"] for r in g["rules"]}
+    assert "{{ .Values.tpunumdriftmax }}" in \
+        alerts["M2KTQuantDriftHigh"]["expr"]
+
+
+def test_dashboard_has_numerics_row():
+    dash = grafana_dashboard("svc", "app", serving=True)
+    titles = [p["title"] for p in dash["panels"]]
+    assert "Gradient rms by layer group" in titles
+    assert "Non-finite entries by layer group" in titles
+    assert "Loss scale" in titles
+    assert any("Quant drift" in t for t in titles)
