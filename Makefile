@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke trainkernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -156,6 +156,17 @@ quant-smoke:
 kernel-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_kernels.py tests/test_autotune.py -q
 	$(CPU_ENV) M2KT_BENCH_KERNELS_TRIALS=1 $(PY) bench.py --model kernels
+
+# training kernels in isolation (all CPU-mode, 8 forced host devices):
+# fused chunked lm-head cross-entropy vs the reference loss (loss +
+# grads, fp32 exact and bf16 logit-gated), flash-backward autotune cache
+# keying, fsdp all-gather prefetch vs the sequential GSPMD reference;
+# then the forced-host dryrun asserting the M2KT_FUSED_CE=on ladder
+# actually dispatches the fused loss (spy, not just a finite loss)
+trainkernel-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_crossentropy.py tests/test_autotune.py -q
+	$(CPU_ENV) $(PY) -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
+	import __graft_entry__ as g; g.dryrun_trainkernels(8)"
 
 # fleet tracing + per-tenant SLO plane in isolation (all CPU-mode):
 # traceparent round-trip, cross-role stitching with exact latency

@@ -451,6 +451,26 @@ def bench_llama(n: int) -> dict:
         from move2kube_tpu.obs import costmodel
         cost_holder["report"] = costmodel.analyze_step_fn(
             step, state, batch_data)
+        # fused-CE memory delta: compile (never run) the SAME step with
+        # the reference [B,T,V] logit loss and compare compiled HBM
+        # peaks. vocab=32000 >> the 2048 chunk, so the default path
+        # above dispatched the chunked lm-head CE (ops/crossentropy.py);
+        # best-effort — a lowering failure must not cost the phase.
+        prev_ce = os.environ.get("M2KT_FUSED_CE")
+        try:
+            os.environ["M2KT_FUSED_CE"] = "off"
+            ref_report = costmodel.analyze_step_fn(
+                m2kt_train.make_lm_train_step(mesh), state, batch_data)
+            if ref_report is not None:
+                cost_holder["reference_hbm"] = ref_report.peak_hbm_bytes
+        except Exception as e:  # noqa: BLE001 - comparison is best-effort
+            print(f"[bench] reference-CE compile failed: {e}",
+                  file=sys.stderr)
+        finally:
+            if prev_ce is None:
+                os.environ.pop("M2KT_FUSED_CE", None)
+            else:
+                os.environ["M2KT_FUSED_CE"] = prev_ce
         t0 = time.perf_counter()
         for _ in range(MEASURE_CALLS):
             state, loss = step(state, batch_data)
@@ -469,6 +489,7 @@ def bench_llama(n: int) -> dict:
     # peak-HBM footprint. Null on backends without cost analysis.
     from move2kube_tpu.obs import costmodel
     train_mfu = train_hbm = None
+    ref_hbm = cost_holder.get("reference_hbm")
     report = cost_holder.get("report")
     if report is not None:
         spec, _ = costmodel.chip_spec(
@@ -485,6 +506,11 @@ def bench_llama(n: int) -> dict:
         "mfu": round(mfu, 4),
         "train_mfu": round(train_mfu, 6) if train_mfu is not None else None,
         "train_hbm_peak_bytes": train_hbm,
+        # compiled HBM peak of the same step with the reference
+        # materialized-logits loss; the ratio is the chunked-CE win
+        "train_hbm_peak_bytes_reference_ce": ref_hbm,
+        "fused_ce_hbm_ratio": (round(ref_hbm / train_hbm, 3)
+                               if ref_hbm and train_hbm else None),
         "batch": batch,
         "seq_len": LLAMA_SEQ,
         "vs_baseline": round(tok_s / anchor, 3),
@@ -574,6 +600,43 @@ def bench_pallas(n: int) -> dict:
 
     tflops = timed_tflops(
         lambda c, k, v: _flash_attention_tpu(c, k, v, True, scale))
+
+    # backward throughput: full grad (forward recompute + the dq and
+    # dk/dv kernels) scanned inside one jit, same dispatch-amortization
+    # as the forward number. This is the path the flash_bwd autotuner
+    # (ops/attention.py get_bwd_block_sizes) feeds — its sweep runs at
+    # trace time here, so the reported TFLOP/s uses the tuned blocks.
+    def timed_bwd_tflops():
+        grad_fn = jax.grad(loss_kernel, argnums=(0, 1, 2))
+
+        def one(c, _):
+            dq, _dk, _dv = grad_fn(c, k, v)
+            # renormalize the carry so scanned grads stay finite
+            c2 = (dq / (jnp.max(jnp.abs(dq)) + 1e-6)).astype(c.dtype)
+            return c2, None
+
+        run = jax.jit(lambda q: jax.lax.scan(one, q, None,
+                                             length=scan_iters)[0])
+        float(jnp.sum(run(q)))  # warm (compile + sweep + streaming)
+        float(jnp.sum(run(q)))  # warm (steady state)
+        iters = 4
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run(q)
+        float(jnp.sum(out))
+        dt = time.perf_counter() - t0
+        # causal grad flops: 2 fwd-recompute + 5 bwd matmuls
+        # (dv, dp, ds->dq, ds->dk, score recompute), 2 flops/MAC, /2 mask
+        flops = 7 * 2 * b * h * s * s * d / 2
+        return flops * scan_iters * iters / dt / 1e12
+
+    bwd_tflops = None
+    try:
+        bwd_tflops = round(timed_bwd_tflops(), 2)
+    except Exception as e:  # noqa: BLE001 - bwd timing is best-effort
+        print(f"[bench] backward timing failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # flush the primary numbers BEFORE the best-effort official-kernel
     # comparison: a comparator hang kills the child on the parent's
     # timeout, and must not cost the phase its TFLOP/s (the parent keeps
@@ -583,6 +646,7 @@ def bench_pallas(n: int) -> dict:
            "vs_baseline": round(tflops * 1e12 / (V5E_PEAK_BF16_FLOPS
                                                  * ANCHOR_MFU), 3),
            "pallas_ok": True, "pallas_bwd_ok": True,
+           "bwd_tflops": bwd_tflops,
            "max_abs_err": round(err, 5), "bwd_rel_err": round(bwd_err, 5)})
 
     # north-star comparison (BASELINE.json: >=90% of a hand-ported
@@ -605,8 +669,8 @@ def bench_pallas(n: int) -> dict:
               f"{type(e).__name__}: {e}", file=sys.stderr)
 
     print(f"[bench] pallas max_abs_err={err:.4f} bwd_rel_err={bwd_err:.4f} "
-          f"{tflops:.1f} TFLOP/s vs_official={vs_official}",
-          file=sys.stderr)
+          f"{tflops:.1f} TFLOP/s bwd={bwd_tflops} TFLOP/s "
+          f"vs_official={vs_official}", file=sys.stderr)
     result = {"phase": "pallas", "metric": metric,
               "value": round(tflops, 2), "unit": unit}
     if vs_official is not None:
@@ -623,6 +687,7 @@ def bench_pallas(n: int) -> dict:
                             "vs_official_kernel is the controlled "
                             "same-chip comparison",
         "pallas_ok": True, "pallas_bwd_ok": True,
+        "bwd_tflops": bwd_tflops,
         "max_abs_err": round(err, 5),
         "bwd_rel_err": round(bwd_err, 5)})
     return result

@@ -178,12 +178,12 @@ def _ask_tpu_slice(name: str, acc: AcceleratorInfo, plan=None) -> None:
     acc.gpu_count = acc.num_slices * chips
 
 
-def _ask_training_knobs(name: str, family: str) -> tuple[str, int]:
-    """Precision and gradient-accumulation are QA problems with cached
-    defaults, same engine as the slice choice. The IDs are shared with
-    ``passes/optimize.py``'s tpu_training_optimizer — one logical knob,
-    asked once, cached answer reused by both the emitted trainer template
-    and the JobSet env injection."""
+def _ask_training_knobs(name: str, family: str) -> tuple[str, int, str]:
+    """Precision, gradient-accumulation and the fused-CE dispatch are QA
+    problems with cached defaults, same engine as the slice choice. The
+    IDs are shared with ``passes/optimize.py``'s tpu_training_optimizer —
+    one logical knob, asked once, cached answer reused by both the
+    emitted trainer template and the JobSet env injection."""
     from move2kube_tpu import qa
     from move2kube_tpu.models.precision import PRECISION_OPTIONS
 
@@ -211,7 +211,15 @@ def _ask_training_knobs(name: str, family: str) -> tuple[str, int]:
         log.warning("invalid grad-accum answer %r for %s; using 1",
                     raw, name)
         grad_accum = 1
-    return precision, grad_accum
+    raw = qa.fetch_select(
+        f"m2kt.services.{name}.train.fusedce",
+        f"Select the fused LM-head cross-entropy mode for [{name}]",
+        ["auto fuses the chunked online-logsumexp loss when the vocab "
+         "spans multiple chunks (the [B,T,V] logit tensor never "
+         "materializes); on forces it; off keeps the jnp reference loss"],
+        "auto", ["auto", "on", "off"])
+    fused_ce = raw if raw in ("auto", "on", "off") else "auto"
+    return precision, grad_accum, fused_ce
 
 
 def _ask_elastic_knobs(name: str, num_slices: int) -> tuple[bool, int]:
@@ -431,9 +439,10 @@ def emit_container(service: PlanService, plan=None) -> Container:
     }
     mesh = infer_mesh_config(max(1, acc.gpu_count), **degrees)
     if serving:
-        precision, grad_accum = "bf16", 1  # decode server: no train knobs
+        # decode server: no train knobs
+        precision, grad_accum, fused_ce = "bf16", 1, "auto"
     else:
-        precision, grad_accum = _ask_training_knobs(name, family)
+        precision, grad_accum, fused_ce = _ask_training_knobs(name, family)
 
     image_name = service.image or f"{name}:latest"
     # HF GPT-2 fine-tunes (family gpt) emit the true GPT-2 architecture
@@ -532,6 +541,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
                 "expert_parallel": degrees["expert_parallel"],
                 "precision": precision,
                 "grad_accum": grad_accum,
+                "fused_ce": fused_ce,
                 "moe_experts": moe_experts,
                 "numerics": numerics_knobs["numerics"],
                 # in-image default; pods that mount a durable volume point

@@ -143,7 +143,7 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, cache=None,
-                 return_kv=False):
+                 return_kv=False, return_hidden=False):
         """Same three modes as models/llama.py ``Llama.__call__``:
         full forward (default), prefill (``return_kv=True`` also returns
         per-layer K/V), and paged single-token decode (``cache=`` with
@@ -198,6 +198,11 @@ class GPT2(nn.Module):
                 x = out
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
                          name="ln_f")(x)
+        if return_hidden:
+            # pre-head hidden states for the fused chunked lm-head CE
+            # (ops/crossentropy.py); the tied wte.embedding.T head is
+            # folded into the loss chunk loop by the caller
+            return x
         # LM head tied to the token embedding (HF GPT2LMHeadModel ties)
         logits = x.astype(jnp.float32) @ wte.embedding.astype(jnp.float32).T
         if return_kv:

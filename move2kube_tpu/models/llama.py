@@ -234,7 +234,7 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, cache=None,
-                 return_kv=False):
+                 return_kv=False, return_hidden=False):
         """Three modes, one parameter tree:
 
         - training / full forward (default): ``(input_ids[b, s]) -> logits``
@@ -299,6 +299,11 @@ class Llama(nn.Module):
             else:
                 x = out
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if return_hidden:
+            # pre-head hidden states for the fused chunked lm-head CE
+            # (ops/crossentropy.py): the caller folds the lm_head matmul
+            # into the loss so the [b, s, vocab] logits never materialize
+            return x
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x.astype(jnp.float32))
         if return_kv:

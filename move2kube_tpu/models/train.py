@@ -19,8 +19,15 @@ import optax
 from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from move2kube_tpu.ops import crossentropy
 from move2kube_tpu.parallel.compat import ambient_mesh, bare_spec_constraints_ok
-from move2kube_tpu.parallel.overlap import is_pure_data_parallel, overlapped_accum_grads
+from move2kube_tpu.parallel.overlap import (
+    fsdp_prefetch_mode,
+    is_pure_data_parallel,
+    is_pure_fsdp,
+    overlapped_accum_grads,
+    prefetched_fsdp_accum_grads,
+)
 from move2kube_tpu.parallel.sharding import ShardingRules, infer_param_axes
 
 
@@ -107,8 +114,11 @@ def cross_entropy_loss(logits, labels) -> jax.Array:
 
 
 def lm_loss(logits, input_ids) -> jax.Array:
-    """Next-token prediction loss."""
-    return cross_entropy_loss(logits[:, :-1], input_ids[:, 1:])
+    """Next-token prediction loss. Dispatches over the M2KT_FUSED_CE
+    ladder (ops/crossentropy.py): the chunked online-logsumexp path when
+    the vocab is wide enough to pay for it, the jnp reference otherwise
+    — identical math, gated by tests/test_crossentropy.py."""
+    return crossentropy.cross_entropy(logits[:, :-1], input_ids[:, 1:])
 
 
 def data_axes(mesh) -> tuple[str, ...]:
@@ -407,18 +417,50 @@ def make_lm_train_step(mesh: Mesh, remat: bool = True,
     microbatches (``input_ids`` of shape [k, batch, seq]) per optimizer
     update.  On a pure data-parallel mesh the per-microbatch gradient
     reduction rides an explicit ppermute ring that overlaps the next
-    microbatch's backward (parallel/overlap.py); on meshes with
-    model-parallel axes it falls back to a sequential lax.scan
-    accumulation and lets GSPMD place the final reduce.
+    microbatch's backward (parallel/overlap.py); on a pure-fsdp (ZeRO)
+    mesh the param all-gather is issued as independent per-leaf rings
+    ahead of the backward and the grad reduce-scatter rides the same
+    overlap (prefetched_fsdp_accum_grads); on meshes with model-parallel
+    axes it falls back to a sequential lax.scan accumulation and lets
+    GSPMD place the final reduce.
 
     ``precision`` (models/precision.py PrecisionPolicy) casts the fp32
     master params to the compute dtype inside the loss and applies/undoes
     optional loss scaling around the backward; gradients and the reported
     loss come back unscaled fp32."""
 
+    def _aux(sown):
+        return sum((jnp.sum(v) for v in jax.tree.leaves(sown)),
+                   jnp.float32(0.0))
+
     def _loss(apply_fn, params, ids):
         if precision is not None:
             params = precision.cast_params(params)
+
+        # head-folded fused CE (ops/crossentropy.py): when the ladder says
+        # fuse and the param tree exposes a recognizable LM head, ask the
+        # model for its pre-head hidden states and fold the lm-head matmul
+        # into the chunked loss so the [B, T, V] logit tensor never
+        # materializes. Models without return_hidden (or any trace-time
+        # failure) fall through to the logits path below with a warning.
+        head_w = crossentropy.lm_head_weight(params)
+        if head_w is not None and crossentropy.should_fuse(head_w.shape[-1]):
+            def fwd_h(p, x):
+                return apply_fn({"params": p}, x, mutable=["losses"],
+                                return_hidden=True)
+
+            if remat:
+                fwd_h = jax.checkpoint(fwd_h)
+            try:
+                hidden, sown = fwd_h(params, ids)
+                loss = (crossentropy.linear_lm_loss(hidden, head_w, ids)
+                        + moe_aux_weight * _aux(sown))
+            except Exception as e:  # noqa: BLE001 - reference fallback
+                crossentropy._warn_once("head-folded lm loss", e)
+            else:
+                if precision is not None:
+                    loss = precision.scale_loss(loss)
+                return loss
 
         def fwd(p, x):
             return apply_fn({"params": p}, x, mutable=["losses"])
@@ -426,9 +468,7 @@ def make_lm_train_step(mesh: Mesh, remat: bool = True,
         if remat:
             fwd = jax.checkpoint(fwd)
         logits, sown = fwd(params, ids)
-        aux = sum((jnp.sum(v) for v in jax.tree.leaves(sown)),
-                  jnp.float32(0.0))
-        loss = lm_loss(logits, ids) + moe_aux_weight * aux
+        loss = lm_loss(logits, ids) + moe_aux_weight * _aux(sown)
         if precision is not None:
             loss = precision.scale_loss(loss)
         return loss
@@ -461,6 +501,25 @@ def make_lm_train_step(mesh: Mesh, remat: bool = True,
             return _finish(state, grads, loss)
 
         return _with_mesh(mesh, step_overlap)
+
+    # ZeRO meshes (all devices on fsdp): explicit ring all-gather of the
+    # param shards issued ahead of the backward, grad reduce-scatter
+    # overlapped with the next microbatch (parallel/overlap.py); the
+    # sequential GSPMD scan below stays the fallback (M2KT_FSDP_PREFETCH=off
+    # or any non-pure-fsdp topology).
+    prefetch = (not _trivial(mesh) and is_pure_fsdp(mesh)
+                and fsdp_prefetch_mode() != "off")
+
+    if prefetch:
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step_prefetch(state: TrainState, batch: dict):
+            grads, loss = prefetched_fsdp_accum_grads(
+                mesh,
+                lambda p, mb: _loss(state.apply_fn, p, mb["input_ids"]),
+                state.params, batch, axis_name="fsdp")
+            return _finish(state, grads, loss)
+
+        return _with_mesh(mesh, step_prefetch)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step_accum(state: TrainState, batch: dict):

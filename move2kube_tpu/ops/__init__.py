@@ -7,3 +7,7 @@ of HBM entirely).
 """
 
 from move2kube_tpu.ops.attention import flash_attention  # noqa: F401
+from move2kube_tpu.ops.crossentropy import (  # noqa: F401
+    fused_cross_entropy,
+    fused_linear_cross_entropy,
+)

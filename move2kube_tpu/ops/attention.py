@@ -68,7 +68,7 @@ _disk_cache_path_loaded: str | None = None
 # prefixed with the kernel name so one kernel's geometry can never be
 # served to another (pre-PR-11 cache files carried bare flash keys —
 # _load_disk_cache migrates those by prepending "flash:")
-_KERNEL_NAMES = ("flash", "paged_decode")
+_KERNEL_NAMES = ("flash", "flash_bwd", "paged_decode")
 
 
 def _autotune_enabled() -> bool:
@@ -207,6 +207,83 @@ def get_block_sizes(q_shape, kv_seq: int, dtype: str, causal: bool,
     if not (allow_sweep and _autotune_enabled()):
         return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
     winner = _sweep_blocks(q_shape, kv_seq, dtype, causal)
+    _block_cache[key] = winner
+    _store_disk_cache(key, winner)
+    return winner
+
+
+def _measure_bwd_blocks(q, k, v, o, lse, g, causal: bool, scale: float,
+                        block_q: int, block_k: int) -> float:
+    """Wall seconds for a few timed backward calls (dq + dk/dv grids) at
+    the given blocks. Separated out so tests can stub the timing."""
+    run = jax.jit(lambda *a: _flash_attention_bwd_tpu(
+        *a, causal, scale, block_q=block_q, block_k=block_k))
+    jax.block_until_ready(run(q, k, v, o, lse, g))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = run(q, k, v, o, lse, g)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def _sweep_bwd_blocks(q_shape, kv_seq: int, dtype: str,
+                      causal: bool) -> tuple[int, int]:
+    """Sweep (block_q, block_k) over the SAME candidate grid as the
+    forward, but timing the two backward pallas_calls: their best blocks
+    differ from the forward's (the dkv kernel holds whole Q/dO/lse rows
+    in VMEM per K block, so its budget tilts toward smaller tiles)."""
+    b, s, h, d = (int(x) for x in q_shape)
+    scale = d ** -0.5
+    keys = jax.random.split(jax.random.PRNGKey(1), 4)
+    jdt = jnp.dtype(dtype)
+    q = jax.random.normal(keys[0], (b, s, h, d), jdt)
+    k = jax.random.normal(keys[1], (b, kv_seq, h, d), jdt)
+    v = jax.random.normal(keys[2], (b, kv_seq, h, d), jdt)
+    g = jax.random.normal(keys[3], (b, s, h, d), jdt)
+    o, lse = _flash_attention_tpu(q, k, v, causal, scale,
+                                  return_residuals=True)
+    best, best_t = (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K), float("inf")
+    seen: set[tuple[int, int]] = set()
+    for bq, bk in _BLOCK_CANDIDATES:
+        eff = (_pick_block(bq, s), _pick_block(bk, kv_seq))
+        if eff in seen:
+            continue
+        seen.add(eff)
+        try:
+            t = _measure_bwd_blocks(q, k, v, o, lse, g, causal, scale, *eff)
+        except Exception:  # noqa: BLE001 - candidate may exceed VMEM
+            continue
+        if t < best_t:
+            best, best_t = eff, t
+    logging.getLogger(__name__).info(
+        "flash bwd autotune: %s -> block_q=%d block_k=%d",
+        _cache_key(q_shape, kv_seq, dtype, causal, kernel="flash_bwd",
+                   geometry="dq+dkv"), *best)
+    return best
+
+
+def get_bwd_block_sizes(q_shape, kv_seq: int, dtype: str, causal: bool,
+                        allow_sweep: bool = True) -> tuple[int, int]:
+    """Tuned (block_q, block_k) for the flash-attention BACKWARD (shared
+    by the dq and dk/dv grids), keyed ``flash_bwd:<shape>:dq+dkv`` in the
+    same disk cache as the forward winners. The backward only ever runs
+    under grad tracing, but that does not block the sweep: the timing
+    runs on fresh CONCRETE arrays synthesized from the (static) shapes,
+    so a cache miss sweeps once at trace time and the winner is baked
+    into the compiled program — lookups themselves stay trace-safe. With
+    tuning unavailable the forward's cached winner for the shape is the
+    fallback (its lookup never sweeps), then the measured defaults."""
+    key = _cache_key(q_shape, kv_seq, dtype, causal, kernel="flash_bwd",
+                     geometry="dq+dkv")
+    if key in _block_cache:
+        return _block_cache[key]
+    _load_disk_cache()
+    if key in _block_cache:
+        return _block_cache[key]
+    if not (allow_sweep and _autotune_enabled()):
+        return get_block_sizes(q_shape, kv_seq, dtype, causal,
+                               allow_sweep=False)
+    winner = _sweep_bwd_blocks(q_shape, kv_seq, dtype, causal)
     _block_cache[key] = winner
     _store_disk_cache(key, winner)
     return winner
@@ -460,16 +537,19 @@ def _flash_attention_bwd_tpu(q, k, v, o, lse, g, causal: bool, scale: float,
     """Blockwise flash-attention backward: dq gridded over Q blocks, dk/dv
     gridded over K blocks, probabilities recomputed from ``lse``. HBM
     traffic and VMEM footprint scale O(seq*d), not O(seq^2), matching the
-    forward kernel's point. Blocks default to the forward pass's tuned
-    sizes (never sweeps here: the backward only runs under grad tracing)."""
+    forward kernel's point. Blocks default to the backward's own tuned
+    sizes (get_bwd_block_sizes): the sweep times synthetic concrete
+    arrays, so it runs even though this function only executes under
+    grad tracing — only interpreter mode (CPU kernel-body validation)
+    skips straight to the cached/forward/default ladder."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
         interpret = _INTERPRET
     if block_q is None or block_k is None:
-        tq, tk = get_block_sizes(q.shape, k.shape[1], str(q.dtype), causal,
-                                 allow_sweep=False)
+        tq, tk = get_bwd_block_sizes(q.shape, k.shape[1], str(q.dtype),
+                                     causal, allow_sweep=not interpret)
         block_q = tq if block_q is None else block_q
         block_k = tk if block_k is None else block_k
     b, s, h, d = q.shape

@@ -1,0 +1,313 @@
+"""Fused chunked cross-entropy for LM heads (the training-side kernel).
+
+``cross_entropy_loss`` (models/train.py) upcasts the whole ``[B, T, V]``
+logit tensor to float32 and materializes a second ``[B, T, V]``
+log-softmax; at 32k vocab those two tensors are the largest non-matmul
+HBM cost of the LM step. This module removes them with the flash-
+attention trick applied to the vocab axis:
+
+* :func:`fused_cross_entropy` — blockwise online-logsumexp forward over
+  vocab chunks (running max / sum-of-exp, one ``[N, chunk]`` float32
+  tile live at a time) with a ``custom_vjp`` whose backward emits
+  ``(softmax(logits) - onehot(labels)) * g / N`` chunk by chunk, never
+  building the full-softmax intermediate jax's log_softmax VJP would.
+
+* :func:`fused_linear_cross_entropy` — the same, with the lm-head
+  matmul folded INTO the chunk loop: the forward computes
+  ``hidden @ W[:, chunk]`` per chunk, so the full ``[N, V]`` logit
+  tensor never exists at all; the backward recomputes each chunk and
+  contracts it straight into ``d_hidden`` / ``dW[:, chunk]``. This is
+  what moves the lm-head off the HBM roofline (bench.py llama phase
+  records the peak delta).
+
+Everything here is plain jnp/XLA — backend-independent, differentiable,
+and exactly equivalent to the reference at fp32 (chunk reassociation of
+the logsumexp is the only difference; tests gate it at 1e-6).
+
+Dispatch mirrors the ``M2KT_SERVE_KERNELS`` ladder (attention.py
+serve_kernels_mode): ``M2KT_FUSED_CE=auto|on|off``, with any trace-time
+failure of the fused path logged once and falling back to the jnp
+reference. Unlike the serving ladder, ``auto`` is not TPU-gated —
+chunked CE is XLA, not Pallas — it instead engages when the vocab is
+large enough to span more than one ``M2KT_CE_CHUNK``-sized chunk
+(chunking a tiny classifier head would only add loop overhead).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+DEFAULT_CHUNK = 2048
+
+_warned: set[str] = set()
+
+
+def _warn_once(site: str, exc: Exception) -> None:
+    if site in _warned:
+        return
+    _warned.add(site)
+    logging.getLogger(__name__).warning(
+        "fused cross-entropy: %s failed (%s: %s); falling back to the jnp "
+        "reference path", site, type(exc).__name__, exc)
+
+
+def fused_ce_mode() -> str:
+    """``M2KT_FUSED_CE`` -> 'auto' | 'on' | 'off' (same spellings the
+    serving ladder accepts; anything unrecognized reads as auto)."""
+    raw = os.environ.get("M2KT_FUSED_CE", "auto").strip().lower()
+    if raw in ("on", "1", "true"):
+        return "on"
+    if raw in ("off", "0", "false"):
+        return "off"
+    return "auto"
+
+
+def ce_chunk_size() -> int:
+    """Requested vocab chunk size (``M2KT_CE_CHUNK``, default 2048)."""
+    try:
+        c = int(os.environ.get("M2KT_CE_CHUNK", str(DEFAULT_CHUNK)))
+    except ValueError:
+        c = DEFAULT_CHUNK
+    return max(c, 8)
+
+
+def pick_chunk(vocab: int, requested: int) -> int:
+    """Largest divisor of ``vocab`` <= ``requested`` (the chunk loop is
+    ``vocab // chunk`` iterations; a non-divisor would drop columns).
+    Pathological vocabs whose best divisor is tiny (primes) collapse to
+    a single chunk rather than thousands of slivers."""
+    c = max(1, min(int(requested), int(vocab)))
+    while vocab % c:
+        c -= 1
+    if c < 128 and vocab > 128:
+        return vocab
+    return c
+
+
+def should_fuse(vocab: int) -> bool:
+    """The ladder decision for a head of width ``vocab``: on -> always,
+    off -> never, auto -> only when the vocab spans multiple chunks."""
+    mode = fused_ce_mode()
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return int(vocab) > ce_chunk_size()
+
+
+def reference_cross_entropy(logits, labels) -> jax.Array:
+    """The unfused baseline: full fp32 upcast + log_softmax + gather.
+    Identical math to models/train.py cross_entropy_loss."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                                 axis=-1)
+    return -jnp.mean(picked)
+
+
+def _float0_like(labels):
+    """Cotangent for integer labels (custom_vjp requires float0, not a
+    zero int array)."""
+    return np.zeros(labels.shape, dtype=jax.dtypes.float0)
+
+
+# ------------------------------------------------------------------ chunked
+# logits-level fused CE: logits exist (the model computed them) but the
+# fp32 upcast + log-softmax copies never do.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fused_ce(logits, labels, chunk: int):
+    loss, _ = _ce_forward(logits, labels, chunk)
+    return loss
+
+
+def _ce_forward(logits, labels, chunk: int):
+    n, v = logits.shape
+    labels = labels.astype(jnp.int32)
+    m0 = jnp.full((n,), -1e30, jnp.float32)
+
+    def body(i, carry):
+        m, s, picked = carry
+        lo = i * chunk
+        blk = lax.dynamic_slice_in_dim(logits, lo, chunk,
+                                       axis=1).astype(jnp.float32)
+        bm = jnp.max(blk, axis=1)
+        m2 = jnp.maximum(m, bm)
+        s = s * jnp.exp(m - m2) + jnp.sum(jnp.exp(blk - m2[:, None]), axis=1)
+        idx = jnp.clip(labels - lo, 0, chunk - 1)
+        val = jnp.take_along_axis(blk, idx[:, None], axis=1)[:, 0]
+        hit = (labels >= lo) & (labels < lo + chunk)
+        picked = jnp.where(hit, val, picked)
+        return m2, s, picked
+
+    zeros = jnp.zeros((n,), jnp.float32)
+    m, s, picked = lax.fori_loop(0, v // chunk, body, (m0, zeros, zeros))
+    lse = m + jnp.log(s)
+    return jnp.mean(lse - picked), lse
+
+
+def _ce_fwd(logits, labels, chunk: int):
+    loss, lse = _ce_forward(logits, labels, chunk)
+    return loss, (logits, labels, lse)
+
+
+def _ce_bwd(chunk: int, res, g):
+    logits, labels, lse = res
+    n, v = logits.shape
+    labels = labels.astype(jnp.int32)
+    scale = (g / n).astype(jnp.float32)
+
+    def body(i, dl):
+        lo = i * chunk
+        blk = lax.dynamic_slice_in_dim(logits, lo, chunk,
+                                       axis=1).astype(jnp.float32)
+        p = jnp.exp(blk - lse[:, None])
+        col = lo + lax.broadcasted_iota(jnp.int32, (n, chunk), 1)
+        p = p - (col == labels[:, None]).astype(jnp.float32)
+        return lax.dynamic_update_slice_in_dim(
+            dl, (p * scale).astype(dl.dtype), lo, axis=1)
+
+    dl = lax.fori_loop(0, v // chunk, body, jnp.zeros_like(logits))
+    return dl, _float0_like(labels)
+
+
+_fused_ce.defvjp(_ce_fwd, _ce_bwd)
+
+
+def fused_cross_entropy(logits, labels, chunk: int | None = None) -> jax.Array:
+    """Chunked online-logsumexp CE over the last axis of ``logits``
+    (any leading shape; ``labels`` matches the leading shape)."""
+    v = logits.shape[-1]
+    c = pick_chunk(v, chunk or ce_chunk_size())
+    flat = logits.reshape(-1, v)
+    return _fused_ce(flat, labels.reshape(-1), c)
+
+
+# ----------------------------------------------------------- linear-fused
+# head-folded CE: logits never materialize. hidden [N, D], weight [D, V].
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_linear_ce(hidden, weight, labels, chunk: int):
+    loss, _ = _linear_forward(hidden, weight, labels, chunk)
+    return loss
+
+
+def _linear_forward(hidden, weight, labels, chunk: int):
+    n = hidden.shape[0]
+    v = weight.shape[1]
+    h32 = hidden.astype(jnp.float32)
+    labels = labels.astype(jnp.int32)
+    m0 = jnp.full((n,), -1e30, jnp.float32)
+
+    def body(i, carry):
+        m, s, picked = carry
+        lo = i * chunk
+        wc = lax.dynamic_slice_in_dim(weight, lo, chunk,
+                                      axis=1).astype(jnp.float32)
+        blk = jnp.dot(h32, wc, preferred_element_type=jnp.float32)
+        bm = jnp.max(blk, axis=1)
+        m2 = jnp.maximum(m, bm)
+        s = s * jnp.exp(m - m2) + jnp.sum(jnp.exp(blk - m2[:, None]), axis=1)
+        idx = jnp.clip(labels - lo, 0, chunk - 1)
+        val = jnp.take_along_axis(blk, idx[:, None], axis=1)[:, 0]
+        hit = (labels >= lo) & (labels < lo + chunk)
+        picked = jnp.where(hit, val, picked)
+        return m2, s, picked
+
+    zeros = jnp.zeros((n,), jnp.float32)
+    m, s, picked = lax.fori_loop(0, v // chunk, body, (m0, zeros, zeros))
+    lse = m + jnp.log(s)
+    return jnp.mean(lse - picked), lse
+
+
+def _linear_fwd(hidden, weight, labels, chunk: int):
+    loss, lse = _linear_forward(hidden, weight, labels, chunk)
+    return loss, (hidden, weight, labels, lse)
+
+
+def _linear_bwd(chunk: int, res, g):
+    hidden, weight, labels, lse = res
+    n, dm = hidden.shape
+    v = weight.shape[1]
+    h32 = hidden.astype(jnp.float32)
+    labels = labels.astype(jnp.int32)
+    scale = (g / n).astype(jnp.float32)
+
+    def body(i, carry):
+        dh, dw = carry
+        lo = i * chunk
+        wc = lax.dynamic_slice_in_dim(weight, lo, chunk,
+                                      axis=1).astype(jnp.float32)
+        blk = jnp.dot(h32, wc, preferred_element_type=jnp.float32)
+        p = jnp.exp(blk - lse[:, None])
+        col = lo + lax.broadcasted_iota(jnp.int32, (n, chunk), 1)
+        p = (p - (col == labels[:, None]).astype(jnp.float32)) * scale
+        dh = dh + jnp.dot(p, wc.T, preferred_element_type=jnp.float32)
+        dwc = jnp.dot(h32.T, p, preferred_element_type=jnp.float32)
+        dw = lax.dynamic_update_slice_in_dim(dw, dwc.astype(dw.dtype), lo,
+                                             axis=1)
+        return dh, dw
+
+    dh0 = jnp.zeros((n, dm), jnp.float32)
+    dw0 = jnp.zeros(weight.shape, weight.dtype)
+    dh, dw = lax.fori_loop(0, v // chunk, body, (dh0, dw0))
+    return dh.astype(hidden.dtype), dw, _float0_like(labels)
+
+
+_fused_linear_ce.defvjp(_linear_fwd, _linear_bwd)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels,
+                               chunk: int | None = None) -> jax.Array:
+    """CE of ``hidden @ weight`` against ``labels`` without ever building
+    the ``[N, V]`` logits. ``hidden``: [..., D]; ``weight``: [D, V]."""
+    v = weight.shape[1]
+    c = pick_chunk(v, chunk or ce_chunk_size())
+    flat = hidden.reshape(-1, hidden.shape[-1])
+    return _fused_linear_ce(flat, weight, labels.reshape(-1), c)
+
+
+# ------------------------------------------------------------- dispatchers
+
+def cross_entropy(logits, labels) -> jax.Array:
+    """Ladder-dispatching CE: fused chunked path per :func:`should_fuse`,
+    jnp reference otherwise, with a warn-once trace-time fallback."""
+    if not should_fuse(logits.shape[-1]):
+        return reference_cross_entropy(logits, labels)
+    try:
+        return fused_cross_entropy(logits, labels)
+    except Exception as e:  # noqa: BLE001 - fall back rather than fail
+        _warn_once("fused_cross_entropy", e)
+        return reference_cross_entropy(logits, labels)
+
+
+def lm_head_weight(params):
+    """``[D, V]`` LM-head weight for the model zoo's head layouts, or
+    None when the tree has no recognizable head: llama-style separate
+    ``lm_head`` Dense, or the gpt2 tied token embedding (transposed).
+    Grads flow back through the returned view, so the tied head keeps
+    accumulating both embedding and head contributions."""
+    try:
+        if "lm_head" in params:
+            return params["lm_head"]["kernel"]
+        if "wte" in params:
+            return params["wte"]["embedding"].T
+    except (KeyError, TypeError):
+        return None
+    return None
+
+
+def linear_lm_loss(hidden, weight, input_ids,
+                   chunk: int | None = None) -> jax.Array:
+    """Next-token-prediction loss straight from the pre-head hidden
+    states: shift, flatten, head-folded chunked CE."""
+    h = hidden[:, :-1, :]
+    t = input_ids[:, 1:]
+    return fused_linear_cross_entropy(h, weight, t, chunk=chunk)

@@ -32,6 +32,7 @@ CPU-correct: numerics tests run on 8 forced host devices.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -187,6 +188,59 @@ def collective_decode_matmul(mesh, x, w, *, axis_name: str = "model"):
     return mapped(x, w)
 
 
+def ring_all_gather(shard, axis_name: str, axis: int):
+    """All-gather a leaf's sharded dim via n-1 single-neighbour
+    ``ppermute`` hops. Device r starts with global slice r of ``axis``
+    (the NamedSharding layout); at hop t it receives the slice of device
+    (r - t) mod n and writes it at its global offset. Each leaf's ring is
+    independent of every other leaf's — the latency-hiding scheduler is
+    free to run layer k's matmuls while layer k+1's params are still in
+    flight, which is the FSDP all-gather *prefetch* of
+    :func:`prefetched_fsdp_accum_grads`."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return shard
+    r = lax.axis_index(axis_name)
+    ring = [(i, (i + 1) % n) for i in range(n)]
+    size = shard.shape[axis]
+    full_shape = shard.shape[:axis] + (n * size,) + shard.shape[axis + 1:]
+    full = jnp.zeros(full_shape, shard.dtype)
+    full = lax.dynamic_update_slice_in_dim(full, shard, r * size, axis)
+    cur = shard
+    for t in range(1, n):
+        cur = lax.ppermute(cur, axis_name, ring)
+        src = jnp.mod(r - t, n)
+        full = lax.dynamic_update_slice_in_dim(full, cur, src * size, axis)
+    return full
+
+
+def ring_reduce_scatter(full, axis_name: str, axis: int):
+    """Sum ``full`` over the group, keeping only this device's global
+    slice of ``axis`` (travelling partial sum, n-1 hops + one alignment
+    hop so device r ends owning slice r — the NamedSharding layout the
+    optimizer update expects). The per-hop summand is *read* between
+    hops, so the hops carry no data dependency on concurrent compute."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return full
+    r = lax.axis_index(axis_name)
+    ring = [(i, (i + 1) % n) for i in range(n)]
+    size = full.shape[axis] // n
+
+    def chunk(idx):
+        return lax.dynamic_slice_in_dim(full, jnp.mod(idx, n) * size, size,
+                                        axis)
+
+    # after n-1 hops device r holds the group sum of chunk (r+1) mod n
+    # (same schedule as ring_all_reduce); one extra forward hop aligns
+    # ownership to device r <- chunk r
+    total = chunk(r)
+    for s in range(n - 1):
+        total = lax.ppermute(total, axis_name, ring)
+        total = total + chunk(r - 1 - s)
+    return lax.ppermute(total, axis_name, ring)
+
+
 def is_pure_data_parallel(mesh) -> bool:
     """True when every device sits on the ``data`` axis (params are then
     replicated, the precondition for the overlapped path)."""
@@ -245,3 +299,140 @@ def overlapped_accum_grads(mesh, loss_fn, params, batches, *, axis_name: str = "
         out_specs=(param_spec, P()),
     )
     return mapped(params, batches)
+
+
+def is_pure_fsdp(mesh) -> bool:
+    """True when every device sits on the ``fsdp`` axis (the planner's
+    ZeRO layout: params sharded leaf-wise, batch sharded over fsdp) —
+    the precondition for :func:`prefetched_fsdp_accum_grads`. Mixed
+    dp x fsdp or model-parallel meshes keep the GSPMD fallback."""
+    try:
+        shape = dict(mesh.shape)
+    except Exception:
+        return False
+    fsdp = shape.get("fsdp", 1)
+    return fsdp > 1 and all(v == 1 for k, v in shape.items() if k != "fsdp")
+
+
+def fsdp_prefetch_mode() -> str:
+    """``M2KT_FSDP_PREFETCH`` -> 'auto' | 'on' | 'off' (the serve-kernels
+    ladder spellings). auto/on take the prefetched path whenever
+    :func:`is_pure_fsdp` holds; off forces the sequential GSPMD
+    accumulation even there."""
+    raw = os.environ.get("M2KT_FSDP_PREFETCH", "auto").strip().lower()
+    if raw in ("on", "1", "true"):
+        return "on"
+    if raw in ("off", "0", "false"):
+        return "off"
+    return "auto"
+
+
+def _fsdp_leaf_dims(params, n: int, axis_name: str):
+    """Per-leaf index of the dim sharded over ``axis_name`` under the
+    repo's logical-axis heuristic (parallel/sharding.py — the same table
+    create_sharded_state placed the params with), or None for replicated
+    leaves and leaves whose sharded dim is not divisible by ``n`` (those
+    shard_map cannot split evenly; they ride the replicated bucket).
+    Returns (flat leaf list, treedef, dims list) in matching order."""
+    from move2kube_tpu.parallel.sharding import ShardingRules, infer_param_axes
+
+    rules = ShardingRules.default()
+    axes_tree = infer_param_axes(params)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    axes_leaves = treedef.flatten_up_to(axes_tree)
+
+    dims = []
+    for leaf, axes in zip(leaves, axes_leaves):
+        dim = None
+        spec = rules.spec(tuple(axes)) if axes else P()
+        for i, entry in enumerate(spec):
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if axis_name in names:
+                dim = i
+                break
+        if dim is not None and leaf.shape[dim] % n != 0:
+            dim = None
+        dims.append(dim)
+    return leaves, treedef, dims
+
+
+def prefetched_fsdp_accum_grads(mesh, loss_fn, params, batches, *,
+                                axis_name: str = "fsdp"):
+    """ZeRO-mesh counterpart of :func:`overlapped_accum_grads`: params
+    enter ``shard_map`` in their true sharded layout, are all-gathered
+    ONCE per step through independent per-leaf ppermute rings (GSPMD's
+    sequential accumulation re-gathers them for every microbatch, and
+    serializes each gather behind the compute that needs it — here layer
+    k's gather has no dependency on layer k-1's matmuls, so the
+    latency-hiding scheduler prefetches it while those grads compute),
+    and the per-microbatch grad reduce-scatter rides the scan carry
+    exactly like the pure-dp ring: microbatch k's reduction overlaps
+    microbatch k+1's backward. Grads come back in the params' own shard
+    layout (out_specs below), so the optimizer update and its donation
+    contract see exactly what the sequential path produces.
+
+    ``loss_fn(params, microbatch) -> scalar``; ``batches`` leaves are
+    ``[k, global_batch, ...]``. Returns (grads tree, loss) averaged over
+    microbatches and the group.
+    """
+    leaves, treedef, dims = _fsdp_leaf_dims(
+        params, dict(mesh.shape)[axis_name], axis_name)
+    batch_spec = jax.tree_util.tree_map(
+        lambda _: P(None, ("data", axis_name)), batches)
+
+    def leaf_spec(leaf, dim):
+        entries = [None] * leaf.ndim
+        if dim is not None:
+            entries[dim] = axis_name
+        return P(*entries)
+
+    param_specs = tuple(leaf_spec(l, d) for l, d in zip(leaves, dims))
+
+    def run(shards, mbs):
+        n = lax.psum(1, axis_name)
+        k = jax.tree_util.tree_leaves(mbs)[0].shape[0]
+
+        # prefetch: one independent all-gather ring per sharded leaf
+        full = [x if d is None else ring_all_gather(x, axis_name, d)
+                for x, d in zip(shards, dims)]
+        p_full = jax.tree_util.tree_unflatten(treedef, full)
+
+        def fwd_bwd(mb):
+            loss, g = jax.value_and_grad(loss_fn)(p_full, mb)
+            return loss, list(treedef.flatten_up_to(g))
+
+        def reduce(pending):
+            # sharded leaves: travelling-sum ring reduce-scatter back to
+            # the shard layout; replicated leaves: one bucketed ring
+            # all-reduce. Neither depends on the concurrent backward.
+            rep = [x for x, d in zip(pending, dims) if d is None]
+            rep = iter(ring_all_reduce(rep, axis_name) if rep else [])
+            return [next(rep) if d is None
+                    else ring_reduce_scatter(x, axis_name, d)
+                    for x, d in zip(pending, dims)]
+
+        loss0, g0 = fwd_bwd(jax.tree_util.tree_map(lambda x: x[0], mbs))
+
+        def body(carry, mb):
+            acc, pending = carry
+            reduced = reduce(pending)  # <- independent of fwd_bwd(mb)
+            loss, g = fwd_bwd(mb)
+            acc = [a + r for a, r in zip(acc, reduced)]
+            return (acc, g), loss
+
+        rest = jax.tree_util.tree_map(lambda x: x[1:], mbs)
+        zeros = [jnp.zeros_like(x) for x in shards]
+        (acc, last), losses = lax.scan(body, (zeros, g0), rest)
+        acc = [a + r for a, r in zip(acc, reduce(last))]
+        grads = tuple((a / (k * n)).astype(a.dtype) for a in acc)
+        loss = (loss0 + jnp.sum(losses)) / k
+        loss = lax.psum(loss, axis_name) / n
+        return grads, loss
+
+    mapped = shard_map(
+        run, mesh=mesh,
+        in_specs=(param_specs, batch_spec),
+        out_specs=(param_specs, P()),
+    )
+    grads, loss = mapped(tuple(leaves), batches)
+    return jax.tree_util.tree_unflatten(treedef, list(grads)), loss

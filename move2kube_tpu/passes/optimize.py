@@ -122,10 +122,11 @@ def tpu_training_optimizer(ir: IR) -> IR:
     """Bake the training knobs into accelerated services' pod env.
 
     Asks the SAME QA problems as the jax-xla emitter
-    (``m2kt.services.<name>.tpu.precision`` / ``.tpu.gradaccum``) — one
-    logical knob per service, answered once, cache-consistent: the
-    emitted trainer's baked-in default and the JobSet's explicit
-    ``M2KT_PRECISION`` / ``M2KT_GRAD_ACCUM`` env always agree. The env
+    (``m2kt.services.<name>.tpu.precision`` / ``.tpu.gradaccum`` /
+    ``.train.fusedce``) — one logical knob per service, answered once,
+    cache-consistent: the emitted trainer's baked-in default and the
+    JobSet's explicit ``M2KT_PRECISION`` / ``M2KT_GRAD_ACCUM`` /
+    ``M2KT_FUSED_CE`` env always agree. The env
     entries win inside the trainer (os.environ.get over the template
     default), so editing the YAML retunes a deployed run without a
     rebuild. Existing entries of the same name are never overwritten."""
@@ -157,11 +158,21 @@ def tpu_training_optimizer(ir: IR) -> IR:
             grad_accum = max(1, int(raw))
         except (TypeError, ValueError):
             grad_accum = 1
+        raw = qa.fetch_select(
+            f"m2kt.services.{name}.train.fusedce",
+            f"Select the fused LM-head cross-entropy mode for [{name}]",
+            ["auto fuses the chunked online-logsumexp loss when the vocab "
+             "spans multiple chunks (the [B,T,V] logit tensor never "
+             "materializes); on forces it; off keeps the jnp reference "
+             "loss"],
+            "auto", ["auto", "on", "off"])
+        fused_ce = raw if raw in ("auto", "on", "off") else "auto"
         for container in svc.containers:
             env = container.setdefault("env", [])
             existing = {e.get("name") for e in env}
             for env_name, value in (("M2KT_PRECISION", precision),
-                                    ("M2KT_GRAD_ACCUM", str(grad_accum))):
+                                    ("M2KT_GRAD_ACCUM", str(grad_accum)),
+                                    ("M2KT_FUSED_CE", fused_ce)):
                 if env_name not in existing:
                     env.append({"name": env_name, "value": value})
     return ir

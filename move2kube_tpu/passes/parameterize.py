@@ -54,13 +54,15 @@ def storage_class_parameterizer(ir: IR) -> IR:
 
 def tpu_training_parameterizer(ir: IR) -> IR:
     """Lift the training knobs the optimizer pass injected
-    (``M2KT_PRECISION`` / ``M2KT_GRAD_ACCUM``) into chart values, so a
-    Helm install retunes precision and accumulation per environment
-    (``--set tpuprecision=bf16-scaled``) without touching the manifests.
-    First accelerated service seeds the defaults (one global knob pair —
-    same shape as ``ingresshost``)."""
+    (``M2KT_PRECISION`` / ``M2KT_GRAD_ACCUM`` / ``M2KT_FUSED_CE``) into
+    chart values, so a Helm install retunes precision, accumulation, and
+    the fused LM-head cross-entropy dispatch per environment
+    (``--set tpuprecision=bf16-scaled --set tpufusedce=off``) without
+    touching the manifests. First accelerated service seeds the defaults
+    (one global knob set — same shape as ``ingresshost``)."""
     lifted = {"M2KT_PRECISION": "tpuprecision",
-              "M2KT_GRAD_ACCUM": "tpugradaccum"}
+              "M2KT_GRAD_ACCUM": "tpugradaccum",
+              "M2KT_FUSED_CE": "tpufusedce"}
     for svc in ir.services.values():
         if getattr(svc, "accelerator", None) is None:
             continue

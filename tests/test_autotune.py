@@ -179,6 +179,117 @@ def test_paged_default_ppt_fills_min_sublanes(monkeypatch):
         (4, 4, 32), (65, 8, 2, 32), "int8") == 4
 
 
+# ------------------------------------------------- backward-pass autotune
+
+def _fake_bwd_residuals(monkeypatch):
+    """_sweep_bwd_blocks synthesizes (o, lse) via the REAL forward kernel
+    (no interpret), which cannot run on CPU; the sweep only threads them
+    into _measure_bwd_blocks, so shape-correct zeros suffice here."""
+
+    def fake_forward(q, k, v, causal, scale, return_residuals=False, **kw):
+        b, s, h, d = q.shape
+        o = jnp.zeros_like(q)
+        lse = jnp.zeros((b * h, s, attention._LANES), jnp.float32)
+        return (o, lse) if return_residuals else o
+
+    monkeypatch.setattr(attention, "_flash_attention_tpu", fake_forward)
+
+
+def test_bwd_sweep_grid_once_then_cached(monkeypatch, tmp_path):
+    """The backward sweep really walks the candidate grid, caches its
+    winner in-process, and persists it under flash_bwd:...:dq+dkv."""
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "1")
+    _fake_bwd_residuals(monkeypatch)
+    calls = []
+
+    def fake_measure(q, k, v, o, lse, g, causal, scale, block_q, block_k):
+        calls.append((block_q, block_k))
+        return 0.5 if (block_q, block_k) == (128, 256) else 1.0
+
+    monkeypatch.setattr(attention, "_measure_bwd_blocks", fake_measure)
+    win = attention.get_bwd_block_sizes(SHAPE, KV_SEQ, "float32", True)
+    assert win == (128, 256)
+    n_swept = len(calls)
+    assert n_swept >= 2  # really swept a grid, not a single point
+
+    # second call: in-process cache, no re-sweep
+    assert attention.get_bwd_block_sizes(SHAPE, KV_SEQ, "float32",
+                                         True) == win
+    assert len(calls) == n_swept
+
+    # fresh process: the disk entry answers under its own kernel prefix
+    attention._reset_block_cache()
+    assert attention.get_bwd_block_sizes(SHAPE, KV_SEQ, "float32",
+                                         True) == win
+    assert len(calls) == n_swept
+    data = json.loads((tmp_path / "blocks.json").read_text())
+    assert all(k.startswith("flash_bwd:") and k.endswith(":dq+dkv")
+               for k in data)
+
+
+def test_bwd_disabled_falls_back_to_forward_winner(monkeypatch):
+    """Tuning off: the backward reuses the forward's cached winner for
+    the shape (never sweeping), then the measured defaults."""
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "0")
+
+    def boom(*a, **k):
+        raise AssertionError("bwd sweep must not run when disabled")
+
+    monkeypatch.setattr(attention, "_sweep_bwd_blocks", boom)
+    fwd_key = attention._cache_key(SHAPE, KV_SEQ, "float32", True)
+    attention._block_cache[fwd_key] = (512, 1024)
+    assert attention.get_bwd_block_sizes(SHAPE, KV_SEQ, "float32",
+                                         True) == (512, 1024)
+    attention._reset_block_cache()
+    assert attention.get_bwd_block_sizes(SHAPE, KV_SEQ, "float32",
+                                         True) == (
+        attention.DEFAULT_BLOCK_Q, attention.DEFAULT_BLOCK_K)
+
+
+def test_bwd_seeded_key_wins_over_forward_winner(monkeypatch):
+    """A cached flash_bwd entry beats the forward winner for the same
+    geometry: the two kernels tune independently (the dkv kernel's VMEM
+    budget tilts toward smaller tiles than the forward's)."""
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "1")
+
+    def boom(*a, **k):
+        raise AssertionError("cached bwd winner must suppress the sweep")
+
+    monkeypatch.setattr(attention, "_sweep_bwd_blocks", boom)
+    fwd_key = attention._cache_key(SHAPE, KV_SEQ, "float32", True)
+    bwd_key = attention._cache_key(SHAPE, KV_SEQ, "float32", True,
+                                   kernel="flash_bwd", geometry="dq+dkv")
+    assert fwd_key != bwd_key
+    attention._block_cache[fwd_key] = (512, 1024)
+    attention._block_cache[bwd_key] = (128, 128)
+    assert attention.get_bwd_block_sizes(SHAPE, KV_SEQ, "float32",
+                                         True) == (128, 128)
+
+
+def test_bwd_no_sweep_in_interpret_mode(monkeypatch):
+    """Interpreter mode (CPU kernel-body validation) must skip straight
+    to the cached/forward/default ladder: a grad through the custom_vjp
+    runs the REAL backward kernels without ever timing candidates."""
+    monkeypatch.setenv("M2KT_FLASH_AUTOTUNE", "1")
+    monkeypatch.setattr(attention, "_INTERPRET", True)
+
+    def boom(*a, **k):
+        raise AssertionError("bwd sweep must not run in interpret mode")
+
+    monkeypatch.setattr(attention, "_sweep_bwd_blocks", boom)
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (1, 128, 1, 64), jnp.float32)
+               for kk in ks)
+    scale = 64 ** -0.5
+    dq, dk, dv = jax.grad(
+        lambda q_, k_, v_: jnp.sum(
+            attention._flash_attention_diff(q_, k_, v_, True, scale) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g in (dq, dk, dv):
+        assert g.shape == q.shape
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+
 def test_interpret_mode_flash_matches_reference_with_autotune_defaults():
     """End-to-end sanity: the autotune-resolved default blocks keep the
     interpreter-mode kernel numerically identical to the reference."""

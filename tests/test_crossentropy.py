@@ -1,0 +1,510 @@
+"""Fused chunked LM-head cross-entropy (ops/crossentropy.py) + the
+training-step integration it feeds (models/train.py head folding,
+parallel/overlap.py fsdp all-gather prefetch).
+
+Layers under test:
+
+* knob parsing + the M2KT_FUSED_CE ladder (pure python);
+* fp32 exactness of the chunked online-logsumexp loss AND its
+  custom_vjp grads against the jnp reference (logits-level and
+  head-folded), bf16 gated at a relative tolerance;
+* dispatch: on/off/auto routing, warn-once trace-time fallback;
+* train-step head folding: the fused linear loss actually dispatches
+  (spy), matches the reference-CE step update on llama (separate head)
+  and gpt2 (tied embedding head), composes with loss scaling
+  (apply_if_finite skips poisoned steps), the numerics recorder, and
+  buffer donation;
+* fsdp prefetch: prefetched_fsdp_accum_grads vs the sequential GSPMD
+  fallback vs the plain step on the 8 forced host devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from move2kube_tpu.models import precision as m2kt_precision
+from move2kube_tpu.models import train as m2kt_train
+from move2kube_tpu.obs import numerics as m2kt_numerics
+from move2kube_tpu.ops import crossentropy as ce
+from move2kube_tpu.parallel.mesh import MeshConfig, make_mesh
+from move2kube_tpu.parallel.overlap import fsdp_prefetch_mode, is_pure_fsdp
+
+needs_8 = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 (forced host) devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    """Every test starts from default knobs and a clean warn-once set."""
+    for var in ("M2KT_FUSED_CE", "M2KT_CE_CHUNK", "M2KT_FSDP_PREFETCH"):
+        monkeypatch.delenv(var, raising=False)
+    ce._warned.clear()
+    yield
+    ce._warned.clear()
+
+
+def _mesh1():
+    return make_mesh(MeshConfig(), devices=jax.devices()[:1])
+
+
+def _rand(n=64, v=512, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 2)
+    logits = jax.random.normal(keys[0], (n, v), jnp.float32)
+    labels = jax.random.randint(keys[1], (n,), 0, v)
+    return logits, labels
+
+
+def _llama_fixture():
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32)
+    model = Llama(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (16, 32)))
+    params = model.init(jax.random.PRNGKey(0), ids[:2])["params"]
+
+    def fresh_state(params_, tx=None):
+        # donation deletes the input buffers: every state gets copies
+        return m2kt_train.TrainState.create(
+            apply_fn=model.apply,
+            params=jax.tree.map(lambda a: a.copy(), params_),
+            tx=tx if tx is not None else optax.sgd(1e-2))
+
+    return params, ids, fresh_state
+
+
+# ------------------------------------------------------------------ knobs
+
+def test_fused_ce_mode_spellings(monkeypatch):
+    for raw, want in (("on", "on"), ("1", "on"), ("true", "on"),
+                      ("off", "off"), ("0", "off"), ("false", "off"),
+                      (" ON ", "on"), ("banana", "auto"), ("auto", "auto")):
+        monkeypatch.setenv("M2KT_FUSED_CE", raw)
+        assert ce.fused_ce_mode() == want, raw
+    monkeypatch.delenv("M2KT_FUSED_CE")
+    assert ce.fused_ce_mode() == "auto"
+
+
+def test_ce_chunk_size(monkeypatch):
+    assert ce.ce_chunk_size() == ce.DEFAULT_CHUNK
+    monkeypatch.setenv("M2KT_CE_CHUNK", "4096")
+    assert ce.ce_chunk_size() == 4096
+    monkeypatch.setenv("M2KT_CE_CHUNK", "2")  # floored: sub-8 slivers
+    assert ce.ce_chunk_size() == 8
+    monkeypatch.setenv("M2KT_CE_CHUNK", "banana")
+    assert ce.ce_chunk_size() == ce.DEFAULT_CHUNK
+
+
+def test_pick_chunk_divisor_rules():
+    assert ce.pick_chunk(4096, 2048) == 2048
+    assert ce.pick_chunk(32000, 2048) == 2000   # largest divisor <= 2048
+    assert ce.pick_chunk(512, 2048) == 512      # vocab smaller than chunk
+    assert ce.pick_chunk(65537, 2048) == 65537  # prime: one chunk, no slivers
+    assert ce.pick_chunk(96, 64) == 48          # small vocab may chunk small
+    # every answer divides the vocab (the loop is vocab // chunk)
+    for v, r in ((4096, 2048), (32000, 2048), (65537, 2048), (96, 64)):
+        assert v % ce.pick_chunk(v, r) == 0
+
+
+def test_should_fuse_ladder(monkeypatch):
+    monkeypatch.setenv("M2KT_FUSED_CE", "on")
+    assert ce.should_fuse(16)
+    monkeypatch.setenv("M2KT_FUSED_CE", "off")
+    assert not ce.should_fuse(10 ** 6)
+    monkeypatch.delenv("M2KT_FUSED_CE")
+    # auto: engage only when the vocab spans multiple chunks
+    assert not ce.should_fuse(ce.DEFAULT_CHUNK)
+    assert ce.should_fuse(ce.DEFAULT_CHUNK + 1)
+    monkeypatch.setenv("M2KT_CE_CHUNK", "64")
+    assert ce.should_fuse(128)
+
+
+# --------------------------------------------------------- fp32 exactness
+
+@pytest.mark.parametrize("chunk", [512, 64])
+def test_fused_ce_matches_reference_fp32(chunk):
+    """Loss AND logits-grad equality at fp32 (chunk reassociation of the
+    logsumexp is the only difference), single- and multi-chunk, with
+    labels pinned on chunk boundaries."""
+    logits, labels = _rand()
+    labels = labels.at[:4].set(jnp.array([0, chunk - 1, chunk % 512, 511]))
+
+    loss_f, g_f = jax.value_and_grad(
+        lambda l: ce.fused_cross_entropy(l, labels, chunk=chunk))(logits)
+    loss_r, g_r = jax.value_and_grad(
+        lambda l: ce.reference_cross_entropy(l, labels))(logits)
+    np.testing.assert_allclose(float(loss_f), float(loss_r), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_r), atol=1e-6)
+
+
+def test_fused_ce_leading_shape_flattened():
+    """[B, T, V] logits + [B, T] labels flatten to the same mean loss."""
+    logits, labels = _rand(n=32)
+    flat = ce.fused_cross_entropy(logits, labels, chunk=64)
+    batched = ce.fused_cross_entropy(
+        logits.reshape(4, 8, -1), labels.reshape(4, 8), chunk=64)
+    np.testing.assert_allclose(float(flat), float(batched), atol=1e-7)
+
+
+@pytest.mark.parametrize("chunk", [512, 64])
+def test_fused_linear_ce_matches_reference_fp32(chunk):
+    """Head-folded variant: loss + grads wrt BOTH hidden and weight match
+    the materialize-the-logits reference."""
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    h = jax.random.normal(keys[0], (48, 32), jnp.float32)
+    w = jax.random.normal(keys[1], (32, 512), jnp.float32) * 0.1
+    labels = jax.random.randint(keys[2], (48,), 0, 512)
+
+    def fused(h_, w_):
+        return ce.fused_linear_cross_entropy(h_, w_, labels, chunk=chunk)
+
+    def ref(h_, w_):
+        return ce.reference_cross_entropy(h_ @ w_, labels)
+
+    loss_f, (dh_f, dw_f) = jax.value_and_grad(fused, argnums=(0, 1))(h, w)
+    loss_r, (dh_r, dw_r) = jax.value_and_grad(ref, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(float(loss_f), float(loss_r), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dh_f), np.asarray(dh_r), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw_f), np.asarray(dw_r), atol=1e-5)
+
+
+def test_fused_linear_ce_bf16_gate():
+    """bf16 hidden/weight at a multi-chunk vocab: loss within bf16
+    resolution of the fp32 reference, grads within 5% relative norm and
+    in the primal dtypes (custom_vjp dtype contract)."""
+    keys = jax.random.split(jax.random.PRNGKey(2), 3)
+    h = jax.random.normal(keys[0], (128, 64), jnp.bfloat16)
+    w = (jax.random.normal(keys[1], (64, 8192), jnp.float32)
+         * 0.05).astype(jnp.bfloat16)
+    labels = jax.random.randint(keys[2], (128,), 0, 8192)
+
+    loss_f, (dh, dw) = jax.value_and_grad(
+        lambda h_, w_: ce.fused_linear_cross_entropy(h_, w_, labels),
+        argnums=(0, 1))(h, w)
+    h32, w32 = h.astype(jnp.float32), w.astype(jnp.float32)
+    loss_r, (dh_r, dw_r) = jax.value_and_grad(
+        lambda h_, w_: ce.reference_cross_entropy(h_ @ w_, labels),
+        argnums=(0, 1))(h32, w32)
+
+    assert dh.dtype == jnp.bfloat16 and dw.dtype == jnp.bfloat16
+    assert abs(float(loss_f) - float(loss_r)) / abs(float(loss_r)) < 2e-2
+    for got, want in ((dh, dh_r), (dw, dw_r)):
+        num = float(jnp.linalg.norm(got.astype(jnp.float32) - want))
+        den = float(jnp.linalg.norm(want)) + 1e-12
+        assert num / den < 5e-2
+
+
+# --------------------------------------------------------------- dispatch
+
+def test_dispatch_on_routes_to_fused(monkeypatch):
+    monkeypatch.setenv("M2KT_FUSED_CE", "on")
+    logits, labels = _rand(n=8, v=32)
+    calls = []
+    real = ce.fused_cross_entropy
+    monkeypatch.setattr(ce, "fused_cross_entropy",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    out = ce.cross_entropy(logits, labels)
+    assert calls and jnp.isfinite(out)
+
+
+def test_dispatch_off_routes_to_reference(monkeypatch):
+    monkeypatch.setenv("M2KT_FUSED_CE", "off")
+    logits, labels = _rand(n=8, v=4096)
+
+    def boom(*a, **k):
+        raise AssertionError("fused path must not run when off")
+
+    monkeypatch.setattr(ce, "fused_cross_entropy", boom)
+    out = ce.cross_entropy(logits, labels)
+    np.testing.assert_allclose(
+        float(out), float(ce.reference_cross_entropy(logits, labels)),
+        atol=1e-7)
+
+
+def test_dispatch_auto_small_vocab_stays_reference(monkeypatch):
+    logits, labels = _rand(n=8, v=512)  # 512 <= default 2048 chunk
+
+    def boom(*a, **k):
+        raise AssertionError("auto must not fuse a single-chunk vocab")
+
+    monkeypatch.setattr(ce, "fused_cross_entropy", boom)
+    assert jnp.isfinite(ce.cross_entropy(logits, labels))
+
+
+def test_dispatch_auto_multichunk_vocab_fuses(monkeypatch):
+    monkeypatch.setenv("M2KT_CE_CHUNK", "16")
+    logits, labels = _rand(n=8, v=64)
+    calls = []
+    real = ce.fused_cross_entropy
+    monkeypatch.setattr(ce, "fused_cross_entropy",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    assert jnp.isfinite(ce.cross_entropy(logits, labels))
+    assert calls
+
+
+def test_dispatch_failure_falls_back_with_one_warning(monkeypatch):
+    monkeypatch.setenv("M2KT_FUSED_CE", "on")
+    logits, labels = _rand(n=8, v=32)
+
+    def broken(*a, **k):
+        raise ValueError("injected trace-time failure")
+
+    monkeypatch.setattr(ce, "fused_cross_entropy", broken)
+    want = float(ce.reference_cross_entropy(logits, labels))
+    for _ in range(2):  # second call: warn-once, still falls back
+        np.testing.assert_allclose(
+            float(ce.cross_entropy(logits, labels)), want, atol=1e-7)
+    assert ce._warned == {"fused_cross_entropy"}
+
+
+# --------------------------------------------------------- head detection
+
+def test_lm_head_weight_layouts():
+    w = jnp.ones((8, 32))
+    e = jnp.ones((32, 8))
+    assert ce.lm_head_weight({"lm_head": {"kernel": w}}) is w
+    tied = ce.lm_head_weight({"wte": {"embedding": e}})
+    assert tied.shape == (8, 32)
+    assert ce.lm_head_weight({"dense": {"kernel": w}}) is None
+    assert ce.lm_head_weight([w]) is None
+
+
+# --------------------------------------------- train-step head folding
+
+def test_train_step_dispatches_head_folded_loss(monkeypatch):
+    monkeypatch.setenv("M2KT_FUSED_CE", "on")
+    params, ids, fresh_state = _llama_fixture()
+    calls = []
+    real = ce.fused_linear_cross_entropy
+    monkeypatch.setattr(ce, "fused_linear_cross_entropy",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    step = m2kt_train.make_lm_train_step(_mesh1(), remat=False)
+    _, loss = step(fresh_state(params), {"input_ids": ids[:4]})
+    assert calls, "head-folded fused CE never dispatched"
+    assert jnp.isfinite(loss)
+
+
+def _step_update(mesh, params, ids, fresh_state, **kw):
+    step = m2kt_train.make_lm_train_step(mesh, remat=False, **kw)
+    state, loss = step(fresh_state(params), {"input_ids": ids})
+    return state, float(loss)
+
+
+def test_head_folded_step_matches_reference_step_llama(monkeypatch):
+    """One optimizer update with the fused head-folded loss vs the
+    reference logits path: llama_tiny's 512 vocab at fp32 must agree to
+    1e-5 on the loss and every param leaf."""
+    params, ids, fresh_state = _llama_fixture()
+    mesh = _mesh1()
+    monkeypatch.setenv("M2KT_FUSED_CE", "on")
+    s_fused, l_fused = _step_update(mesh, params, ids, fresh_state)
+    monkeypatch.setenv("M2KT_FUSED_CE", "off")
+    s_ref, l_ref = _step_update(mesh, params, ids, fresh_state)
+    np.testing.assert_allclose(l_fused, l_ref, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_fused.params),
+                    jax.tree.leaves(s_ref.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_head_folded_step_matches_reference_step_gpt2_tied(monkeypatch):
+    """gpt2's head is the TIED token embedding (lm_head_weight returns
+    wte.T): the fused path must route grads back into the embedding —
+    both the head contribution and the input-embedding contribution —
+    to reproduce the reference update."""
+    from move2kube_tpu.models.gpt2 import GPT2, gpt2_tiny
+
+    cfg = dataclasses.replace(gpt2_tiny(), dtype=jnp.float32)
+    model = GPT2(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (4, 32)))
+    params = model.init(jax.random.PRNGKey(0), ids[:2])["params"]
+
+    def fresh_state(params_):
+        return m2kt_train.TrainState.create(
+            apply_fn=model.apply,
+            params=jax.tree.map(lambda a: a.copy(), params_),
+            tx=optax.sgd(1e-2))
+
+    mesh = _mesh1()
+    calls = []
+    real = ce.fused_linear_cross_entropy
+    monkeypatch.setattr(ce, "fused_linear_cross_entropy",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    monkeypatch.setenv("M2KT_FUSED_CE", "on")
+    s_fused, l_fused = _step_update(mesh, params, ids, fresh_state)
+    assert calls, "tied-head fused CE never dispatched"
+    monkeypatch.setenv("M2KT_FUSED_CE", "off")
+    s_ref, l_ref = _step_update(mesh, params, ids, fresh_state)
+    np.testing.assert_allclose(l_fused, l_ref, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_fused.params),
+                    jax.tree.leaves(s_ref.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_head_folded_step_donates_state(monkeypatch):
+    monkeypatch.setenv("M2KT_FUSED_CE", "on")
+    params, ids, fresh_state = _llama_fixture()
+    step = m2kt_train.make_lm_train_step(_mesh1(), remat=False)
+    n = m2kt_train.assert_state_donated(step, fresh_state(params),
+                                        {"input_ids": ids[:4]})
+    assert n >= len(jax.tree.leaves(params))
+
+
+# ------------------------------------- precision + numerics composition
+
+def test_fused_step_with_loss_scaling_skips_poisoned_update(monkeypatch):
+    """Fused CE under a loss-scaled policy: a clean step applies (scaled
+    grads unscale back to the plain update) and a NaN-poisoned head makes
+    apply_if_finite SKIP the update — params untouched, the skip counter
+    and the numerics recorder both see it."""
+    monkeypatch.setenv("M2KT_FUSED_CE", "on")
+    params, ids, fresh_state = _llama_fixture()
+    mesh = _mesh1()
+    pol = dataclasses.replace(m2kt_precision.policy("fp32"),
+                              name="fp32-scaled", loss_scale=2.0)
+    tx = optax.chain(m2kt_numerics.health_recorder(True),
+                     pol.wrap_optimizer(optax.sgd(1e-2)))
+    step = m2kt_train.make_lm_train_step(mesh, remat=False, precision=pol)
+
+    # clean step: applied, loss reported unscaled
+    state, loss = step(fresh_state(params, tx=tx), {"input_ids": ids[:4]})
+    assert m2kt_precision.skipped_updates(state) == 0
+    plain = m2kt_train.make_lm_train_step(mesh, remat=False)
+    _, loss_plain = plain(fresh_state(params), {"input_ids": ids[:4]})
+    np.testing.assert_allclose(float(loss), float(loss_plain), atol=1e-5)
+
+    # poisoned head: NaN flows through the fused loss into every grad
+    bad = jax.tree.map(lambda a: a.copy(), params)
+    bad["lm_head"]["kernel"] = bad["lm_head"]["kernel"].at[0, 0].set(
+        jnp.nan)
+    state2, loss2 = step(fresh_state(bad, tx=tx), {"input_ids": ids[:4]})
+    assert not bool(jnp.isfinite(loss2))
+    assert m2kt_precision.skipped_updates(state2) == 1
+    np.testing.assert_array_equal(
+        np.asarray(state2.params["lm_head"]["kernel"])[1:],
+        np.asarray(bad["lm_head"]["kernel"])[1:])
+    health = m2kt_numerics.health_from_state(state2)
+    assert int(jnp.sum(health.grad_nonfinite)) > 0
+
+
+def test_fused_step_numerics_parity_with_reference(monkeypatch):
+    """The in-graph tensor-health stats recorded during a fused step must
+    match the reference step's (same grads -> same forensics)."""
+    params, ids, fresh_state = _llama_fixture()
+    mesh = _mesh1()
+
+    def health(env):
+        monkeypatch.setenv("M2KT_FUSED_CE", env)
+        tx = optax.chain(m2kt_numerics.health_recorder(True),
+                         optax.sgd(1e-2))
+        step = m2kt_train.make_lm_train_step(mesh, remat=False)
+        state, _ = step(fresh_state(params, tx=tx), {"input_ids": ids[:4]})
+        return m2kt_numerics.health_from_state(state)
+
+    h_fused, h_ref = health("on"), health("off")
+    assert int(jnp.sum(h_fused.grad_nonfinite)) == 0
+    np.testing.assert_allclose(np.asarray(h_fused.grad_rms),
+                               np.asarray(h_ref.grad_rms),
+                               rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(h_fused.grad_max_abs),
+                               np.asarray(h_ref.grad_max_abs),
+                               rtol=1e-4, atol=1e-7)
+
+
+# ------------------------------------------------- fsdp prefetch ladder
+
+def test_is_pure_fsdp_cases():
+    from jax.sharding import AbstractMesh
+
+    def amesh(**sizes):
+        base = {"data": 1, "fsdp": 1, "pipe": 1, "tensor": 1, "seq": 1,
+                "expert": 1}
+        base.update(sizes)
+        return AbstractMesh(tuple(base.items()))
+
+    assert is_pure_fsdp(amesh(fsdp=8))
+    assert not is_pure_fsdp(amesh(data=8))
+    assert not is_pure_fsdp(amesh(data=2, fsdp=4))
+    assert not is_pure_fsdp(amesh(fsdp=4, tensor=2))
+    assert not is_pure_fsdp(amesh())
+    assert not is_pure_fsdp(object())
+
+
+def test_fsdp_prefetch_mode_spellings(monkeypatch):
+    assert fsdp_prefetch_mode() == "auto"
+    for raw, want in (("on", "on"), ("1", "on"), ("off", "off"),
+                      ("0", "off"), ("FALSE", "off"), ("banana", "auto")):
+        monkeypatch.setenv("M2KT_FSDP_PREFETCH", raw)
+        assert fsdp_prefetch_mode() == want, raw
+
+
+@needs_8
+def test_prefetched_fsdp_matches_sequential_and_plain(monkeypatch):
+    """grad_accum=2 on a pure-fsdp mesh: the prefetched ring path (auto)
+    must reproduce both the M2KT_FSDP_PREFETCH=off sequential GSPMD scan
+    and the plain single-step update on the flattened batch."""
+    params, ids, fresh_state = _llama_fixture()
+    mesh = make_mesh(MeshConfig(fsdp=8))
+    assert is_pure_fsdp(mesh)
+
+    step_plain = m2kt_train.make_lm_train_step(mesh, remat=False)
+    step_pref = m2kt_train.make_lm_train_step(mesh, remat=False,
+                                              grad_accum=2)
+    monkeypatch.setenv("M2KT_FSDP_PREFETCH", "off")
+    step_seq = m2kt_train.make_lm_train_step(mesh, remat=False,
+                                             grad_accum=2)
+
+    s_plain, l_plain = step_plain(fresh_state(params), {"input_ids": ids})
+    micro = {"input_ids": ids.reshape(2, 8, 32)}
+    s_pref, l_pref = step_pref(fresh_state(params), micro)
+    s_seq, l_seq = step_seq(fresh_state(params), micro)
+
+    np.testing.assert_allclose(float(l_pref), float(l_plain), atol=1e-5)
+    np.testing.assert_allclose(float(l_pref), float(l_seq), atol=1e-5)
+    for a, b, c in zip(jax.tree.leaves(s_pref.params),
+                       jax.tree.leaves(s_seq.params),
+                       jax.tree.leaves(s_plain.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=1e-5)
+
+
+@needs_8
+def test_fused_ce_composes_with_fsdp_prefetch(monkeypatch):
+    """The whole tentpole at once: head-folded fused CE dispatched inside
+    the prefetched fsdp accumulation reproduces the fused plain step."""
+    monkeypatch.setenv("M2KT_FUSED_CE", "on")
+    params, ids, fresh_state = _llama_fixture()
+    mesh = make_mesh(MeshConfig(fsdp=8))
+    calls = []
+    real = ce.fused_linear_cross_entropy
+    monkeypatch.setattr(ce, "fused_linear_cross_entropy",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+
+    step_plain = m2kt_train.make_lm_train_step(mesh, remat=False)
+    step_pref = m2kt_train.make_lm_train_step(mesh, remat=False,
+                                              grad_accum=2)
+    s_plain, l_plain = step_plain(fresh_state(params), {"input_ids": ids})
+    s_pref, l_pref = step_pref(fresh_state(params),
+                               {"input_ids": ids.reshape(2, 8, 32)})
+    assert calls, "fused CE never dispatched on the fsdp mesh"
+    np.testing.assert_allclose(float(l_pref), float(l_plain), atol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_pref.params),
+                    jax.tree.leaves(s_plain.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@needs_8
+def test_prefetched_fsdp_step_donates_state(monkeypatch):
+    monkeypatch.setenv("M2KT_FUSED_CE", "on")
+    params, ids, fresh_state = _llama_fixture()
+    mesh = make_mesh(MeshConfig(fsdp=8))
+    step = m2kt_train.make_lm_train_step(mesh, remat=False, grad_accum=2)
+    n = m2kt_train.assert_state_donated(
+        step, fresh_state(params), {"input_ids": ids.reshape(2, 8, 32)})
+    assert n >= len(jax.tree.leaves(params))
