@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke trainkernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke trainkernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke sched-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -198,6 +198,16 @@ swap-smoke:
 numerics-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_numerics.py -q
 	$(CPU_ENV) $(PY) bench.py --model numerics
+
+# scheduler plane in isolation (CPU-mode): admission quotas + priority
+# preemption with token-exact journal resume + chunked prefill + paged
+# multi-LoRA equivalence, then the bench sched phase (best-effort flood
+# vs one high-priority tenant; FAILS unless gold p95 TTFT holds the SLO,
+# every preempted stream resumes token-exact, and each adapter in the
+# multi-LoRA batch matches a dedicated engine)
+sched-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_sched.py -q
+	$(CPU_ENV) $(PY) bench.py --model sched
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -68,7 +68,7 @@ MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
 PHASES = ("resnet", "bert", "pallas", "llama", "translate", "goodput",
           "scaling", "serving", "fleet", "quant", "kernels", "obs",
-          "chaos", "swap", "numerics")
+          "chaos", "swap", "numerics", "sched")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
@@ -87,6 +87,7 @@ PHASE_METRICS = {
     "chaos": ("chaos_recovered_token_exact_fraction", "fraction"),
     "swap": ("swap_cold_join_ttft_speedup", "x"),
     "numerics": ("numerics_telemetry_overhead_fraction", "fraction"),
+    "sched": ("multilora_aggregate_tokens_s", "tok/s"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
@@ -1317,6 +1318,265 @@ def run_fleet_probe() -> int:
         "trace_residual_s": decomp["residual_s"],
         "trace_parts": len(decomp["parts"]),
         "trace_e2e_ms": round(decomp["e2e_s"] * 1e3, 3),
+    }), flush=True)
+    return 0
+
+
+# round-14 prefix-cached fleet throughput capture (BENCH_NOTES round 14:
+# "674 vs 269 tok/s") — the scheduler plane's multi-LoRA batch must not
+# give back what the cache bought
+SCHED_TPUT_BASELINE = 674.0
+
+
+def bench_sched(n: int) -> dict:
+    """Scheduler-plane phase on forced host devices: a best-effort flood
+    holds every decode slot of a single replica while a high-priority
+    tenant keeps arriving, so each gold request can only land by
+    preempting a victim; then a paged multi-LoRA batch serves two
+    adapters plus the base model from ONE engine. The phase FAILS unless
+    (a) the gold tenant's p95 TTFT holds the SLO target under the flood
+    and its per-tenant fast-burn input stays quiet, (b) every preempted
+    best-effort request finishes token-exactly (fraction 1.0) vs an
+    uninterrupted greedy run, and (c) each adapter's batched output
+    matches a dedicated merged-weight engine. Reports the multi-LoRA
+    aggregate tok/s against the round-14 fleet capture. Own subprocess
+    for the usual reason: the probe must own jax's platform env before
+    import."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    # drill-scale the SLO windows so the gold tenant's burn-rate gate
+    # reads a window its handful of requests can actually fill
+    env.setdefault("M2KT_SLO_WINDOW_SCALE", "0.01")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sched-probe"],
+        env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"sched probe rc={res.returncode}: {res.stderr[-300:]}")
+    probe = json.loads(res.stdout.strip().splitlines()[-1])
+    dt = time.perf_counter() - t0
+    print(f"[bench] sched: {probe['preempted']} preemptions, resume "
+          f"exact fraction {probe['preempt_exact_fraction']:.2f}, gold "
+          f"p95 TTFT {probe['gold_p95_ttft_ms']:.2f}ms (SLO "
+          f"{probe['gold_ttft_slo_ms']:.0f}ms, burn "
+          f"{probe['gold_burn_fast_short']:.1f}<{probe['fast_burn_limit']}"
+          f"); multi-LoRA x{probe['lora_adapters']} "
+          f"{probe['multilora_aggregate_tokens_s']:.1f} tok/s in {dt:.1f}s",
+          file=sys.stderr)
+    metric, unit = PHASE_METRICS["sched"]
+    return {"phase": "sched", "metric": metric,
+            "value": probe["multilora_aggregate_tokens_s"], "unit": unit,
+            "vs_baseline": round(
+                probe["multilora_aggregate_tokens_s"]
+                / SCHED_TPUT_BASELINE, 3),
+            "baseline": "round14_fleet_cached_674_tok_s",
+            "preempted": probe["preempted"],
+            "preempt_exact_fraction": probe["preempt_exact_fraction"],
+            "resumed_reasons": probe["resumed_reasons"],
+            "gold_p95_ttft_ms": probe["gold_p95_ttft_ms"],
+            "gold_ttft_slo_ms": probe["gold_ttft_slo_ms"],
+            "gold_burn_fast_short": probe["gold_burn_fast_short"],
+            "fast_burn_limit": probe["fast_burn_limit"],
+            "lora_adapters": probe["lora_adapters"],
+            "multilora_requests": probe["multilora_requests"],
+            "multilora_executables": probe["multilora_executables"],
+            "slo_window_scale": probe["slo_window_scale"],
+            "wall_s": round(dt, 2)}
+
+
+def run_sched_probe() -> int:
+    """In-process half of the sched phase (spawned by bench_sched with
+    jax forced onto host devices). Part 1: priority-preemption drill
+    through the router — two best-effort streams saturate a 2-slot
+    engine, gold requests arrive and must evict to land, the victims
+    resume token-exactly from the journal. Part 2: multi-LoRA batch —
+    base + two adapters decode together in one engine; each adapter's
+    tokens must equal a dedicated engine built with the LoRA delta
+    merged into the lm_head weights. Prints one JSON line."""
+    import dataclasses
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.obs.slo import FAST_BURN
+    from move2kube_tpu.serving.engine import (EngineConfig, Request,
+                                              ServingEngine)
+    from move2kube_tpu.serving.fleet.router import RouterConfig, build_fleet
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(11)
+
+    # ---- part 1: preemption drill ------------------------------------
+    # one replica, TWO slots: both held by best-effort decode so a gold
+    # arrival can only land by preempting. Best-effort streams are long
+    # (160 new tokens) so they are still mid-decode for every gold shot.
+    tenants = "gold:prio=high;free:prio=besteffort"
+    be_new = 160
+    ecfg = EngineConfig(max_batch=2, max_seq=256, block_size=8,
+                        buckets=(32, 256), sched_tenants=tenants)
+    rcfg = RouterConfig(sched_tenants=tenants)
+    router = build_fleet(model, variables, 1, engine_config=ecfg,
+                         router_config=rcfg)
+    eng = router.replicas[0].engine
+    be_prompts = [rng.integers(1, cfg.vocab_size, size=24).tolist()
+                  for _ in range(2)]
+    gold_prompts = [rng.integers(1, cfg.vocab_size, size=24).tolist()
+                    for _ in range(6)]
+    try:
+        # warm: compile both prefill buckets + decode before the drill,
+        # so gold client latencies measure scheduling, not XLA. Warmed
+        # under the best-effort tenant: compile-time TTFTs are SLO-bad
+        # events and must not land in gold's burn-rate ledger
+        router.generate(gold_prompts[0], max_new_tokens=2, tenant="free")
+        router.generate(list(range(1, 200)), max_new_tokens=2,
+                        tenant="free")
+        # ground truth BEFORE contention: the uninterrupted greedy
+        # output each best-effort stream must reproduce after being
+        # preempted and journal-resumed mid-flight
+        truth = [router.generate(list(p), max_new_tokens=be_new,
+                                 tenant="free")["tokens"]
+                 for p in be_prompts]
+        results: dict[int, dict] = {}
+
+        def _flood(i: int) -> None:
+            results[i] = router.generate(list(be_prompts[i]),
+                                         max_new_tokens=be_new,
+                                         tenant="free")
+
+        threads = [threading.Thread(target=_flood, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if eng.stats().get("active_slots", 0) >= 2:
+                break
+            time.sleep(0.002)
+        ttft_ms = []
+        for p in gold_prompts:
+            t0 = time.perf_counter()
+            router.generate(list(p), max_new_tokens=1, tenant="gold")
+            ttft_ms.append((time.perf_counter() - t0) * 1e3)
+        for t in threads:
+            t.join(timeout=CHILD_TIMEOUT_S)
+        preempted = int(eng.stats().get("preempted", 0))
+        assert preempted > 0, (
+            "gold flood over a saturated engine produced zero "
+            "preemptions — the drill never exercised eviction")
+        exact = sum(1 for i in range(2)
+                    if results.get(i, {}).get("tokens") == truth[i])
+        exact_fraction = exact / 2.0
+        assert exact_fraction == 1.0, (
+            f"preempted best-effort streams did not resume token-exact: "
+            f"{exact}/2 matched the uninterrupted run")
+        # the router must have resumed paused work via the journal (the
+        # counter is reason-labeled; "preempted" is the only reason here)
+        resumed = int(router._sched_resumed.labels(
+            reason="preempted").value)
+        assert resumed > 0, "no journal resume was recorded for a preempt"
+        spec = eng.slo.spec
+        p95_ms = float(np.percentile(ttft_ms, 95))
+        assert p95_ms <= spec.ttft_p95_s * 1e3, (
+            f"gold p95 TTFT {p95_ms:.1f}ms blew the "
+            f"{spec.ttft_p95_s * 1e3:.0f}ms SLO under the flood — "
+            "preemption is not protecting the high-priority tenant")
+        # per-tenant fast-burn input for gold must be quiet: the flood
+        # may burn the best-effort tenant's budget, never gold's
+        gold_burn = max(eng.slo.burn_rate(w, tenant="gold")
+                        for w in spec.fast_windows)
+        assert gold_burn < FAST_BURN, (
+            f"gold fast-burn input {gold_burn:.1f} >= {FAST_BURN} — "
+            "the high-priority tenant is burning error budget")
+    finally:
+        for rep in router.replicas:
+            rep.close()
+
+    # ---- part 2: paged multi-LoRA batch ------------------------------
+    lcfg = EngineConfig(max_batch=4, max_seq=64, block_size=8,
+                        buckets=(32,), max_loras=4, lora_rank=8)
+    e = ServingEngine(model, variables, lcfg)
+    adapters: dict[str, tuple] = {}
+    for name, rank in (("fin", 4), ("legal", 2)):
+        a = (rng.normal(size=(cfg.d_model, rank)) * 0.1).astype(np.float32)
+        b = (rng.normal(size=(rank, cfg.vocab_size)) * 0.1).astype(
+            np.float32)
+        e.register_adapter(name, a, b)
+        adapters[name] = (a, b)
+    assert int(e.stats().get("lora_adapters", 0)) >= 2, \
+        "multi-LoRA drill needs at least two resident adapters"
+    lora_new = 16
+    lprompt = rng.integers(1, cfg.vocab_size, size=12).tolist()
+    mix = ["", "fin", "legal", "", "fin", "legal"]
+    reqs = [Request(rid=f"r{i}", prompt=list(lprompt),
+                    max_new_tokens=lora_new, adapter=nm)
+            for i, nm in enumerate(mix)]
+    # warm pass compiles prefill + the single lora-threaded decode
+    e.run([Request(rid=f"w{i}", prompt=list(lprompt), max_new_tokens=2,
+                   adapter=nm) for i, nm in enumerate(("", "fin"))])
+    t0 = time.perf_counter()
+    outs = e.run(reqs)
+    lora_dt = time.perf_counter() - t0
+    agg = sum(len(c.tokens) for c in outs) / lora_dt
+    # the adapter mix must NOT have multiplied executables: the stacks
+    # are traced operands of the one decode program
+    report = e.compile_report()
+    assert report["total_executables"] <= len(lcfg.buckets) + 2, report
+    by = {r.rid: nm for r, nm in zip(reqs, mix)}
+    got = {c.rid: c.tokens for c in outs}
+    for name, (a, b) in adapters.items():
+        # dedicated reference: the LoRA delta merged into lm_head, so
+        # the paged gather-apply path must reproduce it token for token
+        merged = {"params": {
+            **variables["params"],
+            "lm_head": {"kernel":
+                        variables["params"]["lm_head"]["kernel"] + a @ b}}}
+        ded = ServingEngine(model, merged, EngineConfig(
+            max_batch=4, max_seq=64, block_size=8, buckets=(32,)))
+        want = ded.run([Request(rid="x", prompt=list(lprompt),
+                                max_new_tokens=lora_new)])[0].tokens
+        for rid, nm in by.items():
+            if nm == name:
+                assert got[rid] == want, (
+                    f"{rid} (adapter {name}): batched tokens diverged "
+                    f"from the dedicated merged-weight engine")
+    base = ServingEngine(model, variables, EngineConfig(
+        max_batch=4, max_seq=64, block_size=8, buckets=(32,)))
+    want = base.run([Request(rid="x", prompt=list(lprompt),
+                             max_new_tokens=lora_new)])[0].tokens
+    for rid, nm in by.items():
+        if not nm:
+            assert got[rid] == want, (
+                f"{rid}: base-model rows in the LoRA batch diverged "
+                "from a no-adapter engine")
+
+    print(json.dumps({
+        "preempted": preempted,
+        "preempt_exact_fraction": exact_fraction,
+        "resumed_reasons": {"preempted": resumed},
+        "gold_p95_ttft_ms": round(p95_ms, 3),
+        "gold_ttft_slo_ms": round(spec.ttft_p95_s * 1e3, 1),
+        "gold_burn_fast_short": round(gold_burn, 2),
+        "fast_burn_limit": FAST_BURN,
+        "lora_adapters": int(e.stats().get("lora_adapters", 0)),
+        "multilora_requests": len(reqs),
+        "multilora_aggregate_tokens_s": round(agg, 1),
+        "multilora_executables": report["total_executables"],
+        "slo_window_scale": float(
+            os.environ.get("M2KT_SLO_WINDOW_SCALE", "1") or "1"),
     }), flush=True)
     return 0
 
@@ -2775,7 +3035,7 @@ def run_child(phases: list[str]) -> int:
            "fleet": bench_fleet, "quant": bench_quant,
            "kernels": bench_kernels, "obs": bench_obs,
            "chaos": bench_chaos, "swap": bench_swap,
-           "numerics": bench_numerics}
+           "numerics": bench_numerics, "sched": bench_sched}
     ok = True
     for phase in phases:
         try:
@@ -3111,6 +3371,10 @@ def main() -> int:
                         help="internal: P2P cold-join TTFT vs "
                              "store+compile, plus live-weight-swap chaos "
                              "drill (spawned by the swap phase)")
+    parser.add_argument("--sched-probe", action="store_true",
+                        help="internal: priority-preemption drill + "
+                             "multi-LoRA batch gates (spawned by the "
+                             "sched phase)")
     parser.add_argument("--swap-boot-probe", action="store_true",
                         help="internal: one cold replica boot to first "
                              "token (spawned by the swap probe; "
@@ -3136,6 +3400,8 @@ def main() -> int:
         return run_obs_probe()
     if args.numerics_probe:
         return run_numerics_probe()
+    if args.sched_probe:
+        return run_sched_probe()
     if args.child:
         return run_child(args.child.split(","))
     if args.opportunistic:

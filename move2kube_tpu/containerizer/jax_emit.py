@@ -43,7 +43,7 @@ _ASSETS = os.path.join(_PKG_ROOT, "assets", "jax")
 # "serving/fleet" rides along explicitly — the vendoring walk below is a
 # flat listdir per entry, not recursive.
 VENDORED_SUBPACKAGES = ("models", "parallel", "ops", "native", "resilience",
-                        "serving", "serving/fleet", "obs")
+                        "serving", "serving/fleet", "serving/sched", "obs")
 
 REQUIREMENTS = """jax[tpu]>=0.4.35
 flax
@@ -335,6 +335,53 @@ def _ask_slo_knobs(name: str) -> dict:
     return knobs
 
 
+def _ask_sched_knobs(name: str) -> dict:
+    """Scheduler-plane knobs (serving/sched) as QA problems: tenant
+    priority classes, token-bucket quotas, the chunked-prefill chunk
+    size, and the resident multi-LoRA adapter cap. IDs are shared with
+    ``passes/optimize.py``'s tpu_sched_optimizer — asked once here,
+    cached answers reused for the pod env injection, so the serve
+    template's baked-in defaults and the workload env agree. The spec
+    strings are passed through verbatim: serving/sched's parser is the
+    tolerant one (malformed entries warn and are skipped at runtime)."""
+    from move2kube_tpu import qa
+
+    knobs = {}
+    for key, qid, desc, extra, default in (
+        ("priorities", "serve.sched.priorities",
+         "Enter the tenant priority classes for [{name}]",
+         "tenant:class pairs ('gold:high;free:besteffort'); higher "
+         "classes may preempt lower under slot/page pressure — empty "
+         "keeps the flat, never-preempt default", ""),
+        ("quotas", "serve.sched.quotas",
+         "Enter the tenant admission quotas for [{name}]",
+         "tenant:rate/burst token buckets ('gold:50/100'); over-quota "
+         "requests are refused 429 at the router front — empty means "
+         "unlimited", ""),
+        ("chunkprefill", "serve.sched.chunkprefill",
+         "Enter the chunked-prefill chunk size in tokens for [{name}]",
+         "prompts longer than this prefill in chunks interleaved with "
+         "decode steps, bounding decode stalls; 0 disables chunking", "0"),
+        ("maxloras", "serve.sched.maxloras",
+         "Enter the max resident LoRA adapters for [{name}]",
+         "paged adapter slots served from one engine (S-LoRA style); "
+         "0 disables multi-LoRA serving", "0"),
+    ):
+        raw = qa.fetch_input(
+            f"m2kt.services.{name}.{qid}", desc.format(name=name),
+            [extra], default)
+        if key in ("priorities", "quotas"):
+            knobs[key] = str(raw) if raw is not None else ""
+            continue
+        try:
+            knobs[key] = max(0, int(raw))
+        except (TypeError, ValueError):
+            log.warning("invalid %s answer %r for %s; using %s",
+                        qid, raw, name, default)
+            knobs[key] = int(default)
+    return knobs
+
+
 def _ask_numerics_knobs(name: str, serving: bool) -> dict:
     """Numerics-plane knobs, via the SAME cached QA ids
     ``passes/optimize.py``'s tpu_numerics_optimizer asks
@@ -486,6 +533,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
         acc.serving_port = serve_port
         serve_knobs = _ask_serving_knobs(name)
         slo_knobs = _ask_slo_knobs(name)
+        sched_knobs = _ask_sched_knobs(name)
         with open(os.path.join(_ASSETS, "serve_tpu.py"),
                   encoding="utf-8") as f:
             container.add_file(
@@ -506,6 +554,10 @@ def emit_container(service: PlanService, plan=None) -> Container:
                     "slo_ttft_p95": slo_knobs["ttft_p95"],
                     "slo_availability": slo_knobs["availability"],
                     "slo_max_tenants": slo_knobs["max_tenants"],
+                    "sched_priorities": sched_knobs["priorities"],
+                    "sched_quotas": sched_knobs["quotas"],
+                    "sched_chunk_prefill": sched_knobs["chunkprefill"],
+                    "sched_max_loras": sched_knobs["maxloras"],
                     "numerics": numerics_knobs["numerics"],
                     "quant_audit_rate": numerics_knobs["quant_audit_rate"],
                     "compile_cache_dir": "/app/.jax-cache",

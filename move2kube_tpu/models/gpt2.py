@@ -143,11 +143,13 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, cache=None,
-                 return_kv=False, return_hidden=False):
+                 return_kv=False, return_hidden=False, lora=None):
         """Same three modes as models/llama.py ``Llama.__call__``:
         full forward (default), prefill (``return_kv=True`` also returns
         per-layer K/V), and paged single-token decode (``cache=`` with
-        ``input_ids``/``positions`` shaped ``[b]``)."""
+        ``input_ids``/``positions`` shaped ``[b]``). ``lora`` is the
+        scheduler's paged multi-LoRA hook on the (tied) LM head — see
+        ``_lora_delta`` in models/llama.py."""
         cfg = self.cfg
         wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                        name="wte")
@@ -175,8 +177,11 @@ class GPT2(nn.Module):
                 new_vs.append(vsp)
             x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
                              name="ln_f")(x)
-            logits = (x.astype(jnp.float32)
-                      @ wte.embedding.astype(jnp.float32).T)
+            x32 = x.astype(jnp.float32)
+            logits = x32 @ wte.embedding.astype(jnp.float32).T
+            if lora is not None:
+                from move2kube_tpu.models.llama import _lora_delta
+                logits = logits + _lora_delta(x32, lora)
             out_cache = dict(cache)
             out_cache["k"] = type(cache["k"])(new_k)
             out_cache["v"] = type(cache["v"])(new_v)
@@ -204,7 +209,11 @@ class GPT2(nn.Module):
             # folded into the loss chunk loop by the caller
             return x
         # LM head tied to the token embedding (HF GPT2LMHeadModel ties)
-        logits = x.astype(jnp.float32) @ wte.embedding.astype(jnp.float32).T
+        x32 = x.astype(jnp.float32)
+        logits = x32 @ wte.embedding.astype(jnp.float32).T
+        if lora is not None:
+            from move2kube_tpu.models.llama import _lora_delta
+            logits = logits + _lora_delta(x32, lora)
         if return_kv:
             return logits, kvs
         return logits

@@ -84,6 +84,20 @@ def _rope(x, positions, theta: float):
 
 
 
+def _lora_delta(x32, lora):
+    """Per-row LoRA logit delta for the lm_head: gather each batch row's
+    A/B matrices from the stacked adapter store and apply ``x·A·B``.
+    ``x32`` is the fp32 pre-head hidden ``[b, s, d]``; returns
+    ``[b, s, vocab]``. Everything is a traced operand — batched gather
+    plus two einsums — so one fixed-shape executable serves any mix of
+    adapters (row 0 is the all-zeros base-model adapter)."""
+    a_stack, b_stack, rows = lora
+    av = jnp.take(a_stack, rows, axis=0)   # [b, d, r]
+    bv = jnp.take(b_stack, rows, axis=0)   # [b, r, vocab]
+    u = jnp.einsum("bsd,bdr->bsr", x32, av)
+    return jnp.einsum("bsr,brv->bsv", u, bv)
+
+
 class RMSNorm(nn.Module):
     epsilon: float = 1e-5
 
@@ -234,7 +248,7 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, cache=None,
-                 return_kv=False, return_hidden=False):
+                 return_kv=False, return_hidden=False, lora=None):
         """Three modes, one parameter tree:
 
         - training / full forward (default): ``(input_ids[b, s]) -> logits``
@@ -246,6 +260,13 @@ class Llama(nn.Module):
           slot at ``positions`` ``[b]``; ``cache`` is the paged-KV pytree
           (serving/kvcache.py) whose ``k``/``v`` are per-layer page lists.
           Returns ``(logits[b, vocab], updated_cache)``.
+
+        ``lora`` is the serving scheduler's paged multi-LoRA hook
+        (serving/sched/lora.py): ``(a_stack [rows, d, r], b_stack
+        [rows, r, vocab], rows [b])`` adds each slot's gathered
+        ``x·A·B`` delta to the lm_head logits. The stacks ride in as
+        traced arguments, so registering or swapping adapters never
+        recompiles; row 0 is all-zeros (the base model).
         """
         cfg = self.cfg
         if cache is not None:
@@ -271,9 +292,12 @@ class Llama(nn.Module):
                 new_ks.append(ksp)
                 new_vs.append(vsp)
             x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+            x32 = x.astype(jnp.float32)
             logits = nn.Dense(cfg.vocab_size, use_bias=False,
                               dtype=jnp.float32,
-                              name="lm_head")(x.astype(jnp.float32))
+                              name="lm_head")(x32)
+            if lora is not None:
+                logits = logits + _lora_delta(x32, lora)
             out_cache = dict(cache)
             out_cache["k"] = type(cache["k"])(new_k)
             out_cache["v"] = type(cache["v"])(new_v)
@@ -304,8 +328,11 @@ class Llama(nn.Module):
             # (ops/crossentropy.py): the caller folds the lm_head matmul
             # into the loss so the [b, s, vocab] logits never materialize
             return x
+        x32 = x.astype(jnp.float32)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
-                          name="lm_head")(x.astype(jnp.float32))
+                          name="lm_head")(x32)
+        if lora is not None:
+            logits = logits + _lora_delta(x32, lora)
         if return_kv:
             return logits, kvs
         return logits

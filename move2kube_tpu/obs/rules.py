@@ -40,6 +40,10 @@ THRESHOLDS = {
     # quant-drift auditor may report before alarming — matches the
     # int8 tier of the build-time logit gates
     "tpunumdriftmax": "0.05",
+    # scheduler plane (serving/sched): a tenant whose TTFT p95 sits at
+    # this multiple of the objective WHILE preemption is active is being
+    # starved by higher classes, not by its own quota
+    "tpuschedstarvefactor": "4",
 }
 
 
@@ -249,6 +253,33 @@ def prometheus_rule(name: str, selector_label: str,
             },
         })
         rules.append({
+            "alert": "M2KTPriorityStarvation",
+            # fires only while the scheduler is actively preempting: a
+            # tenant far over its TTFT objective during preemption churn
+            # is losing its slots to higher classes — quota throttling
+            # shows up as 429s (m2kt_sched_throttled_total), never here
+            "expr": (
+                f"m2kt_slo_tenant_ttft_p95_seconds{sel} "
+                f"> {th['tpuschedstarvefactor']} * {th['tpuslottftp95']} "
+                f"and on() (sum(increase("
+                f"m2kt_sched_preempted_total{sel}[10m])) > 0)"),
+            "for": "10m",
+            "labels": {"severity": "warning", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: a low-priority tenant is starving "
+                           "under preemption",
+                "description": (
+                    "A tenant's TTFT p95 has sat at a multiple of the "
+                    "objective while the scheduler kept preempting — "
+                    "best-effort work is being evicted faster than it "
+                    "can finish. Raise the tenant's priority class, add "
+                    "capacity, or quota the high-priority flood "
+                    "(m2kt_sched_preempted_total / _resumed_total show "
+                    "the churn; the tenant label on this alert shows "
+                    "who is starving)."),
+            },
+        })
+        rules.append({
             "alert": "M2KTSLOTenantTTFTHigh",
             "expr": (f"m2kt_slo_tenant_ttft_p95_seconds{sel} "
                      f"> {th['tpuslottftp95']}"),
@@ -366,6 +397,23 @@ def grafana_dashboard(name: str, selector_label: str,
         panels.append(_panel(
             20, "Quant drift (max-rel logit error, audited prefills)",
             f"m2kt_serve_quant_drift{sel}", 12, 56))
+        # scheduler row (serving/sched): preemption/resume churn, who is
+        # being throttled at admission, and how much prefill is riding
+        # the chunked executable — the starvation alert reads the same
+        # series, so the panel is the alert's debugging view
+        panels.append(_panel(
+            21, "Scheduler preemptions / resumes by reason",
+            f"sum(rate(m2kt_sched_preempted_total{sel}[5m])) by (reason) "
+            f"or sum(rate(m2kt_sched_resumed_total{sel}[5m])) by (reason)",
+            0, 80))
+        panels.append(_panel(
+            22, "Admission throttles (429s) by reason",
+            f"sum(rate(m2kt_sched_throttled_total{sel}[5m])) by (reason)",
+            12, 80))
+        panels.append(_panel(
+            23, "Chunked prefill rate by reason",
+            f"sum(rate(m2kt_sched_chunked_total{sel}[5m])) by (reason)",
+            0, 88))
     return {
         "title": f"move2kube-tpu: {name}",
         "uid": f"m2kt-{name}",

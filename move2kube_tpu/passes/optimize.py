@@ -421,6 +421,67 @@ def tpu_slo_optimizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_sched_optimizer(ir: IR) -> IR:
+    """Bake the scheduler-plane knobs into accelerated *serving*
+    services' pod env (``M2KT_SCHED_PRIORITIES`` / ``M2KT_SCHED_QUOTAS``
+    / ``M2KT_SCHED_CHUNK_PREFILL`` / ``M2KT_SCHED_MAX_LORAS``).
+
+    Asks the SAME QA problems as the jax-xla emitter
+    (``m2kt.services.<name>.serve.sched.*``) — answered once and cached,
+    so the serve template's baked-in defaults and the workload env
+    agree; the tpu_sched_parameterizer then lifts these env values into
+    Helm values (tpuschedpriorities etc.) so operators retune tenants
+    without a rebuild. The spec strings are carried verbatim — the
+    serving/sched parser is the tolerant layer (malformed entries warn
+    and are skipped at runtime, never crash a pod)."""
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None or not getattr(acc, "serving", False):
+            continue
+        name = common.make_dns_label(svc.name)
+        entries = []
+        for qid, desc, extra, default, env_name, is_int in (
+            ("serve.sched.priorities",
+             f"Enter the tenant priority classes for [{name}]",
+             "tenant:class pairs ('gold:high;free:besteffort'); higher "
+             "classes may preempt lower under slot/page pressure — empty "
+             "keeps the flat, never-preempt default", "",
+             "M2KT_SCHED_PRIORITIES", False),
+            ("serve.sched.quotas",
+             f"Enter the tenant admission quotas for [{name}]",
+             "tenant:rate/burst token buckets ('gold:50/100'); over-quota "
+             "requests are refused 429 at the router front — empty means "
+             "unlimited", "", "M2KT_SCHED_QUOTAS", False),
+            ("serve.sched.chunkprefill",
+             f"Enter the chunked-prefill chunk size in tokens for [{name}]",
+             "prompts longer than this prefill in chunks interleaved with "
+             "decode steps, bounding decode stalls; 0 disables chunking",
+             "0", "M2KT_SCHED_CHUNK_PREFILL", True),
+            ("serve.sched.maxloras",
+             f"Enter the max resident LoRA adapters for [{name}]",
+             "paged adapter slots served from one engine (S-LoRA style); "
+             "0 disables multi-LoRA serving", "0",
+             "M2KT_SCHED_MAX_LORAS", True),
+        ):
+            raw = qa.fetch_input(f"m2kt.services.{name}.{qid}", desc,
+                                 [extra], default)
+            if is_int:
+                try:
+                    value = str(max(0, int(raw)))
+                except (TypeError, ValueError):
+                    value = default
+            else:
+                value = str(raw) if raw is not None else default
+            entries.append((env_name, value))
+        for container in svc.containers:
+            env = container.setdefault("env", [])
+            existing = {e.get("name") for e in env}
+            for env_name, value in entries:
+                if env_name not in existing:
+                    env.append({"name": env_name, "value": value})
+    return ir
+
+
 def tpu_planreport_optimizer(ir: IR) -> IR:
     """Bake ``M2KT_PLAN_REPORT=1`` into accelerated *training* services
     behind the ``m2kt.services.<name>.obs.planreport`` QA knob
@@ -492,6 +553,7 @@ OPTIMIZERS = [
     tpu_elastic_optimizer,
     tpu_observability_optimizer,
     tpu_slo_optimizer,
+    tpu_sched_optimizer,
     tpu_planreport_optimizer,
     tpu_numerics_optimizer,
 ]

@@ -225,6 +225,36 @@ def tpu_slo_parameterizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_sched_parameterizer(ir: IR) -> IR:
+    """Lift the scheduler-plane env the sched optimizer injected into
+    chart values, so a Helm install retunes tenants per environment
+    (``--set tpuschedpriorities='gold:high;free:besteffort'``) without a
+    rebuild. Empty spec values lift too: the knob then exists in
+    values.yaml for operators to fill in, and the runtime treats empty
+    as the flat, never-preempt default."""
+    lifted = {
+        "M2KT_SCHED_PRIORITIES": "tpuschedpriorities",
+        "M2KT_SCHED_QUOTAS": "tpuschedquotas",
+        "M2KT_SCHED_CHUNK_PREFILL": "tpuschedchunkprefill",
+        "M2KT_SCHED_MAX_LORAS": "tpuschedmaxloras",
+    }
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None or not getattr(acc, "serving", False):
+            continue
+        for container in svc.containers:
+            for env in container.get("env", []) or []:
+                key = lifted.get(env.get("name"))
+                if key is None:
+                    continue
+                value = env.get("value")
+                if value is None or "{{" in str(value):
+                    continue
+                ir.values.global_variables.setdefault(key, str(value))
+                env["value"] = "{{ .Values.%s }}" % key
+    return ir
+
+
 def tpu_numerics_parameterizer(ir: IR) -> IR:
     """Lift the numerics-plane env the numerics optimizer injected into
     chart values: ``M2KT_NUMERICS`` -> ``tpunumerics`` (any accelerated
@@ -288,6 +318,7 @@ PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
                   tpu_serving_parameterizer, tpu_fleet_parameterizer,
                   tpu_elastic_parameterizer,
                   tpu_obs_parameterizer, tpu_slo_parameterizer,
+                  tpu_sched_parameterizer,
                   tpu_numerics_parameterizer, tpu_rules_parameterizer]
 
 

@@ -46,6 +46,29 @@ Env knobs (docs/USAGE.md):
   disables (default 0)
 - ``M2KT_SERVE_KERNELS``    fused-kernel dispatch auto|on|off
   (ops/attention.py serve_kernels_mode; default auto)
+- ``M2KT_SCHED_TENANTS``    scheduler tenant spec — priorities drive
+  preemption ordering here, quotas are enforced at the router
+  (serving/sched/admission.py; default empty = never preempt)
+- ``M2KT_SCHED_CHUNK_PREFILL`` chunk size for interleaved chunked
+  prefill of long prompts; 0 disables (default 0)
+- ``M2KT_SCHED_MAX_LORAS``  resident paged LoRA adapter rows
+  (serving/sched/lora.py); 0 disables (default 0)
+- ``M2KT_LORA_RANK``        max adapter rank the stacks hold (default 8)
+
+Scheduler plane (``serving/sched/``, PR 17): when the tenant spec ranks
+tenants into distinct priority classes, an admission that finds no free
+slot (or no free pages) may *preempt* the lowest-priority,
+most-recently-admitted slot — its pages free immediately and its
+Completion carries ``finish_reason="preempted"``; the router treats
+that as paused work and resumes it token-exactly by force-feeding the
+journal, so preemption loses zero tokens. Chunked prefill
+(``chunk_prefill > 0``) admits a long prompt into a slot up front and
+feeds it through ONE extra fixed-shape decode-mode executable, one
+chunk per engine step, interleaved with the running decode batch.
+Multi-LoRA (``max_loras > 0``) serves per-request adapters from stacked
+paged A/B weights gathered by slot inside the SAME prefill/decode
+executables (the stacks are traced operands — registering an adapter
+never recompiles).
 
 Low-precision serving (``quant``): weights are quantized ONCE at engine
 construction (per-output-channel int8, serving/quant.py) and dequantized
@@ -84,6 +107,7 @@ from move2kube_tpu.obs import tracing
 from move2kube_tpu.obs.metrics import Registry
 from move2kube_tpu.serving import kvcache
 from move2kube_tpu.serving import quant as quantlib
+from move2kube_tpu.serving import sched as schedlib
 from move2kube_tpu.serving.fleet.prefixcache import PrefixCache, PrefixHit
 from move2kube_tpu.serving.kvcache import (
     NULL_PAGE,
@@ -156,6 +180,19 @@ class EngineConfig:
     # draft depth divisor: num_layers // factor layers (1 = full-depth
     # draft — acceptance 1.0, useful as a correctness anchor)
     spec_draft_factor: int = 2
+    # scheduler plane (serving/sched): the combined tenant spec plus the
+    # split QA-knob forms, merged at construction. Priorities order
+    # preemption in the engine; quotas only bite at the router.
+    sched_tenants: str = ""
+    sched_priorities: str = ""
+    sched_quotas: str = ""
+    # chunked prefill: prompts longer than this many tokens prefill as
+    # interleaved decode-mode chunks of this size (0 = off)
+    chunk_prefill: int = 0
+    # paged multi-LoRA serving: resident adapter rows (0 = off) and the
+    # max rank the stacked A/B weights hold
+    max_loras: int = 0
+    lora_rank: int = 8
 
     def resolved_buckets(self) -> tuple[int, ...]:
         buckets = self.buckets or _default_buckets(self.max_seq)
@@ -193,6 +230,19 @@ class EngineConfig:
                 os.environ.get("M2KT_SERVE_QUANT", "") or cls.quant),
             quant_audit_rate=numericslib.audit_rate(),
             spec_k=max(0, _int("M2KT_SPEC_K", cls.spec_k)),
+            # sched fields share _int's tolerance: a bad value in a Helm
+            # override warns inside the spec parser / defaults here, it
+            # never takes the engine down (quant.py convention)
+            sched_tenants=os.environ.get("M2KT_SCHED_TENANTS",
+                                         cls.sched_tenants),
+            sched_priorities=os.environ.get("M2KT_SCHED_PRIORITIES",
+                                            cls.sched_priorities),
+            sched_quotas=os.environ.get("M2KT_SCHED_QUOTAS",
+                                        cls.sched_quotas),
+            chunk_prefill=max(0, _int("M2KT_SCHED_CHUNK_PREFILL",
+                                      cls.chunk_prefill)),
+            max_loras=max(0, _int("M2KT_SCHED_MAX_LORAS", cls.max_loras)),
+            lora_rank=max(1, _int("M2KT_LORA_RANK", cls.lora_rank)),
         )
         cfg.update(overrides)
         return cls(**cfg)
@@ -228,6 +278,9 @@ class Request:
     # timeout-slow), and queued requests that expire before a slot
     # frees complete with finish_reason "shed"
     deadline_s: float | None = None
+    # named LoRA adapter to decode under ("" = base model); must be
+    # registered in the engine's adapter store or submit rejects
+    adapter: str = ""
 
 
 @dataclasses.dataclass
@@ -235,7 +288,10 @@ class Completion:
     rid: str
     prompt_len: int
     tokens: list[int]
-    finish_reason: str  # "eos" | "length" | "shed"
+    # "eos" | "length" | "shed" | "preempted" — preempted is paused
+    # work, not failure: the router resumes it token-exactly from its
+    # journal (the tokens so far already rode on_token)
+    finish_reason: str
     # the engine's weight generation at release time — a stream that
     # rode across a live swap finishes stamped with the NEW version
     weights_version: int = 0
@@ -252,6 +308,22 @@ class _Slot:
     # token per decode step; argmax output is discarded until empty
     pending: list[int] = dataclasses.field(default_factory=list)
     prefix_hit: bool = False
+    # scheduler plane: admission order + priority class (preemption
+    # picks the lowest class, most recent seq), the slot's row in the
+    # adapter store, and the chunked-prefill marker (a chunking slot is
+    # excluded from decode until its whole prompt has landed)
+    seq: int = 0
+    priority: int = 1
+    adapter_row: int = 0
+    chunking: bool = False
+
+
+@dataclasses.dataclass
+class _ChunkJob:
+    """The (single) in-flight chunked prefill: one chunk of the slot's
+    prompt runs per engine step, interleaved with the decode batch."""
+    slot_idx: int
+    done: int = 0  # prompt tokens already written into the slot's pages
 
 
 class ServingEngine:
@@ -306,9 +378,47 @@ class ServingEngine:
         self._allocator = PageAllocator(self.cache_cfg.num_pages)
         self._slots: list[_Slot | None] = [None] * self.config.max_batch
         self._pending: deque[Request] = deque()
+        # ---- scheduler plane (serving/sched) -------------------------
+        # tenant policies shared with the router: priorities order
+        # preemption here; quotas only bite at the router front
+        self.sched = schedlib.AdmissionController.from_specs(
+            self.config.sched_tenants, self.config.sched_priorities,
+            self.config.sched_quotas)
+        self._preempt_enabled = self.sched.distinct_priorities()
+        self._admit_seq = 0
+        self._preempt_count = 0
+        self._chunk_count = 0
+        # paged multi-LoRA (sched/lora.py): mutually exclusive with spec
+        # decode — the draft shares the target's head, and a proposer
+        # blind to the adapter would collapse acceptance anyway
+        self.max_loras = max(0, self.config.max_loras)
+        if self.max_loras and self.config.spec_k:
+            print("[m2kt] WARNING: M2KT_SCHED_MAX_LORAS is incompatible "
+                  "with spec decode (M2KT_SPEC_K); disabling multi-LoRA",
+                  flush=True)
+            self.max_loras = 0
+        self.adapters: schedlib.AdapterStore | None = None
+        if self.max_loras:
+            self.adapters = schedlib.AdapterStore(
+                d_model=model.cfg.d_model, vocab=model.cfg.vocab_size,
+                rank=max(1, self.config.lora_rank),
+                max_loras=self.max_loras)
+        self._req_adapter: dict[str, int] = {}
+        # chunked prefill: spec decode keeps its own window discipline
+        # and opts out
+        self.chunk_prefill = max(0, self.config.chunk_prefill)
+        if self.chunk_prefill and self.config.spec_k:
+            print("[m2kt] WARNING: M2KT_SCHED_CHUNK_PREFILL is "
+                  "incompatible with spec decode (M2KT_SPEC_K); "
+                  "disabling chunked prefill", flush=True)
+            self.chunk_prefill = 0
+        self._chunk_job: _ChunkJob | None = None
+        # --------------------------------------------------------------
         self._prefill = self._make_prefill()
         self._decode = self._make_decode()
         self._install, self._copy, self._install_kv = self._make_table_ops()
+        self._chunk = (self._make_chunk_prefill()
+                       if self.chunk_prefill else None)
         # speculative decoding: draft model (shrunk same-family config
         # sharing the target's embeddings/head) + its own paged cache with
         # IDENTICAL page geometry, so page indices map 1:1 and every
@@ -423,6 +533,14 @@ class ServingEngine:
         self._prefix_pages = reg.gauge(
             "m2kt_serve_prefix_cache_pages",
             "KV pages currently pinned by the prefix cache")
+        self._sched_preempted = reg.counter(
+            "m2kt_sched_preempted_total",
+            "Slots evicted by the scheduler as paused work (the router "
+            "journal resumes them token-exactly)", labels=("reason",))
+        self._sched_chunked = reg.counter(
+            "m2kt_sched_chunked_total",
+            "Long prompts prefilled as interleaved decode-mode chunks",
+            labels=("reason",))
         self._spec_proposed = reg.counter(
             "m2kt_serve_spec_proposed_total",
             "Draft tokens proposed to the verify step")
@@ -497,8 +615,13 @@ class ServingEngine:
         block_size, dq = self.cache_cfg.block_size, self._dq
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def prefill(variables, cache, ids, bt_row, slot, prompt_len):
-            logits, kvs = model.apply(dq(variables), ids, return_kv=True)
+        def prefill(variables, cache, ids, bt_row, slot, prompt_len,
+                    *lora):
+            # lora: () or the scheduler's (a_stack, b_stack, rows) —
+            # traced operands, so the same executable serves every
+            # adapter mix (and the no-lora engine never pays for it)
+            logits, kvs = model.apply(dq(variables), ids, return_kv=True,
+                                      lora=lora if lora else None)
             cache = scatter_prefill(cache, kvs, slot, bt_row, prompt_len,
                                     block_size)
             first = jnp.argmax(logits[0, prompt_len - 1]).astype(jnp.int32)
@@ -510,7 +633,7 @@ class ServingEngine:
         model, dq = model or self.model, self._dq
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def decode(variables, cache, tokens, active):
+        def decode(variables, cache, tokens, active, *lora):
             # sanitize freed/idle slots: their stale tables must not write
             # into pages the allocator may have handed to someone else —
             # redirect them to the reserved null page
@@ -520,7 +643,8 @@ class ServingEngine:
             model_cache["block_tables"] = bt
             model_cache["seq_lens"] = pos + 1
             logits, model_cache = model.apply(
-                dq(variables), tokens, positions=pos, cache=model_cache)
+                dq(variables), tokens, positions=pos, cache=model_cache,
+                lora=lora if lora else None)
             new_cache = {k: model_cache[k] for k in PAGE_KEYS if k in cache}
             new_cache["block_tables"] = cache["block_tables"]
             new_cache["seq_lens"] = (cache["seq_lens"]
@@ -563,6 +687,59 @@ class ServingEngine:
             return jnp.stack(all_logits, axis=1), new_cache
 
         return verify
+
+    def _make_chunk_prefill(self):
+        """The chunked-prefill executable: ONE fixed-shape jit that
+        feeds ``chunk_prefill`` prompt tokens of a single slot through
+        the decode-mode path (K/V written page-wise at each position),
+        carrying the page pools through a fori_loop. The engine runs one
+        chunk per step, after the decode batch, so a max-length prompt
+        shares the device with the running streams instead of stalling
+        them. Returns the logits after the chunk's LAST token — on the
+        final chunk that is exactly the reading a whole bucketed prefill
+        would have produced for the prompt's last position — plus the
+        updated cache (``seq_lens`` advances to ``start + count``
+        in-graph)."""
+        model, dq, C = self.model, self._dq, self.chunk_prefill
+        vocab = model.cfg.vocab_size
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def chunk(variables, cache, tokens, slot, start, count, *lora):
+            params = dq(variables)
+            n = cache["seq_lens"].shape[0]
+            onehot = jnp.arange(n) == slot
+            # only the chunking slot's table is live; every other row is
+            # redirected to the null page so the loop's writes cannot
+            # touch a running stream's pages
+            bt = jnp.where(onehot[:, None], cache["block_tables"],
+                           NULL_PAGE)
+            pages = {k: cache[k] for k in PAGE_KEYS if k in cache}
+
+            def body(j, carry):
+                pages, last = carry
+                act = onehot & (j < count)
+                pos = jnp.where(act, start + j, 0)
+                toks = jnp.where(act, tokens[j], 0).astype(jnp.int32)
+                mc = dict(pages)
+                mc["block_tables"] = jnp.where(act[:, None], bt, NULL_PAGE)
+                mc["seq_lens"] = pos + 1
+                logits, mc = model.apply(params, toks, positions=pos,
+                                         cache=mc,
+                                         lora=lora if lora else None)
+                pages = {k: mc[k] for k in pages}
+                last = jnp.where(j + 1 == count,
+                                 logits[slot].astype(jnp.float32), last)
+                return pages, last
+
+            pages, last = jax.lax.fori_loop(
+                0, C, body, (pages, jnp.zeros((vocab,), jnp.float32)))
+            new_cache = dict(cache)
+            new_cache.update(pages)
+            new_cache["seq_lens"] = jnp.where(
+                onehot, start + count, cache["seq_lens"]).astype(jnp.int32)
+            return last, new_cache
+
+        return chunk
 
     def _make_table_ops(self):
         """Three small donated steps for admissions that skip prefill:
@@ -615,6 +792,16 @@ class ServingEngine:
                 raise DeadlineExceeded(
                     f"{req.rid}: deadline {req.deadline_s:.3f}s {reason} "
                     f"for {max_new} new tokens")
+            if req.adapter:
+                if self.adapters is None:
+                    raise ValueError(
+                        f"{req.rid}: adapter {req.adapter!r} requested "
+                        "but the engine serves no adapters "
+                        "(M2KT_SCHED_MAX_LORAS=0)")
+                # refcounted row acquire (unknown adapter raises): the
+                # store cannot drop the weights while this stream runs
+                self._req_adapter[req.rid] = self.adapters.acquire(
+                    req.adapter)
         except ValueError:
             self._rejected.inc()
             self._tenant_rejected.labels(tenant).inc()
@@ -673,6 +860,8 @@ class ServingEngine:
         self.slo.record(tenant, ok=False)
         self._submit_ts.pop(req.rid, None)
         self._deadline_abs.pop(req.rid, None)
+        if self.adapters is not None:
+            self.adapters.release(self._req_adapter.pop(req.rid, 0))
         self._completed.labels(reason="shed").inc()
         if self.tracer is not None:
             root = self._req_spans.pop(req.rid, None)
@@ -701,14 +890,22 @@ class ServingEngine:
         finished = self._admit_pending()
         if self.spec_k:
             return self._spec_step(finished)
-        active_mask = np.array([s is not None for s in self._slots])
+        # a chunking slot owns pages and a block table but has no prompt
+        # resident yet: it sits out the decode batch until _chunk_step
+        # lands its final chunk
+        active_mask = np.array([s is not None and not s.chunking
+                                for s in self._slots])
         if not active_mask.any():
+            self._chunk_step(finished)
+            self._update_occupancy()
             return finished
         tokens = np.array(
-            [s.last_token if s else 0 for s in self._slots], np.int32)
+            [s.last_token if s is not None and not s.chunking else 0
+             for s in self._slots], np.int32)
         t0 = time.perf_counter()
         logits, next_tokens, cache = self._decode(
-            self.variables, self._cache, tokens, active_mask)
+            self.variables, self._cache, tokens, active_mask,
+            *self._lora_args())
         next_tokens = np.asarray(next_tokens)  # blocks until ready
         dt = time.perf_counter() - t0
         self._cache = cache
@@ -723,7 +920,7 @@ class ServingEngine:
         self._tokens_total.inc(produced)
         logits_np = np.asarray(logits) if self.capture_logits else None
         for i, slot in enumerate(self._slots):
-            if slot is None:
+            if slot is None or slot.chunking:
                 continue
             if slot.pending:
                 # the cache covered positions < seq_len; the next prompt
@@ -762,8 +959,75 @@ class ServingEngine:
             done = self._finish_reason(slot, tok)
             if done:
                 finished.append(self._release(i, done))
+        self._chunk_step(finished)
         self._update_occupancy()
         return finished
+
+    def _lora_args(self, rows=None) -> tuple:
+        """Extra traced operands for the jitted steps when multi-LoRA is
+        on: the stacked A/B adapter weights plus each slot's row in
+        them. Empty when the engine serves no adapters — the executables
+        then compile without the gather entirely."""
+        if not self.max_loras:
+            return ()
+        a, b = self.adapters.stacks()
+        if rows is None:
+            rows = [s.adapter_row if s is not None else 0
+                    for s in self._slots]
+        return (a, b, np.asarray(rows, np.int32))
+
+    def _chunk_step(self, finished: list[Completion]) -> None:
+        """Run at most one chunk of the in-flight chunked prefill —
+        called once per engine step, after the decode batch, so the long
+        prompt and the running streams interleave on the device."""
+        job = self._chunk_job
+        if job is None:
+            return
+        slot_idx = job.slot_idx
+        slot = self._slots[slot_idx]
+        prompt = slot.req.prompt
+        start = job.done
+        count = min(self.chunk_prefill, len(prompt) - start)
+        toks = np.zeros((self.chunk_prefill,), np.int32)
+        toks[:count] = prompt[start:start + count]
+        t0 = time.perf_counter()
+        last, cache = self._chunk(
+            self.variables, self._cache, toks, np.int32(slot_idx),
+            np.int32(start), np.int32(count), *self._lora_args())
+        self._cache = cache
+        job.done += count
+        root = self._req_spans.get(slot.req.rid)
+        if self.tracer is not None and root is not None:
+            self.tracer.record(
+                "serve.chunk_prefill", t0, time.perf_counter(),
+                attrs={"start": start, "count": count},
+                trace_id=root.trace_id, parent_id=root.span_id)
+        if job.done < len(prompt):
+            return
+        # final chunk: its last reading is the logits a whole bucketed
+        # prefill would have produced for the prompt's last position —
+        # the first generated token argmaxes from them, TTFT closes here
+        self._chunk_job = None
+        slot.chunking = False
+        self._prefill_count += 1
+        last_np = np.asarray(last)
+        tok = int(np.argmax(last_np))
+        if self.capture_logits:
+            self.logit_log.setdefault(slot.req.rid, []).append(
+                last_np.copy())
+        slot.tokens.append(tok)
+        slot.last_token = tok
+        self._emit_token(slot.req.rid, tok)
+        submit_ts = self._submit_ts.pop(slot.req.rid, None)
+        if submit_ts is not None:
+            now = time.perf_counter()
+            self._ttft_hist.observe(now - submit_ts)
+            self._close_ttft(slot.req.rid, now - submit_ts)
+            if root is not None:
+                root.attrs["ttft_s"] = now - submit_ts
+        done = self._finish_reason(slot, tok)
+        if done:
+            finished.append(self._release(slot_idx, done))
 
     def _spec_step(self, finished: list[Completion]) -> list[Completion]:
         """One speculative engine iteration. Window layout per slot:
@@ -898,6 +1162,16 @@ class ServingEngine:
                 stall = 0
         return completions
 
+    def register_adapter(self, name: str, a, b) -> int:
+        """Install a LoRA adapter (``a [d_model, r]``, ``b [r, vocab]``,
+        ``r <= lora_rank``) into the paged store; returns its row. The
+        stacks are traced operands of every executable, so this never
+        recompiles — the next step simply gathers the new row."""
+        if self.adapters is None:
+            raise ValueError("engine serves no adapters "
+                             "(M2KT_SCHED_MAX_LORAS=0)")
+        return self.adapters.register(name, a, b)
+
     def install_weights(self, variables, version: int | None = None) -> int:
         """Live weight swap: replace the parameters *between* decode
         steps without dropping in-flight requests. Every jitted step
@@ -973,6 +1247,9 @@ class ServingEngine:
         self._completed.labels(reason=reason).inc()
         self._req_tenant.pop(slot.req.rid, None)
         self._deadline_abs.pop(slot.req.rid, None)
+        self._submit_ts.pop(slot.req.rid, None)
+        if self.adapters is not None:
+            self.adapters.release(self._req_adapter.pop(slot.req.rid, 0))
         if self.tracer is not None:
             root = self._req_spans.pop(slot.req.rid, None)
             if root is not None:
@@ -1014,15 +1291,126 @@ class ServingEngine:
             # saturated engine still rejects dead-on-arrival work fast
             self._pending.popleft()
             return True, [self._shed(req, "queued_expired")]
-        free = [i for i, s in enumerate(self._slots) if s is None]
-        if not free:
-            return False, []
         plen = len(req.prompt)
         max_new = req.max_new_tokens or self.config.max_new_tokens
+        chunked = (self._chunk is not None and plen > self.chunk_prefill)
+        if chunked and self._chunk_job is not None:
+            return False, []  # one chunk job at a time; wait for it
+        pre: list[Completion] = []
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            # under slot pressure a higher-priority tenant's request may
+            # evict the lowest-priority running stream (paused work, not
+            # failure — the router journal resumes it token-exactly)
+            victim = self._preempt_victim(req)
+            if victim is None:
+                return False, []
+            pre.append(self._preempt(victim, "slots"))
+            free = [victim]
         hit = self._try_prefix_hit(req, plen)
         if hit is not None:
-            return self._admit_hit(req, free[0], hit, plen, max_new)
-        return self._admit_cold(req, free[0], plen, max_new)
+            ok, done = self._admit_hit(req, free[0], hit, plen, max_new)
+        elif chunked:
+            ok, done = self._admit_chunked(req, free[0], plen, max_new)
+        else:
+            ok, done = self._admit_cold(req, free[0], plen, max_new)
+        return ok or bool(pre), pre + done
+
+    def _req_priority(self, req: Request) -> int:
+        return self.sched.priority(
+            self._req_tenant.get(req.rid, req.tenant))
+
+    def _next_seq(self) -> int:
+        self._admit_seq += 1
+        return self._admit_seq
+
+    def _preempt_victim(self, req: Request) -> int | None:
+        """Slot to evict for ``req``: the lowest-priority active slot,
+        most recently admitted among ties — and only one strictly below
+        the incoming request's class, so a flat (or empty) tenant spec
+        keeps the historical never-preempt behavior. Chunking slots (a
+        chunk job in flight, nothing in the journal yet) and slots still
+        force-feeding a prefix suffix are not candidates."""
+        if not self._preempt_enabled:
+            return None
+        prio = self._req_priority(req)
+        best, best_key = None, None
+        for i, s in enumerate(self._slots):
+            if s is None or s.chunking or s.pending:
+                continue
+            if s.priority >= prio:
+                continue
+            key = (s.priority, -s.seq)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def _preempt(self, slot_idx: int, reason: str) -> Completion:
+        """Evict a slot as *paused work*: its pages free immediately and
+        its Completion carries ``finish_reason="preempted"`` — the
+        tokens so far already rode ``on_token`` into the router journal,
+        so the resume (journal force-fed as ground truth) is
+        token-exact. Preemption loses zero tokens."""
+        self._preempt_count += 1
+        self._sched_preempted.labels(reason=reason).inc()
+        return self._release(slot_idx, "preempted")
+
+    def _alloc_preempting(self, req: Request,
+                          n: int) -> tuple[list[int] | None,
+                                           list[Completion]]:
+        """``_alloc_with_evict`` escalated to preemption: when shedding
+        cold prefix-cache entries still leaves the pool short, release a
+        strictly-lower-priority slot's pages and hand its stream back to
+        the router as paused work."""
+        pre: list[Completion] = []
+        pages = self._alloc_with_evict(n)
+        while pages is None:
+            victim = self._preempt_victim(req)
+            if victim is None:
+                break
+            if not self._allocator.reclaimable(self._slots[victim].pages):
+                # every page is shared (prefix cache / CoW siblings):
+                # evicting this stream frees nothing — keep it running
+                break
+            pre.append(self._preempt(victim, "pages"))
+            pages = self._alloc_with_evict(n)
+        return pages, pre
+
+    def _admit_chunked(self, req: Request, slot_idx: int, plen: int,
+                       max_new: int) -> tuple[bool, list[Completion]]:
+        """Seat a long prompt for chunked prefill: allocate its full
+        page run and block table up front (``seq_len`` starts at 0),
+        mark the slot ``chunking`` so decode skips it, and let
+        :meth:`_chunk_step` land the prompt one chunk per engine step."""
+        n_pages = pages_for(plen + max_new + self._spec_slack,
+                            self.cache_cfg.block_size)
+        pages, pre = self._alloc_preempting(req, n_pages)
+        if pages is None:
+            return False, pre
+        self._pending.popleft()
+        bt_row = np.full((self.cache_cfg.max_pages_per_seq,), NULL_PAGE,
+                         np.int32)
+        bt_row[:len(pages)] = pages
+        self._cache = self._install(self._cache, np.int32(slot_idx),
+                                    bt_row, np.int32(0))
+        slot = _Slot(req=req, pages=pages, tokens=[], last_token=0,
+                     max_new=max_new, chunking=True,
+                     priority=self._req_priority(req),
+                     adapter_row=self._req_adapter.get(req.rid, 0),
+                     seq=self._next_seq())
+        self._slots[slot_idx] = slot
+        self._chunk_job = _ChunkJob(slot_idx=slot_idx)
+        self._chunk_count += 1
+        self._sched_chunked.labels(reason="long_prompt").inc()
+        self._admitted.inc()
+        self._tenant_admitted.labels(
+            self._req_tenant.get(req.rid, "default")).inc()
+        if self._prefix is not None:
+            # chunked prompts are not donated to the prefix cache (their
+            # pages fill across many steps); they count as misses
+            self._prefix_misses.inc()
+        self._update_occupancy()
+        return True, pre
 
     def _alloc_with_evict(self, n: int) -> list[int] | None:
         pages = self._allocator.alloc(n)
@@ -1093,7 +1481,9 @@ class ServingEngine:
         slot = _Slot(req=req, pages=list(hit.pages[:w]) + priv, tokens=[],
                      last_token=int(req.prompt[c]), max_new=max_new,
                      pending=[int(t) for t in req.prompt[c + 1:]],
-                     prefix_hit=True)
+                     prefix_hit=True, priority=self._req_priority(req),
+                     adapter_row=self._req_adapter.get(req.rid, 0),
+                     seq=self._next_seq())
         self._slots[slot_idx] = slot
         self._admitted.inc()
         self._tenant_admitted.labels(
@@ -1169,11 +1559,13 @@ class ServingEngine:
             got = self._alloc_with_evict(n_pages + 1)
             if got is not None:
                 pages, spare = got[:n_pages], got[n_pages:]
+        pre: list[Completion] = []
         if pages is None:
-            pages = self._alloc_with_evict(n_pages)
+            pages, pre = self._alloc_preempting(req, n_pages)
         if pages is None:
-            return False, []  # wait for running sequences to free pages
+            return False, pre  # wait for running sequences to free pages
         self._pending.popleft()
+        adapter_row = self._req_adapter.get(req.rid, 0)
         bucket = self._bucket_for(plen)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :plen] = req.prompt
@@ -1183,7 +1575,8 @@ class ServingEngine:
         t_prefill = time.perf_counter()
         first, logits0, cache = self._prefill(
             self.variables, self._cache, ids, bt_row,
-            np.int32(slot_idx), np.int32(plen))
+            np.int32(slot_idx), np.int32(plen),
+            *self._lora_args(rows=[adapter_row]))
         self._cache = cache
         if self._draft_cache is not None:
             # same ids, same pages: the draft's K/V for this prompt lands
@@ -1221,17 +1614,21 @@ class ServingEngine:
         if self.capture_logits:
             self.logit_log.setdefault(req.rid, []).append(
                 np.asarray(logits0[plen - 1]).copy())
-        if self._audit_rate:
+        if self._audit_rate and adapter_row == 0:
+            # adapter-carrying prefills skip the audit: the fp reference
+            # path runs the base model, so the LoRA delta would read as
+            # false drift
             self._maybe_audit_quant(req.rid, ids, plen, logits0)
         slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
-                     max_new=max_new)
+                     max_new=max_new, priority=self._req_priority(req),
+                     adapter_row=adapter_row, seq=self._next_seq())
         self._slots[slot_idx] = slot
         self._emit_token(req.rid, tok)
         self._insert_prefix(slot_idx, slot, bt_row, plen, spare)
         done = self._finish_reason(slot, tok)
         if done:
-            return True, [self._release(slot_idx, done)]
-        return True, []
+            return True, pre + [self._release(slot_idx, done)]
+        return True, pre
 
     def _insert_prefix(self, slot_idx: int, slot: _Slot, bt_row: np.ndarray,
                        plen: int, spare: list[int] | None) -> None:
@@ -1311,6 +1708,15 @@ class ServingEngine:
             raise ValueError(f"{req.rid}: handoff of {plen} prompt + "
                              f"{max_new} new tokens does not fit max_seq "
                              f"{self.cache_cfg.max_seq}")
+        if req.adapter:
+            # the handoff carries only base-model K/V and a first token
+            # the prefill replica argmaxed WITHOUT the adapter delta —
+            # admitting it would silently serve the wrong head
+            self._rejected.inc()
+            self._tenant_rejected.labels(tenant).inc()
+            self.slo.record(tenant, ok=False)
+            raise ValueError(f"{req.rid}: disagg handoff does not carry "
+                             f"adapter state (adapter {req.adapter!r})")
         if bucket > self.cache_cfg.max_seq:
             self._rejected.inc()
             self._tenant_rejected.labels(tenant).inc()
@@ -1363,7 +1769,8 @@ class ServingEngine:
                 trace_id=root.trace_id, parent_id=root.span_id)
         tok = int(first_token)
         slot = _Slot(req=req, pages=pages, tokens=[tok], last_token=tok,
-                     max_new=max_new)
+                     max_new=max_new, priority=self._req_priority(req),
+                     seq=self._next_seq())
         self._slots[slot_idx] = slot
         self._emit_token(req.rid, tok)
         self._update_occupancy()
@@ -1384,6 +1791,8 @@ class ServingEngine:
         active = np.zeros((self.config.max_batch,), bool)
         return kvcache.assert_cache_donated(
             self._decode, self.variables, self._cache, tokens, active,
+            *self._lora_args(rows=np.zeros((self.config.max_batch,),
+                                           np.int32)),
             num_layers=self.cache_cfg.num_layers)
 
     def _snapshot_persistent_cache(self) -> None:
@@ -1422,6 +1831,12 @@ class ServingEngine:
         }
         counted = [report["prefill_executables"],
                    report["decode_executables"]]
+        if self._chunk is not None:
+            # chunked prefill is the one extra fixed-shape executable the
+            # scheduler plane adds; it rides inside the num_buckets + 2
+            # headroom the serve smoke already grants
+            report["chunk_prefill_executables"] = cache_size(self._chunk)
+            counted.append(report["chunk_prefill_executables"])
         if self.spec_k:
             # the verify step REPLACES decode in the engine loop, so the
             # target-model total stays <= num_buckets + 1; the draft's
@@ -1537,6 +1952,12 @@ class ServingEngine:
             out["quant_audits"] = int(self._quant_audits.value)
             out["quant_drift_last_rel"] = self._drift_last
             out["quant_drift_max_rel"] = self._drift_max
+        if self._preempt_enabled:
+            out["preempted"] = self._preempt_count
+        if self._chunk is not None:
+            out["chunked_prefills"] = self._chunk_count
+        if self.adapters is not None:
+            out["lora_adapters"] = len(self.adapters.names)
         if self.spec_k:
             prop = self._spec_proposed.value
             acc = self._spec_accepted.value

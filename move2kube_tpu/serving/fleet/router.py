@@ -58,6 +58,7 @@ from move2kube_tpu.serving.engine import (
     Request,
     ServingEngine,
 )
+from move2kube_tpu.serving.sched import AdmissionController, SchedThrottled
 
 # remaining deadline budget in seconds (gRPC-style relative value, not a
 # wall-clock timestamp — immune to clock skew between pods); each hop
@@ -80,6 +81,14 @@ class ReplicaDraining(RuntimeError):
     draining. Retryable: the router re-routes to a surviving replica."""
 
 
+class RequestPreempted(RuntimeError):
+    """The engine evicted the request mid-stream to make room for a
+    higher-priority tenant (finish_reason ``"preempted"``). Retryable
+    like a replica death — the journal makes the retry a token-exact
+    resume — but NOT the replica's fault: the router neither marks the
+    replica down nor excludes it from the resume placement."""
+
+
 class ReplicaHTTPError(RuntimeError):
     """A replica answered with a non-2xx status. Carries the status code
     and a body excerpt so the router's mark-down reason and logs say
@@ -100,6 +109,10 @@ def failure_reason(err: Exception) -> str:
     the value the reason-labeled retry/mark-down counters carry."""
     if isinstance(err, ReplicaHTTPError):
         return f"http_{err.status}"
+    if isinstance(err, RequestPreempted):
+        return "preempted"
+    if isinstance(err, SchedThrottled):
+        return "throttled"
     if isinstance(err, DeadlineExceeded):
         return "deadline"
     if isinstance(err, (ReplicaDraining, EngineDraining)):
@@ -136,7 +149,7 @@ class ReplicaHandle:
     def generate(self, prompt, max_new_tokens: int | None = None,
                  rid: str | None = None, tenant: str = "",
                  traceparent: str = "", deadline_s: float | None = None,
-                 on_token=None) -> dict:
+                 on_token=None, adapter: str = "") -> dict:
         raise NotImplementedError
 
     def queue_depth(self) -> float:
@@ -283,11 +296,17 @@ class InProcessReplica(ReplicaHandle):
         if comp.finish_reason == "shed":
             raise DeadlineExceeded(
                 f"{comp.rid}: shed while queued (deadline expired)")
+        if comp.finish_reason == "preempted":
+            # paused work, not an error: every emitted token is already
+            # in the caller's journal, so the router resumes it
+            raise RequestPreempted(
+                f"{comp.rid}: preempted after {len(comp.tokens)} tokens")
         return comp
 
     def generate(self, prompt, max_new_tokens=None, rid=None,
                  tenant: str = "", traceparent: str = "",
-                 deadline_s: float | None = None, on_token=None) -> dict:
+                 deadline_s: float | None = None, on_token=None,
+                 adapter: str = "") -> dict:
         if self.fail_next > 0:
             self.fail_next -= 1
             raise RuntimeError(f"{self.name}: injected failure")
@@ -310,7 +329,8 @@ class InProcessReplica(ReplicaHandle):
                                            max_new_tokens=max_new_tokens,
                                            tenant=tenant,
                                            traceparent=traceparent,
-                                           deadline_s=deadline_s))
+                                           deadline_s=deadline_s,
+                                           adapter=adapter))
             except EngineDraining as err:
                 self._waiters.pop(rid, None)
                 self._token_cbs.pop(rid, None)
@@ -448,14 +468,15 @@ class HttpReplica(ReplicaHandle):
 
     def generate(self, prompt, max_new_tokens=None, rid=None,
                  tenant: str = "", traceparent: str = "",
-                 deadline_s: float | None = None, on_token=None) -> dict:
+                 deadline_s: float | None = None, on_token=None,
+                 adapter: str = "") -> dict:
         # request/response transport: there is no mid-stream token feed,
         # so ``on_token`` replays the whole completion at once — a death
         # before the reply resumes as a whole-request retry, which is
         # trivially token-exact
         body = json.dumps({"prompt": list(prompt),
                            "max_new_tokens": max_new_tokens,
-                           "rid": rid}).encode()
+                           "rid": rid, "adapter": adapter}).encode()
         out = json.loads(self._post(
             "/generate", body, "application/json",
             tenant=tenant, traceparent=traceparent,
@@ -463,6 +484,12 @@ class HttpReplica(ReplicaHandle):
         if on_token is not None:
             for tok in out.get("tokens", []):
                 on_token(tok)
+        if out.get("finish_reason") == "preempted":
+            # journal already replayed above; the raise turns the reply
+            # into the same resume path the in-process replica takes
+            raise RequestPreempted(
+                f"{out.get('rid')}: preempted after "
+                f"{len(out.get('tokens', []))} tokens")
         return out
 
     def install(self, handoff_bytes: bytes, tenant: str = "",
@@ -538,6 +565,17 @@ class RouterConfig:
     # does not regress)
     probe_backoff_base_s: float = 0.5
     probe_backoff_cap_s: float = 30.0
+    # scheduler plane (PR 17): the same tenant specs the engines parse —
+    # admission throttles HERE, before placement, so an over-quota
+    # tenant never costs a replica round-trip. Malformed entries warn
+    # and are skipped inside the sched parser (quant.py tolerance).
+    sched_tenants: str = ""
+    sched_priorities: str = ""
+    sched_quotas: str = ""
+    # how many preemption resumes one request may take before the
+    # router gives up (a bound on best-effort starvation spin, NOT a
+    # replica-failure retry — those stay on max_retries)
+    max_preempt_resumes: int = 64
 
     @classmethod
     def from_env(cls, **overrides) -> "RouterConfig":
@@ -563,6 +601,14 @@ class RouterConfig:
                                       cls.probe_backoff_base_s, float),
             probe_backoff_cap_s=_num("M2KT_ROUTER_PROBE_BACKOFF_CAP_S",
                                      cls.probe_backoff_cap_s, float),
+            sched_tenants=os.environ.get("M2KT_SCHED_TENANTS",
+                                         cls.sched_tenants),
+            sched_priorities=os.environ.get("M2KT_SCHED_PRIORITIES",
+                                            cls.sched_priorities),
+            sched_quotas=os.environ.get("M2KT_SCHED_QUOTAS",
+                                        cls.sched_quotas),
+            max_preempt_resumes=_num("M2KT_ROUTER_PREEMPT_RESUMES",
+                                     cls.max_preempt_resumes, int),
         )
         cfg.update(overrides)
         return cls(**cfg)
@@ -585,6 +631,12 @@ class Router:
         # the replica down immediately without waiting for a probe
         self._up: dict[str, bool] = {r.name: True for r in self.replicas}
         self._rr = 0  # round-robin cursor over prefill replicas
+        # scheduler plane: the router front runs admission (token-bucket
+        # throttling) against the same specs the engines parse, so the
+        # two sides can never disagree on who a tenant is
+        self.admission = AdmissionController.from_specs(
+            self.config.sched_tenants, self.config.sched_priorities,
+            self.config.sched_quotas, registry=self.registry)
         # readmission-probe backoff: replica -> (consecutive failed
         # probes, monotonic ts before which it is not probed again)
         self._probe_state: dict[str, tuple[int, float]] = {}
@@ -597,6 +649,10 @@ class Router:
             "Mid-stream requests resumed on a surviving replica with "
             "their journaled tokens force-fed, by failure reason",
             labels=("reason",))
+        self._sched_resumed = reg.counter(
+            "m2kt_sched_resumed_total",
+            "Preempted requests resumed token-exactly from the journal, "
+            "by the reason the resume was needed", labels=("reason",))
         self._retries = reg.counter(
             "m2kt_router_retries_total", "Requests retried on another "
             "replica after a failure")
@@ -766,9 +822,18 @@ class Router:
     def generate(self, prompt, max_new_tokens: int | None = None,
                  rid: str | None = None, tenant: str = "",
                  traceparent: str | None = None,
-                 deadline_s: float | None = None) -> dict:
+                 deadline_s: float | None = None,
+                 adapter: str = "") -> dict:
         prompt = list(prompt)
         tenant = clean_tenant(tenant)
+        # admission runs before any placement or span work: an
+        # over-quota tenant costs the fleet nothing but this check
+        # (the HTTP front maps SchedThrottled to 429)
+        try:
+            self.admission.admit(tenant)
+        except SchedThrottled:
+            self._requests.labels(outcome="throttled").inc()
+            raise
         self._inflight.inc()
         # ONE absolute deadline per request (caller's X-M2KT-Deadline
         # remainder, else the configured default): the disagg attempt,
@@ -802,7 +867,8 @@ class Router:
                 except Exception:  # noqa: BLE001 - fall back to direct path
                     pass
             out = self._generate_direct(prompt, max_new_tokens, rid,
-                                        tenant, root, deadline)
+                                        tenant, root, deadline,
+                                        adapter=adapter)
             self._requests.labels(outcome="ok").inc()
             return out
         except Exception as err:
@@ -821,7 +887,8 @@ class Router:
                 if deadline is not None else None)
 
     def _generate_direct(self, prompt, max_new_tokens, rid, tenant="",
-                         root=None, deadline: float | None = None) -> dict:
+                         root=None, deadline: float | None = None,
+                         adapter: str = "") -> dict:
         tried: list[ReplicaHandle] = []
         last_err: Exception | None = None
         # the journal: every token any replica has emitted for this
@@ -831,9 +898,10 @@ class Router:
         # suffix, and greedy decode regenerates the rest byte-identically
         emitted: list[int] = []
         max_new = max_new_tokens or EngineConfig.max_new_tokens
-        for attempt in range(self.config.max_retries + 1):
+        attempt = preempts = 0
+        while attempt <= self.config.max_retries:
             journal = list(emitted)
-            resumed = bool(attempt and journal)
+            resumed = bool((attempt or preempts) and journal)
             if journal and (len(journal) >= max_new
                             or (self.config.eos_id is not None
                                 and journal[-1] == self.config.eos_id)):
@@ -865,18 +933,22 @@ class Router:
                 self._resumed.labels(reason=failure_reason(last_err)
                                      if last_err is not None
                                      else "unknown").inc()
+                if isinstance(last_err, RequestPreempted):
+                    self._sched_resumed.labels(reason="preempted").inc()
             tried.append(replica)
             try:
                 if self.config.hedge_after_s is not None:
                     out = self._call_hedged(
                         replica, prompt + journal, max_new - len(journal),
-                        rid, tried, tenant, root, remaining)
+                        rid, tried, tenant, root, remaining,
+                        adapter=adapter)
                 else:
                     out = self._call_one(
                         replica, prompt + journal, max_new - len(journal),
                         rid, tenant, root, remaining,
                         on_token=emitted.append,
-                        hop="resume" if resumed else "generate")
+                        hop="resume" if resumed else "generate",
+                        adapter=adapter)
                 if journal:
                     out = dict(out)
                     out["tokens"] = journal + list(out["tokens"])
@@ -885,22 +957,36 @@ class Router:
                 return out
             except DeadlineExceeded:
                 raise  # the caller's problem; not the replica's fault
+            except RequestPreempted as err:
+                # paused, not failed: the replica stays up AND stays
+                # eligible — the same engine usually resumes the work
+                # once the higher-priority burst passes. Bounded so a
+                # best-effort request cannot spin forever under flood.
+                last_err = err
+                tried.pop()
+                preempts += 1
+                if preempts > self.config.max_preempt_resumes:
+                    break
             except Exception as err:  # noqa: BLE001 - any failure fails over
                 last_err = err
                 self._mark_down(replica, failure_reason(err))
+                attempt += 1
         if last_err is not None:
             raise last_err
         raise RuntimeError("router: no healthy replica available")
 
     def _call_one(self, replica, prompt, max_new_tokens, rid, tenant,
                   root, deadline_s: float | None = None, on_token=None,
-                  hop: str = "generate") -> dict:
+                  hop: str = "generate", adapter: str = "") -> dict:
         span, header = self._open_call(root, replica, hop)
+        # adapter rides only when set, so pre-sched ReplicaHandle
+        # subclasses keep their narrower generate() signature
+        extra = {"adapter": adapter} if adapter else {}
         try:
             return replica.generate(prompt, max_new_tokens, rid,
                                     tenant=tenant, traceparent=header,
                                     deadline_s=deadline_s,
-                                    on_token=on_token)
+                                    on_token=on_token, **extra)
         except Exception as err:  # noqa: BLE001 - annotate, then re-raise
             if span is not None:
                 span.attrs["error"] = failure_reason(err)
@@ -911,7 +997,8 @@ class Router:
 
     def _call_hedged(self, primary, prompt, max_new_tokens, rid,
                      tried, tenant="", root=None,
-                     deadline_s: float | None = None) -> dict:
+                     deadline_s: float | None = None,
+                     adapter: str = "") -> dict:
         """Fire ``primary``; if it has not answered within the hedge
         deadline, fire the runner-up too and take whichever finishes
         first. The loser's work is wasted by design — hedging trades
@@ -927,7 +1014,7 @@ class Router:
                 # its own redundancy, so the loser is simply discarded
                 results.append(self._call_one(
                     replica, prompt, max_new_tokens, rid, tenant, root,
-                    deadline_s))
+                    deadline_s, adapter=adapter))
                 done.set()
             except Exception as err:  # noqa: BLE001 - collected below
                 errors.append(err)
@@ -1064,8 +1151,12 @@ class RouterHTTPServer:
                         tenant=self.headers.get(TENANT_HEADER, ""),
                         traceparent=self.headers.get(
                             TRACEPARENT_HEADER),
-                        deadline_s=deadline_s)
+                        deadline_s=deadline_s,
+                        adapter=payload.get("adapter", "") or "")
                     self._send(200, json.dumps(out).encode())
+                except SchedThrottled as err:
+                    self._send(429, json.dumps(
+                        {"error": str(err)}).encode())
                 except DeadlineExceeded as err:
                     self._send(504, json.dumps(
                         {"error": str(err)}).encode())

@@ -267,6 +267,15 @@ class PageAllocator:
         """More than one holder — writes must copy-on-write first."""
         return self._refs.get(int(page), 0) > 1
 
+    def reclaimable(self, pages) -> int:
+        """How many of ``pages`` would actually return to the free list
+        if their holder freed them now — shared pages (prefix cache,
+        copy-on-write siblings) stay resident under their other holders.
+        The scheduler's page-pressure preemption consults this before
+        evicting a victim: a slot whose pages are all shared buys the
+        incoming request nothing, so killing its stream is pure waste."""
+        return sum(1 for p in pages if self._refs.get(int(p), 0) == 1)
+
     def free(self, pages) -> None:
         """Drop one reference per page; a page returns to the free list
         only when its last holder releases it."""

@@ -1,0 +1,622 @@
+"""Multi-tenant scheduler plane: admission (token-bucket quotas +
+priority classes, tolerant spec parsing), priority preemption with
+token-exact journal resume, chunked prefill, and paged multi-LoRA
+serving.
+
+The load-bearing properties mirror the serving suite's: *equivalence*.
+A preempted-and-resumed stream must be byte-identical to an
+uninterrupted greedy run (fp32 exact; int8-kv logit-gated while the
+trajectories coincide), a chunked prefill must reproduce the whole
+prefill's logits, and every adapter in a multi-LoRA batch must
+reproduce a dedicated engine with the LoRA delta merged into the
+lm_head weights — all without growing the fixed-executable budget.
+"""
+
+import dataclasses
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from move2kube_tpu.models.gpt2 import GPT2, gpt2_tiny
+from move2kube_tpu.models.llama import Llama, llama_tiny
+from move2kube_tpu.obs.metrics import Registry
+from move2kube_tpu.obs.rules import (
+    THRESHOLDS,
+    grafana_dashboard,
+    prometheus_rule,
+)
+from move2kube_tpu.qa import engine as qaengine
+from move2kube_tpu.serving import quant as quantlib
+from move2kube_tpu.serving.engine import EngineConfig, Request, ServingEngine
+from move2kube_tpu.serving.fleet.router import (
+    RequestPreempted,
+    RouterConfig,
+    build_fleet,
+)
+from move2kube_tpu.serving.kvcache import PageAllocator
+from move2kube_tpu.serving.sched import (
+    AdapterStore,
+    AdmissionController,
+    SchedThrottled,
+    TokenBucket,
+    merge_split_specs,
+    parse_tenant_spec,
+)
+from move2kube_tpu.serving.sched.admission import DEFAULT_PRIORITY, PRIORITIES
+from move2kube_tpu.types.ir import IR, Service
+from move2kube_tpu.types.plan import AcceleratorInfo
+
+
+@pytest.fixture(scope="module")
+def llama_parts():
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def gpt2_parts():
+    cfg = dataclasses.replace(gpt2_tiny(), dtype=jnp.float32)
+    model = GPT2(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, **over) -> ServingEngine:
+    cfg = EngineConfig(**{**dict(max_batch=2, max_seq=64, block_size=8,
+                                 buckets=(16, 32)), **over})
+    return ServingEngine(model, variables, cfg)
+
+
+def _prompt(seed, plen=10):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 200, size=plen).tolist()
+
+
+# ----------------------------------------------------------------------
+# spec parsing: tolerant, warn-and-skip
+# ----------------------------------------------------------------------
+
+def test_parse_tenant_spec():
+    pols = parse_tenant_spec(
+        "gold:prio=high,rate=50,burst=100;free:prio=besteffort;flat:")
+    assert pols["gold"].priority == "high"
+    assert pols["gold"].rate == 50 and pols["gold"].burst == 100
+    assert pols["gold"].priority_class > pols["free"].priority_class
+    assert pols["free"].rate == 0  # unlimited
+    assert pols["flat"].priority == DEFAULT_PRIORITY
+
+
+def test_parse_tenant_spec_skips_malformed():
+    warned = []
+    pols = parse_tenant_spec(
+        "ok:prio=high;bad:prio=emperor;worse:rate=minusfive;:prio=high",
+        warn=warned.append)
+    assert set(pols) == {"ok"}
+    assert len(warned) == 3  # every malformed entry named, none fatal
+
+
+def test_merge_split_specs_combined_wins():
+    combined = parse_tenant_spec("gold:prio=high,rate=9,burst=9")
+    merged = merge_split_specs(combined,
+                               priorities="gold:besteffort;free:besteffort",
+                               quotas="gold:1/1;free:5/10")
+    # the combined spec owns gold outright; split knobs only add tenants
+    assert merged["gold"].priority == "high" and merged["gold"].rate == 9
+    assert merged["free"].priority == "besteffort"
+    assert merged["free"].rate == 5 and merged["free"].burst == 10
+
+
+def test_merge_split_specs_tolerant():
+    warned = []
+    merged = merge_split_specs({}, priorities="a:high;b:king",
+                               quotas="a:3/6;c:fast/loose",
+                               warn=warned.append)
+    assert set(merged) == {"a"}
+    assert len(warned) == 2
+
+
+# ----------------------------------------------------------------------
+# token bucket: refill goldens on an injected clock
+# ----------------------------------------------------------------------
+
+def test_token_bucket_refill_golden():
+    now = [0.0]
+    b = TokenBucket(rate=2.0, burst=4.0, clock=lambda: now[0])
+    # burst drains dry, then refuses
+    assert [b.take() for _ in range(5)] == [True] * 4 + [False]
+    # 1s at 2 req/s buys exactly two admits
+    now[0] = 1.0
+    assert [b.take() for _ in range(3)] == [True, True, False]
+    # refill caps at burst no matter how long the idle gap
+    now[0] = 100.0
+    assert b.tokens == pytest.approx(4.0)
+    # fractional refill: 0.25s at 2/s is half a token — not admittable,
+    # visible in the gauge
+    assert [b.take() for _ in range(4)] == [True] * 4
+    now[0] = 100.25
+    assert not b.take()
+    assert b.tokens == pytest.approx(0.5)
+
+
+def test_admission_controller_throttles_and_counts():
+    now = [0.0]
+    reg = Registry()
+    adm = AdmissionController.from_specs(
+        tenants="gold:rate=1,burst=2", registry=reg,
+        clock=lambda: now[0])
+    adm.admit("gold")
+    adm.admit("gold")
+    with pytest.raises(SchedThrottled):
+        adm.admit("gold")
+    adm.admit("anonymous")  # unknown tenants are never throttled
+    now[0] = 1.0
+    adm.admit("gold")  # refilled
+    assert 'm2kt_sched_throttled_total{reason="quota"} 1' in reg.render()
+
+
+def test_priority_classes():
+    adm = AdmissionController.from_specs(
+        tenants="gold:prio=high;free:prio=besteffort")
+    assert adm.priority("gold") > adm.priority("") > adm.priority("free")
+    assert adm.distinct_priorities()
+    flat = AdmissionController.from_specs(tenants="a:rate=5,burst=5")
+    assert not flat.distinct_priorities()  # quotas alone never preempt
+    assert not AdmissionController.from_specs().configured
+
+
+# ----------------------------------------------------------------------
+# allocator: reclaimability under sharing
+# ----------------------------------------------------------------------
+
+def test_page_allocator_reclaimable():
+    alloc = PageAllocator(8)
+    pages = alloc.alloc(3)
+    assert alloc.reclaimable(pages) == 3
+    alloc.incref([pages[0]])  # shared with a prefix-cache/CoW sibling
+    assert alloc.reclaimable(pages) == 2
+    alloc.free([pages[0]])
+    assert alloc.reclaimable(pages) == 3
+
+
+# ----------------------------------------------------------------------
+# chunked prefill: logit equivalence + executable budget
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("family", ["llama", "gpt2"])
+def test_chunked_prefill_logit_equivalence(family, llama_parts, gpt2_parts):
+    model, variables = llama_parts if family == "llama" else gpt2_parts
+    prompt = _prompt(3, plen=40)
+    whole = _engine(model, variables, max_seq=128, buckets=(16, 64))
+    whole.capture_logits = True
+    ref = whole.run([Request("r", list(prompt), 6)])[0]
+
+    chunked = _engine(model, variables, max_seq=128, buckets=(16, 64),
+                      chunk_prefill=16)
+    chunked.capture_logits = True
+    got = chunked.run([Request("r", list(prompt), 6)])[0]
+
+    assert got.tokens == ref.tokens
+    for a, b in zip(whole.logit_log["r"], chunked.logit_log["r"]):
+        assert np.allclose(a, b, atol=1e-4), np.abs(a - b).max()
+    assert chunked.stats()["chunked_prefills"] >= 1
+    # the chunk executable is ONE more fixed-shape program, inside the
+    # num_buckets + 2 budget
+    report = chunked.compile_report()
+    assert report["chunk_prefill_executables"] == 1
+    assert report["total_executables"] <= 2 + 2
+
+
+def test_short_prompts_skip_chunking(llama_parts):
+    model, variables = llama_parts
+    eng = _engine(model, variables, chunk_prefill=16)
+    out = eng.run([Request("r", _prompt(4, plen=8), 4)])[0]
+    assert len(out.tokens) == 4
+    assert eng.stats()["chunked_prefills"] == 0
+
+
+# ----------------------------------------------------------------------
+# preemption: paused-not-failed completions, engine-level
+# ----------------------------------------------------------------------
+
+def test_preempt_emits_paused_completion(llama_parts):
+    """Two best-effort streams hold both slots; a gold arrival must
+    evict the most recent one. The victim's completion is paused work
+    (finish_reason="preempted", partial tokens that prefix the
+    uninterrupted run), never a lost request."""
+    model, variables = llama_parts
+    spec = "gold:prio=high;free:prio=besteffort"
+    truth = _engine(model, variables).run(
+        [Request("t", _prompt(5), 12)])[0]
+
+    eng = _engine(model, variables, sched_tenants=spec)
+    eng.submit(Request("be1", _prompt(5), 12, tenant="free"))
+    eng.submit(Request("be2", _prompt(5, plen=9), 12, tenant="free"))
+    done = []
+    for _ in range(4):
+        done += eng.step()
+    assert not done  # both still decoding, both slots held
+    eng.submit(Request("gold", _prompt(6), 2, tenant="gold"))
+    while eng.has_work():
+        done += eng.step()
+    by = {c.rid: c for c in done}
+    # most-recently-admitted best-effort stream is the victim
+    assert by["be2"].finish_reason == "preempted"
+    assert by["be1"].finish_reason == "length"
+    assert len(by["gold"].tokens) == 2
+    assert eng.stats()["preempted"] == 1
+    # the paused stream's tokens are a prefix of the uninterrupted run
+    assert by["be1"].tokens == truth.tokens
+    n = len(by["be2"].tokens)
+    assert 0 < n < 12
+
+
+def test_no_preemption_without_distinct_priorities(llama_parts):
+    """A flat tenant spec keeps the historical never-preempt behavior:
+    the gold request waits its turn instead of evicting anyone."""
+    model, variables = llama_parts
+    eng = _engine(model, variables)
+    eng.submit(Request("be1", _prompt(5), 6, tenant="free"))
+    eng.submit(Request("be2", _prompt(5, plen=9), 6, tenant="free"))
+    for _ in range(2):
+        eng.step()
+    eng.submit(Request("late", _prompt(6), 2, tenant="gold"))
+    done = {c.rid: c for c in eng.run([])}
+    assert done["be1"].finish_reason == "length"
+    assert done["be2"].finish_reason == "length"
+    assert done["late"].finish_reason == "length"
+    assert "preempted" not in eng.stats()
+
+
+# ----------------------------------------------------------------------
+# preemption: token-exact resume through the router journal
+# ----------------------------------------------------------------------
+
+def test_preempt_resume_token_exact_fp32(llama_parts):
+    """The full loop: a best-effort stream is preempted mid-decode, the
+    router's journal force-feeds the emitted tokens on the SAME replica
+    (a preempt is not the replica's fault), and the resumed output is
+    byte-identical to an uninterrupted greedy run."""
+    model, variables = llama_parts
+    spec = "gold:prio=high;free:prio=besteffort"
+    ecfg = EngineConfig(max_batch=2, max_seq=128, block_size=8,
+                        buckets=(16, 64), sched_tenants=spec)
+    router = build_fleet(model, variables, 1, engine_config=ecfg,
+                         router_config=RouterConfig(sched_tenants=spec))
+    eng = router.replicas[0].engine
+    p1, p2 = _prompt(7), _prompt(8, plen=9)
+    try:
+        truth = [router.generate(list(p), max_new_tokens=24,
+                                 tenant="free")["tokens"]
+                 for p in (p1, p2)]
+        results = {}
+
+        def _flood(i, p):
+            results[i] = router.generate(list(p), max_new_tokens=24,
+                                         tenant="free")
+
+        threads = [threading.Thread(target=_flood, args=(i, p))
+                   for i, p in enumerate((p1, p2))]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30.0
+        while (time.monotonic() < deadline
+               and eng.stats().get("active_slots", 0) < 2):
+            time.sleep(0.002)
+        router.generate(_prompt(9), max_new_tokens=2, tenant="gold")
+        for t in threads:
+            t.join(timeout=60)
+        assert eng.stats().get("preempted", 0) >= 1
+        for i in range(2):
+            assert results[i]["tokens"] == truth[i], f"stream {i} diverged"
+        # resumed via the scheduler counter, and the replica was never
+        # marked down — a preempt is backpressure, not a failure
+        assert router._sched_resumed.labels(reason="preempted").value >= 1
+        assert router.replicas[0].healthy()
+    finally:
+        for rep in router.replicas:
+            rep.close()
+
+
+def test_resume_refeed_int8kv_logit_gated(llama_parts):
+    """The resume mechanics in isolation (what the journal does: re-feed
+    prompt + emitted tokens to a fresh prefill) under int8-kv. The
+    re-prefilled stream sees requantized KV, so tokens may legitimately
+    fork at a near-tie — while the trajectories coincide the logits
+    must stay inside the int8 relative-error gate."""
+    model, variables = llama_parts
+    prompt = _prompt(11, plen=12)
+    full = _engine(model, variables, quant="int8-kv", max_seq=128,
+                   buckets=(16, 64))
+    full.capture_logits = True
+    truth = full.run([Request("t", list(prompt), 10)])[0]
+
+    k = 4  # "preempted" after 4 emitted tokens
+    resumed = _engine(model, variables, quant="int8-kv", max_seq=128,
+                      buckets=(16, 64))
+    resumed.capture_logits = True
+    out = resumed.run([Request("r", list(prompt) + truth.tokens[:k],
+                               10 - k)])[0]
+    tail, ref_tail = out.tokens, truth.tokens[k:]
+    agree = 0
+    while agree < len(ref_tail) and tail[agree] == ref_tail[agree]:
+        agree += 1
+    for i in range(min(agree + 1, len(resumed.logit_log["r"]),
+                       len(full.logit_log["t"]) - k)):
+        gate = quantlib.logit_gate(full.logit_log["t"][k + i],
+                                   resumed.logit_log["r"][i])
+        assert gate["max_rel_err"] < 0.05, (i, gate)
+    assert agree >= 1  # the gate actually compared something
+
+
+# ----------------------------------------------------------------------
+# router front: quota throttling
+# ----------------------------------------------------------------------
+
+def test_router_throttles_over_quota(llama_parts):
+    model, variables = llama_parts
+    rcfg = RouterConfig(sched_tenants="free:rate=0.001,burst=2")
+    router = build_fleet(model, variables, 1, engine_config=EngineConfig(
+        max_batch=2, max_seq=64, block_size=8, buckets=(16,)),
+        router_config=rcfg)
+    try:
+        p = _prompt(12, plen=6)
+        router.generate(list(p), max_new_tokens=1, tenant="free")
+        router.generate(list(p), max_new_tokens=1, tenant="free")
+        with pytest.raises(SchedThrottled):
+            router.generate(list(p), max_new_tokens=1, tenant="free")
+        # other tenants are unaffected by one tenant's empty bucket
+        router.generate(list(p), max_new_tokens=1, tenant="gold")
+        text = router.registry.render()
+        assert 'm2kt_sched_throttled_total{reason="quota"} 1' in text
+        assert 'outcome="throttled"' in text
+    finally:
+        for rep in router.replicas:
+            rep.close()
+
+
+# ----------------------------------------------------------------------
+# multi-LoRA: batched equivalence vs dedicated merged-weight engines
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("family", ["llama", "gpt2"])
+def test_multilora_batch_equivalence(family, llama_parts, gpt2_parts):
+    model, variables = llama_parts if family == "llama" else gpt2_parts
+    cfg = model.cfg
+    d_model = cfg.d_model
+    vocab = cfg.vocab_size
+    rng = np.random.default_rng(21)
+    eng = _engine(model, variables, max_batch=4, max_loras=4, lora_rank=8)
+    adapters = {}
+    for name, rank in (("fin", 4), ("legal", 2)):
+        a = (rng.normal(size=(d_model, rank)) * 0.1).astype(np.float32)
+        b = (rng.normal(size=(rank, vocab)) * 0.1).astype(np.float32)
+        eng.register_adapter(name, a, b)
+        adapters[name] = (a, b)
+    prompt = _prompt(22)
+    mix = ["", "fin", "legal", "fin"]
+    outs = eng.run([Request(f"r{i}", list(prompt), 6, adapter=nm)
+                    for i, nm in enumerate(mix)])
+    got = {c.rid: c.tokens for c in outs}
+    # adapter stacks are traced operands: no per-adapter executables
+    assert eng.compile_report()["total_executables"] <= 2 + 2
+    assert eng.stats()["lora_adapters"] == 2
+
+    for name, (a, b) in adapters.items():
+        # dedicated single-adapter engine: the batch must not let the
+        # other rows' adapters bleed into this stream
+        ded = _engine(model, variables, max_batch=4, max_loras=1,
+                      lora_rank=8)
+        ded.register_adapter(name, a, b)
+        want = ded.run([Request("x", list(prompt), 6,
+                                adapter=name)])[0].tokens
+        if family == "llama":
+            # stronger oracle where the head is untied: the LoRA delta
+            # merged directly into the lm_head weights
+            params = dict(variables["params"])
+            head = dict(params["lm_head"])
+            head["kernel"] = head["kernel"] + a @ b
+            params["lm_head"] = head
+            merged = _engine(model, {"params": params}, max_batch=4)
+            assert merged.run([Request("x", list(prompt), 6)]
+                              )[0].tokens == want, name
+        for rid, nm in zip(got, mix):
+            if nm == name:
+                assert got[rid] == want, (family, name)
+    base = _engine(model, variables, max_batch=4)
+    want = base.run([Request("x", list(prompt), 6)])[0].tokens
+    assert got["r0"] == want  # row 0 is the zero adapter = base model
+
+
+def test_adapter_refcounts_and_rejection(llama_parts):
+    model, variables = llama_parts
+    eng = _engine(model, variables, max_loras=2, lora_rank=4)
+    cfg = model.cfg
+    rng = np.random.default_rng(23)
+    a = rng.normal(size=(cfg.d_model, 4)).astype(np.float32)
+    b = rng.normal(size=(4, cfg.vocab_size)).astype(np.float32)
+    row = eng.register_adapter("fin", a, b)
+    assert row == 1  # row 0 is reserved for the base model
+    with pytest.raises(ValueError):
+        eng.submit(Request("r", _prompt(24), 2, adapter="unknown"))
+    out = eng.run([Request("r", _prompt(24), 2, adapter="fin")])[0]
+    assert len(out.tokens) == 2
+    # per-request refs released at completion: only the registration
+    # ref remains, and unregister returns the row to the pool
+    assert eng.adapters.refcount(row) == 1
+    eng.adapters.unregister("fin")
+    assert eng.adapters.refcount(row) == 0
+    # rank above the stack's capacity is a registration-time error
+    wide = rng.normal(size=(cfg.d_model, 9)).astype(np.float32)
+    with pytest.raises(ValueError):
+        eng.register_adapter("wide", wide,
+                             rng.normal(size=(9, cfg.vocab_size))
+                             .astype(np.float32))
+
+
+def test_adapter_store_load_dir(tmp_path):
+    store = AdapterStore(d_model=8, vocab=16, rank=4, max_loras=4)
+    rng = np.random.default_rng(25)
+    np.savez(tmp_path / "fin.npz",
+             a=rng.normal(size=(8, 2)).astype(np.float32),
+             b=rng.normal(size=(2, 16)).astype(np.float32))
+    np.savez(tmp_path / "broken.npz",
+             a=rng.normal(size=(3, 2)).astype(np.float32))  # no "b"
+    (tmp_path / "notes.txt").write_text("ignored")
+    warned = []
+    count = store.load_dir(str(tmp_path), warn=warned.append)
+    assert count == 1 and store.names == ["fin"]
+    assert warned  # the broken registry entry was named, not fatal
+
+
+# ----------------------------------------------------------------------
+# config: tolerant env parsing (quant.py conventions)
+# ----------------------------------------------------------------------
+
+def test_engine_config_from_env_tolerant(monkeypatch):
+    monkeypatch.setenv("M2KT_SCHED_TENANTS", "gold:prio=high")
+    monkeypatch.setenv("M2KT_SCHED_CHUNK_PREFILL", "not-an-int")
+    monkeypatch.setenv("M2KT_SCHED_MAX_LORAS", "-3")
+    cfg = EngineConfig.from_env()
+    assert cfg.sched_tenants == "gold:prio=high"
+    assert cfg.chunk_prefill == 0  # warn + default, never a crash
+    assert cfg.max_loras == 0      # negative clamps to off
+
+
+def test_router_config_from_env_tolerant(monkeypatch):
+    monkeypatch.setenv("M2KT_SCHED_PRIORITIES", "gold:high")
+    monkeypatch.setenv("M2KT_SCHED_QUOTAS", "gold:5/10")
+    monkeypatch.setenv("M2KT_ROUTER_PREEMPT_RESUMES", "bogus")
+    cfg = RouterConfig.from_env()
+    assert cfg.sched_priorities == "gold:high"
+    assert cfg.sched_quotas == "gold:5/10"
+    assert cfg.max_preempt_resumes == 64  # warn + default
+    assert isinstance(RequestPreempted("x"), RuntimeError)
+
+
+# ----------------------------------------------------------------------
+# QA knob -> optimizer pass -> Helm parameterization
+# ----------------------------------------------------------------------
+
+
+class _AnswerEngine(qaengine.Engine):
+    def __init__(self, answers):
+        self.answers = answers
+
+    def fetch_answer(self, problem):
+        if problem.id in self.answers:
+            problem.set_answer(self.answers[problem.id])
+        return problem
+
+
+def _qa(answers=None):
+    qaengine.reset_engines()
+    if answers:
+        qaengine.add_engine(_AnswerEngine(answers))
+    qaengine.start_engine(qa_skip=True)
+
+
+def _serving_ir():
+    svc = Service(name="api")
+    svc.accelerator = AcceleratorInfo(
+        gpu_count=1, tpu_accelerator="tpu-v5e-slice", tpu_topology="1x1",
+        serving=True, serving_port=8000)
+    svc.containers.append({"name": "api", "image": "r/a:latest"})
+    ir = IR(name="p")
+    ir.add_service(svc)
+    return ir, svc
+
+
+def test_sched_optimizer_injects_env():
+    from move2kube_tpu.passes.optimize import tpu_sched_optimizer
+
+    ir, svc = _serving_ir()
+    _qa({"m2kt.services.api.serve.sched.priorities":
+         "gold:high;free:besteffort",
+         "m2kt.services.api.serve.sched.quotas": "free:5/10",
+         "m2kt.services.api.serve.sched.maxloras": "8"})
+    try:
+        ir = tpu_sched_optimizer(ir)
+        ir = tpu_sched_optimizer(ir)  # idempotent
+    finally:
+        qaengine.reset_engines()
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_SCHED_PRIORITIES"] == "gold:high;free:besteffort"
+    assert env["M2KT_SCHED_QUOTAS"] == "free:5/10"
+    assert env["M2KT_SCHED_CHUNK_PREFILL"] == "0"  # unanswered default
+    assert env["M2KT_SCHED_MAX_LORAS"] == "8"
+    assert len([e for e in svc.containers[0]["env"]
+                if e["name"] == "M2KT_SCHED_QUOTAS"]) == 1
+
+
+def test_sched_optimizer_tolerates_bad_int_answer():
+    from move2kube_tpu.passes.optimize import tpu_sched_optimizer
+
+    ir, svc = _serving_ir()
+    _qa({"m2kt.services.api.serve.sched.chunkprefill": "many"})
+    try:
+        ir = tpu_sched_optimizer(ir)
+    finally:
+        qaengine.reset_engines()
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_SCHED_CHUNK_PREFILL"] == "0"
+
+
+def test_sched_parameterizer_lifts_to_helm_values():
+    from move2kube_tpu.passes.parameterize import tpu_sched_parameterizer
+
+    ir, svc = _serving_ir()
+    svc.containers[0]["env"] = [
+        {"name": "M2KT_SCHED_PRIORITIES", "value": "gold:high"},
+        {"name": "M2KT_SCHED_QUOTAS", "value": ""},
+        {"name": "M2KT_SCHED_CHUNK_PREFILL", "value": "64"},
+        {"name": "M2KT_SCHED_MAX_LORAS", "value": "4"},
+    ]
+    ir = tpu_sched_parameterizer(ir)
+    gv = ir.values.global_variables
+    assert gv["tpuschedpriorities"] == "gold:high"
+    assert gv["tpuschedquotas"] == ""  # empty knobs still become values
+    assert gv["tpuschedchunkprefill"] == "64"
+    assert gv["tpuschedmaxloras"] == "4"
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_SCHED_PRIORITIES"] == \
+        "{{ .Values.tpuschedpriorities }}"
+    # second run must not double-template
+    ir = tpu_sched_parameterizer(ir)
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_SCHED_PRIORITIES"] == \
+        "{{ .Values.tpuschedpriorities }}"
+
+
+# ----------------------------------------------------------------------
+# alert rules + dashboard
+# ----------------------------------------------------------------------
+
+def test_priority_starvation_rule_and_dashboard():
+    assert "tpuschedstarvefactor" in THRESHOLDS
+    doc = prometheus_rule("svc", "app", serving=False)
+    alerts = {r["alert"]
+              for g in doc["spec"]["groups"] for r in g["rules"]}
+    assert "M2KTPriorityStarvation" not in alerts  # serving-only
+    doc = prometheus_rule("svc", "app", serving=True)
+    rules = {r["alert"]: r
+             for g in doc["spec"]["groups"] for r in g["rules"]}
+    starve = rules["M2KTPriorityStarvation"]
+    # only fires while preemption is actually happening: starvation is
+    # an interaction between tiers, not plain slowness
+    assert "m2kt_sched_preempted_total" in starve["expr"]
+    assert "m2kt_slo_tenant_ttft_p95_seconds" in starve["expr"]
+    dash = grafana_dashboard("svc", "app", serving=True)
+    text = str(dash)
+    assert "m2kt_sched_preempted_total" in text
+    assert "m2kt_sched_throttled_total" in text
+    assert "m2kt_sched_chunked_total" in text
