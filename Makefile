@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke trainkernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke sched-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke trainkernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke sched-smoke autoscale-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -208,6 +208,17 @@ numerics-smoke:
 sched-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_sched.py -q
 	$(CPU_ENV) $(PY) bench.py --model sched
+
+# autoscaling plane in isolation (CPU-mode): demand forecaster goldens
+# + controller hysteresis + the discrete-event fleet simulator + the
+# emission dueling-controller guard, then the bench autoscale phase
+# (24h million-user sim — predictive must beat the reactive HPA on SLO
+# attainment AND replica-hours — plus a live smoke where a forecasted
+# ramp scales a real fleet before the fast-burn alert fires and drains
+# back down without losing a stream)
+autoscale-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_autoscale.py -q
+	$(CPU_ENV) $(PY) bench.py --model autoscale
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

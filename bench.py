@@ -68,7 +68,7 @@ MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
 PHASES = ("resnet", "bert", "pallas", "llama", "translate", "goodput",
           "scaling", "serving", "fleet", "quant", "kernels", "obs",
-          "chaos", "swap", "numerics", "sched")
+          "chaos", "swap", "numerics", "sched", "autoscale")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
@@ -88,6 +88,7 @@ PHASE_METRICS = {
     "swap": ("swap_cold_join_ttft_speedup", "x"),
     "numerics": ("numerics_telemetry_overhead_fraction", "fraction"),
     "sched": ("multilora_aggregate_tokens_s", "tok/s"),
+    "autoscale": ("autoscale_replica_hours_saving", "fraction"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
@@ -1581,6 +1582,283 @@ def run_sched_probe() -> int:
     return 0
 
 
+def bench_autoscale(n: int) -> dict:
+    """Predictive-autoscaling phase, two halves in one probe child.
+    Half 1 (the scale the probe can never serve): the discrete-event
+    fleet simulator replays a >=24h diurnal+bursty trace with over a
+    million DISTINCT simulated users in seconds of wall clock, running
+    the REAL production controller (DemandForecaster +
+    PredictiveAutoscaler) against a faithful reactive-HPA model on the
+    SAME trace — the phase FAILS unless predictive wins on BOTH SLO
+    attainment AND replica-hours. Half 2 (the scale it can): a live
+    in-process fleet where a forecasted traffic ramp grows the fleet
+    BEFORE the PR-12 fast-burn alert fires, and the forecast collapse
+    afterwards shrinks it through the PR-13 drain path with zero lost
+    streams. Reports the replica-hours saving fraction vs reactive HPA
+    on the simulated day."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    # drill-scale the SLO windows so the live smoke's burn-rate gate
+    # reads a window its seconds-long ramp can actually fill
+    env.setdefault("M2KT_SLO_WINDOW_SCALE", "0.01")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--autoscale-probe"],
+        env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"autoscale probe rc={res.returncode}: {res.stderr[-300:]}")
+    probe = json.loads(res.stdout.strip().splitlines()[-1])
+    dt = time.perf_counter() - t0
+    saving = 1.0 - (probe["sim_predictive_replica_hours"]
+                    / probe["sim_reactive_replica_hours"])
+    print(f"[bench] autoscale: sim {probe['sim_requests']} reqs / "
+          f"{probe['sim_distinct_users']} users in "
+          f"{probe['sim_wall_s']:.1f}s — attainment "
+          f"{probe['sim_predictive_attainment']:.4f} vs "
+          f"{probe['sim_reactive_attainment']:.4f}, hours "
+          f"{probe['sim_predictive_replica_hours']:.1f} vs "
+          f"{probe['sim_reactive_replica_hours']:.1f} "
+          f"({saving:.1%} saved); live smoke scaled in "
+          f"{probe['live_scale_up_s']:.1f}s (cold-join lead "
+          f"{probe['live_cold_join_s']:.1f}s) with burn "
+          f"{probe['live_burn_at_scale_up']:.2f}<"
+          f"{probe['fast_burn_limit']} and "
+          f"{probe['live_lost_streams']} lost streams in {dt:.1f}s",
+          file=sys.stderr)
+    metric, unit = PHASE_METRICS["autoscale"]
+    return {"phase": "autoscale", "metric": metric,
+            "value": round(saving, 4), "unit": unit,
+            "vs_baseline": round(
+                probe["sim_reactive_replica_hours"]
+                / probe["sim_predictive_replica_hours"], 3),
+            "baseline": "reactive_hpa_same_trace",
+            "sim_requests": probe["sim_requests"],
+            "sim_distinct_users": probe["sim_distinct_users"],
+            "sim_duration_s": probe["sim_duration_s"],
+            "sim_wall_s": probe["sim_wall_s"],
+            "sim_predictive_attainment":
+                probe["sim_predictive_attainment"],
+            "sim_reactive_attainment": probe["sim_reactive_attainment"],
+            "sim_predictive_replica_hours":
+                probe["sim_predictive_replica_hours"],
+            "sim_reactive_replica_hours":
+                probe["sim_reactive_replica_hours"],
+            "sim_predictive_p95_ttft_s":
+                probe["sim_predictive_p95_ttft_s"],
+            "sim_reactive_p95_ttft_s": probe["sim_reactive_p95_ttft_s"],
+            "live_cold_join_s": probe["live_cold_join_s"],
+            "live_scale_up_s": probe["live_scale_up_s"],
+            "live_burn_at_scale_up": probe["live_burn_at_scale_up"],
+            "fast_burn_limit": probe["fast_burn_limit"],
+            "live_requests_ok": probe["live_requests_ok"],
+            "live_lost_streams": probe["live_lost_streams"],
+            "slo_window_scale": probe["slo_window_scale"],
+            "wall_s": round(dt, 2)}
+
+
+def run_autoscale_probe() -> int:
+    """In-process half of the autoscale phase (spawned by
+    bench_autoscale with jax forced onto host devices). Part 1: the
+    fleet simulator's 24h predictive-vs-reactive gate at million-user
+    scale. Part 2: live smoke — a 1-replica llama_tiny fleet under a
+    ramping load; the forecaster sees the ramp in the router's
+    admitted-token counter, the controller grows the fleet to 2 while
+    the fast-burn alert is still quiet, then the post-ramp forecast
+    collapse drain-shrinks back to 1 losing zero streams. Prints one
+    JSON line."""
+    import dataclasses
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.obs.slo import FAST_BURN
+    from move2kube_tpu.serving.engine import EngineConfig, ServingEngine
+    from move2kube_tpu.serving.fleet.autoscaler import (
+        AutoscaleConfig, FleetActuator, PredictiveAutoscaler)
+    from move2kube_tpu.serving.fleet.forecast import (
+        CounterDemand, DemandForecaster, ForecastConfig)
+    from move2kube_tpu.serving.fleet.router import (InProcessReplica,
+                                                    build_fleet)
+    from move2kube_tpu.serving.fleet.sim import compare_policies
+
+    # ---- part 1: million-user simulated day --------------------------
+    sim = compare_policies()
+    react, pred = sim["reactive"], sim["predictive"]
+    assert sim["trace"]["duration_s"] >= 86400, sim["trace"]
+    assert sim["trace"]["distinct_users"] >= 1_000_000, (
+        f"only {sim['trace']['distinct_users']} distinct simulated "
+        "users — the trace is below the million-user gate")
+    assert sim["wall_s"] < 60.0, (
+        f"simulated day took {sim['wall_s']:.1f}s wall — over the 60s "
+        "CPU CI budget")
+    assert react["lost_streams"] == 0 and pred["lost_streams"] == 0
+    assert sim["predictive_wins"], (
+        "predictive policy did not beat reactive HPA on BOTH axes: "
+        f"attainment {pred['attainment']:.4f} vs "
+        f"{react['attainment']:.4f}, replica-hours "
+        f"{pred['replica_hours']:.1f} vs {react['replica_hours']:.1f}")
+
+    # ---- part 2: live smoke ------------------------------------------
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(23)
+    ecfg = EngineConfig(max_batch=2, max_seq=128, block_size=8,
+                        buckets=(32,))
+    router = build_fleet(model, variables, 1, engine_config=ecfg)
+    prompt = rng.integers(1, cfg.vocab_size, size=16).tolist()
+    results: list = []
+    errors: list = []
+    try:
+        # warm replica-0 (compile) before anything is measured
+        router.generate(list(prompt), max_new_tokens=2)
+        # measured cold-join: how long a NEW replica takes from factory
+        # to first served token — this becomes the forecast lead time
+        t0 = time.perf_counter()
+        probe_rep = InProcessReplica(
+            "replica-joinprobe", ServingEngine(model, variables,
+                                               ecfg)).start()
+        probe_rep.generate(list(prompt), max_new_tokens=1, rid="joinwarm")
+        cold_join_s = time.perf_counter() - t0
+        probe_rep.drain(2.0)
+        probe_rep.close()
+
+        def factory(name):
+            return InProcessReplica(
+                name, ServingEngine(model, variables, ecfg)).start()
+
+        actuator = FleetActuator(router, factory, drain_grace_s=10.0)
+        forecaster = DemandForecaster(
+            ForecastConfig(alpha=0.5, beta=0.3, max_trend_frac=0.05,
+                           mean_tau_s=2.0))
+        # capacity deliberately conservative (tokens admitted per
+        # second one replica should carry): the ramp crosses the
+        # scale-up threshold while replica-0 still serves comfortably,
+        # which is the whole point of predictive — grow BEFORE burn
+        tokens_per_req = len(prompt) + 4
+        capacity_tps = 8.0 * tokens_per_req
+        scaler = PredictiveAutoscaler(
+            forecaster, capacity_tps,
+            config=AutoscaleConfig(
+                interval_s=0.2, min_replicas=1, max_replicas=2,
+                target_util=0.7, lead_time_s=cold_join_s,
+                down_delay_s=1.5))
+        demand = CounterDemand(router.admitted_tokens, forecaster,
+                               window_s=2.0)
+        stop = threading.Event()
+        threads: list = []
+
+        def fire():
+            try:
+                results.append(router.generate(list(prompt),
+                                               max_new_tokens=4))
+            except Exception as err:  # noqa: BLE001 - counted, asserted
+                errors.append(err)
+
+        def ramp():
+            # request rate ramps 2/s -> 12/s over ~8s: the token
+            # demand the forecaster must see coming
+            t_start = time.monotonic()
+            while not stop.is_set():
+                dt = time.monotonic() - t_start
+                rate = min(12.0, 2.0 + 1.25 * dt)
+                th = threading.Thread(target=fire)
+                th.start()
+                threads.append(th)
+                stop.wait(1.0 / rate)
+
+        ramper = threading.Thread(target=ramp)
+        ramper.start()
+        scale_up_s = -1.0
+        burn_at_scale_up = float("inf")
+        t_ramp0 = time.perf_counter()
+        deadline = t_ramp0 + 60.0
+        while time.perf_counter() < deadline:
+            demand.tick()
+            cur = actuator.replicas()
+            target = scaler.decide(cur)
+            if target > cur:
+                # the gate: the forecast-driven grow must land while
+                # the fast-burn alert is still quiet on every engine
+                spec = router.replicas[0].engine.slo.spec
+                burn_at_scale_up = max(
+                    rep.engine.slo.burn_rate(w)
+                    for rep in router.replicas
+                    for w in spec.fast_windows)
+                actuator.scale_to(target)
+                scale_up_s = time.perf_counter() - t_ramp0
+                break
+            time.sleep(0.2)
+        stop.set()
+        ramper.join(timeout=10)
+        for th in threads:
+            th.join(timeout=CHILD_TIMEOUT_S)
+        assert scale_up_s >= 0, (
+            "the forecasted ramp never triggered a scale-up within 60s")
+        assert burn_at_scale_up < FAST_BURN, (
+            f"fast-burn alert ({burn_at_scale_up:.1f} >= {FAST_BURN}) "
+            "was already firing when the autoscaler grew the fleet — "
+            "predictive scaling arrived late")
+        assert len(router.replicas) == 2
+        assert not errors, f"{len(errors)} requests failed: {errors[:3]}"
+        # forecast collapse: demand is now zero; the down-delay lapses
+        # and the controller drain-shrinks back to 1
+        shrink_deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < shrink_deadline:
+            demand.tick()
+            cur = actuator.replicas()
+            target = scaler.decide(cur)
+            if target < cur:
+                actuator.scale_to(target)
+                break
+            time.sleep(0.2)
+        assert len(router.replicas) == 1, (
+            "forecast collapse never shrank the fleet within 30s")
+        assert actuator.lost_streams == 0, (
+            f"scale-down lost {actuator.lost_streams} streams — drain "
+            "must absorb every in-flight request")
+        ok = sum(1 for r in results if r.get("tokens"))
+        assert ok == len(results), (
+            f"only {ok}/{len(results)} ramp requests returned tokens")
+    finally:
+        for rep in router.replicas:
+            rep.close()
+
+    print(json.dumps({
+        "sim_requests": sim["trace"]["requests"],
+        "sim_distinct_users": sim["trace"]["distinct_users"],
+        "sim_duration_s": sim["trace"]["duration_s"],
+        "sim_wall_s": round(sim["wall_s"], 2),
+        "sim_predictive_attainment": round(pred["attainment"], 5),
+        "sim_reactive_attainment": round(react["attainment"], 5),
+        "sim_predictive_replica_hours": round(pred["replica_hours"], 2),
+        "sim_reactive_replica_hours": round(react["replica_hours"], 2),
+        "sim_predictive_p95_ttft_s": round(pred["p95_ttft_s"], 3),
+        "sim_reactive_p95_ttft_s": round(react["p95_ttft_s"], 3),
+        "live_cold_join_s": round(cold_join_s, 2),
+        "live_scale_up_s": round(scale_up_s, 2),
+        "live_burn_at_scale_up": round(burn_at_scale_up, 2),
+        "fast_burn_limit": FAST_BURN,
+        "live_requests_ok": len(results),
+        "live_lost_streams": actuator.lost_streams,
+        "slo_window_scale": float(
+            os.environ.get("M2KT_SLO_WINDOW_SCALE", "1") or "1"),
+    }), flush=True)
+    return 0
+
+
 def bench_chaos(n: int) -> dict:
     """Serving-fleet fault-tolerance phase on forced host devices: a
     zipfian replay through the router while a chaos injector kills one
@@ -3035,7 +3313,8 @@ def run_child(phases: list[str]) -> int:
            "fleet": bench_fleet, "quant": bench_quant,
            "kernels": bench_kernels, "obs": bench_obs,
            "chaos": bench_chaos, "swap": bench_swap,
-           "numerics": bench_numerics, "sched": bench_sched}
+           "numerics": bench_numerics, "sched": bench_sched,
+           "autoscale": bench_autoscale}
     ok = True
     for phase in phases:
         try:
@@ -3375,6 +3654,10 @@ def main() -> int:
                         help="internal: priority-preemption drill + "
                              "multi-LoRA batch gates (spawned by the "
                              "sched phase)")
+    parser.add_argument("--autoscale-probe", action="store_true",
+                        help="internal: million-user simulator gate + "
+                             "live predictive scale-up smoke (spawned "
+                             "by the autoscale phase)")
     parser.add_argument("--swap-boot-probe", action="store_true",
                         help="internal: one cold replica boot to first "
                              "token (spawned by the swap probe; "
@@ -3402,6 +3685,8 @@ def main() -> int:
         return run_numerics_probe()
     if args.sched_probe:
         return run_sched_probe()
+    if args.autoscale_probe:
+        return run_autoscale_probe()
     if args.child:
         return run_child(args.child.split(","))
     if args.opportunistic:

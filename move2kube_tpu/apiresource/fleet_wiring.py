@@ -46,6 +46,13 @@ ROLE_LABEL = "move2kube-tpu.io/role"
 ROUTER_ROLE = "router"
 PREFILL_ROLE = "prefill"
 DECODE_ROLE = "decode"
+# the predictive-autoscaler controller (serving/fleet/autoscaler.py):
+# one CPU-only pod next to the router, scraping its admitted-token
+# counters and exporting the m2kt_autoscale_* gauges. When this role is
+# emitted the reactive per-role HPAs are NOT — two controllers writing
+# the same Deployment's replica count would fight (dueling-controller
+# guard, asserted by tests/test_autoscale.py).
+AUTOSCALER_ROLE = "autoscaler"
 
 # gauges exported by the serving engine (serving/engine.py) that the
 # per-role HPAs target; names asserted by tests/test_fleet.py
@@ -192,6 +199,47 @@ def fleet_knobs(svc_name: str) -> dict | None:
              "M2KT_FLEET_AFFINITY_SALT"],
             "") or "")
     counts["salt"] = salt
+    # predictive autoscaling: off by default (the reactive HPAs keep
+    # working untouched); on, the controller Deployment replaces them
+    raw = os.environ.get("M2KT_AUTOSCALE", "")
+    if raw in ("0", "1"):
+        counts["autoscale"] = raw == "1"
+    else:
+        counts["autoscale"] = qa.fetch_bool(
+            f"m2kt.services.{name}.serve.fleet.autoscale",
+            f"Enable predictive autoscaling for [{name}]'s fleet?",
+            ["Emits a forecast-driven controller Deployment (demand "
+             "forecast over the router's admitted-token counters, "
+             "scale-up lead = cold-join time, drain-based scale-down) "
+             "INSTEAD of the per-role reactive HPAs; override via "
+             "M2KT_AUTOSCALE"],
+            False)
+    if counts["autoscale"]:
+        for key, env_var, qid, desc, default in (
+            ("autoscalelead", "M2KT_AUTOSCALE_LEAD_S",
+             "serve.fleet.autoscale.lead",
+             "Scale-up lead time (seconds) for [{name}] — the forecast "
+             "horizon, sized to the measured replica cold-join time",
+             "120"),
+            ("autoscalemax", "M2KT_AUTOSCALE_MAX",
+             "serve.fleet.autoscale.max",
+             "Predictive autoscaler replica ceiling for [{name}]", "8"),
+            ("autoscaleutil", "M2KT_AUTOSCALE_TARGET_UTIL",
+             "serve.fleet.autoscale.util",
+             "Target utilization (0..1) forecast demand may fill of "
+             "[{name}]'s capacity", "0.7"),
+        ):
+            raw = os.environ.get(env_var, "")
+            if not raw:
+                raw = str(qa.fetch_input(
+                    f"m2kt.services.{name}.{qid}", desc.format(name=name),
+                    [f"override via {env_var}"], default) or default)
+            try:
+                counts[key] = max(0.0, float(raw))
+            except ValueError:
+                log.warning("bad %s %r for %s; using %s", qid, raw, name,
+                            default)
+                counts[key] = float(default)
     return counts
 
 
@@ -228,6 +276,28 @@ def role_service(svc: Service, role: str, knobs: dict) -> Service:
     port = _serving_port(svc)
     for c in clone.containers:
         _set_env(c, "M2KT_FLEET_ROLE", role)
+        if role == AUTOSCALER_ROLE:
+            # the controller scrapes the router's counters through the
+            # front Service (the router serves /metrics on the traffic
+            # port) and targets the decode Deployment's scale
+            _set_env(c, "M2KT_AUTOSCALE", "1")
+            _set_env(c, "M2KT_AUTOSCALE_METRICS_URL",
+                     f"http://{svc.name}:{port}/metrics")
+            _set_env(c, "M2KT_AUTOSCALE_TARGET",
+                     f"{svc.name}-{DECODE_ROLE}")
+            _set_env(c, "M2KT_AUTOSCALE_LEAD_S",
+                     f"{knobs.get('autoscalelead', 120.0):g}")
+            _set_env(c, "M2KT_AUTOSCALE_MAX",
+                     f"{int(knobs.get('autoscalemax', 8))}")
+            _set_env(c, "M2KT_AUTOSCALE_TARGET_UTIL",
+                     f"{knobs.get('autoscaleutil', 0.7):g}")
+            _set_env(c, "M2KT_AUTOSCALE_MIN",
+                     f"{max(1, int(knobs.get('decode', 1)))}")
+            c.get("resources", {}).get("limits", {}).pop(
+                "google.com/tpu", None)
+            c.get("resources", {}).get("requests", {}).pop(
+                "google.com/tpu", None)
+            continue
         if role == ROUTER_ROLE:
             _set_env(c, "M2KT_ROUTER_BACKENDS",
                      f"{svc.name}-{DECODE_ROLE}:{port}")
@@ -254,7 +324,7 @@ def role_service(svc: Service, role: str, knobs: dict) -> Service:
             if wport > 0:
                 _set_env(c, "M2KT_WEIGHTS_PEERS",
                          f"{svc.name}-{DECODE_ROLE}:{wport}")
-    if role == ROUTER_ROLE:
+    if role in (ROUTER_ROLE, AUTOSCALER_ROLE):
         clone.accelerator = None
         clone.node_selector = {
             k: v for k, v in clone.node_selector.items()
@@ -264,7 +334,8 @@ def role_service(svc: Service, role: str, knobs: dict) -> Service:
             if t.get("key") != "google.com/tpu"]
     replicas = {ROUTER_ROLE: knobs.get("routers", 1),
                 PREFILL_ROLE: knobs.get("prefill", 0),
-                DECODE_ROLE: knobs.get("decode", 2)}[role]
+                DECODE_ROLE: knobs.get("decode", 2),
+                AUTOSCALER_ROLE: 1}[role]
     clone.replicas = max(1, int(replicas))
     return clone
 
@@ -465,8 +536,22 @@ def maybe_fleet_objects(deployer, svc: Service,
             objs.append(role_headless_service(
                 svc, role, SELECTOR_LABEL, port,
                 weights_port=int(knobs.get("weightsport", 0) or 0)))
-        objs.append(role_hpa(svc, role, clone.replicas))
+        if not knobs.get("autoscale"):
+            # dueling-controller guard: with the predictive controller
+            # on, the reactive HPAs are suppressed — two writers on one
+            # Deployment's replica count oscillate against each other
+            objs.append(role_hpa(svc, role, clone.replicas))
         objs.append(role_pdb(svc, role, selector, min_available))
+    if knobs.get("autoscale"):
+        clone = role_service(svc, AUTOSCALER_ROLE, knobs)
+        labels = {SELECTOR_LABEL: clone.name, ROLE_LABEL: AUTOSCALER_ROLE,
+                  **svc.labels}
+        dep = deployer._create_deployment(clone, labels)
+        dep["spec"]["selector"] = {"matchLabels": {
+            SELECTOR_LABEL: clone.name, ROLE_LABEL: AUTOSCALER_ROLE}}
+        objs.append(dep)
     log.info("%s: fleet mode — %d objects across roles (%s)", svc.name,
-             len(objs), ", ".join(fleet_roles(knobs)))
+             len(objs), ", ".join(
+                 fleet_roles(knobs)
+                 + ([AUTOSCALER_ROLE] if knobs.get("autoscale") else [])))
     return objs

@@ -124,10 +124,16 @@ class KnativeServiceAPIResource(APIResource):
                 # it scales on the engine gauges instead of concurrency
                 for role in fleet_wiring.fleet_roles(knobs):
                     clone = fleet_wiring.role_service(svc, role, knobs)
-                    objs.append(self._knative_service(
-                        clone,
-                        fleet_wiring.knative_autoscaling_annotations(
-                            role, clone.replicas)))
+                    if knobs.get("autoscale"):
+                        # dueling-controller guard (same as the HPA
+                        # path): the predictive controller owns the
+                        # replica count, so pin minScale only
+                        ann = {"autoscaling.knative.dev/minScale":
+                               str(max(1, int(clone.replicas)))}
+                    else:
+                        ann = fleet_wiring.knative_autoscaling_annotations(
+                            role, clone.replicas)
+                    objs.append(self._knative_service(clone, ann))
             else:
                 objs.append(self._knative_service(svc, None))
             # alert rules + dashboard ride along with the knative Service
@@ -178,6 +184,13 @@ class KnativeServiceAPIResource(APIResource):
                 "autoscaling.knative.dev/target": str(concurrency),
             })
         if autoscale_annotations:
+            # a fleet-role override REPLACES the concurrency KPA
+            # defaults — under the predictive controller the only
+            # annotation left is the minScale floor, so the revision
+            # autoscaler never duels the controller on replica count
+            for k in ("autoscaling.knative.dev/metric",
+                      "autoscaling.knative.dev/target"):
+                tmpl_annotations.pop(k, None)
             tmpl_annotations.update(autoscale_annotations)
         # telemetry-enabled revisions advertise the scrape target —
         # Prometheus scrapes the pod IP directly, so the telemetry

@@ -398,6 +398,27 @@ def _ask_numerics_knobs(name: str, serving: bool) -> dict:
     return knobs
 
 
+def _ask_autoscale_interval(name: str) -> int:
+    """Predictive-autoscaler control-loop period as a QA problem. Only
+    the baked template default — the enable knob, lead time, ceiling
+    and utilization live in ``fleet_wiring.fleet_knobs`` (the
+    ``serve.fleet.autoscale.*`` ids) because they shape the emitted
+    objects, not just the runtime env."""
+    from move2kube_tpu import qa
+
+    raw = qa.fetch_input(
+        f"m2kt.services.{name}.serve.fleet.autoscale.interval",
+        f"Predictive-autoscaler loop period (seconds) for [{name}]",
+        ["How often the controller re-forecasts and re-decides; "
+         "override via M2KT_AUTOSCALE_INTERVAL_S"], "15")
+    try:
+        return max(1, int(raw))
+    except (TypeError, ValueError):
+        log.warning("invalid autoscale.interval answer %r for %s; "
+                    "using 15", raw, name)
+        return 15
+
+
 def _ask_obs_port(name: str) -> int:
     """Telemetry (/metrics) port as a QA problem. Same ID as
     ``passes/optimize.py``'s tpu_observability_optimizer — asked once,
@@ -558,6 +579,7 @@ def emit_container(service: PlanService, plan=None) -> Container:
                     "sched_quotas": sched_knobs["quotas"],
                     "sched_chunk_prefill": sched_knobs["chunkprefill"],
                     "sched_max_loras": sched_knobs["maxloras"],
+                    "autoscale_interval": _ask_autoscale_interval(name),
                     "numerics": numerics_knobs["numerics"],
                     "quant_audit_rate": numerics_knobs["quant_audit_rate"],
                     "compile_cache_dir": "/app/.jax-cache",

@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import math
 import threading
+import time
+from collections import deque
 
 # prometheus_client's default buckets: latency-shaped, seconds
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
@@ -122,6 +124,15 @@ class _Family:
     def _render_child(self, out, values, child) -> None:
         raise NotImplementedError
 
+    def total(self) -> float:
+        """Sum of every child's scalar value across label sets — the
+        PromQL ``sum(family)`` a single process can answer directly.
+        Counters and gauges only; families whose children carry no
+        scalar ``value`` (histograms) contribute 0."""
+        with self._lock:
+            return float(sum(getattr(c, "value", 0.0)
+                             for c in self._children.values()))
+
 
 class _Value:
     __slots__ = ("value",)
@@ -221,6 +232,9 @@ class Histogram(_Family):
     def quantile(self, q: float) -> float:
         return self._default_child().quantile(q)
 
+    def snapshot(self) -> "HistogramSnapshot":
+        return self._default_child().snapshot()
+
     @property
     def count(self) -> int:
         return self._default_child().count
@@ -284,6 +298,165 @@ class HistogramChild:
                     frac = (rank - prev_cum) / self.bucket_counts[i]
                     return lo + (edge - lo) * min(1.0, max(0.0, frac))
             return 0.0
+
+    def snapshot(self) -> "HistogramSnapshot":
+        """Consistent point-in-time copy of this child's state, safe to
+        read (or sample from) without holding the registry lock."""
+        with self._lock:
+            return HistogramSnapshot(self.buckets,
+                                     tuple(self.bucket_counts),
+                                     self.sum, self.count)
+
+
+class HistogramSnapshot:
+    """Immutable copy of one histogram's buckets — the engine's own
+    latency distributions handed to consumers that must not race the
+    serving hot path: the fleet simulator samples per-phase service
+    times from these via :meth:`sample`, and offline analysis reads
+    :meth:`quantile` without touching the live registry."""
+
+    __slots__ = ("buckets", "bucket_counts", "sum", "count")
+
+    def __init__(self, buckets: tuple[float, ...],
+                 bucket_counts: tuple[int, ...],
+                 sum_: float, count: int) -> None:
+        self.buckets = tuple(buckets)
+        self.bucket_counts = tuple(bucket_counts)
+        self.sum = float(sum_)
+        self.count = int(count)
+
+    def quantile(self, q: float) -> float:
+        """Same interpolation as the live child (Prometheus
+        ``histogram_quantile`` semantics), off the frozen counts."""
+        total = self.count
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cumulative = 0
+        for i, edge in enumerate(self.buckets):
+            prev_cum = cumulative
+            cumulative += self.bucket_counts[i]
+            if cumulative >= rank and self.bucket_counts[i]:
+                if edge == math.inf:
+                    finite = [e for e in self.buckets if e != math.inf]
+                    return finite[-1] if finite else 0.0
+                lo = self.buckets[i - 1] if i else 0.0
+                frac = (rank - prev_cum) / self.bucket_counts[i]
+                return lo + (edge - lo) * min(1.0, max(0.0, frac))
+        return 0.0
+
+    def sample(self, u: float) -> float:
+        """Inverse-CDF draw: map a uniform ``u`` in [0, 1) to a value
+        distributed like the recorded observations (linear within each
+        bucket; the +Inf bucket clamps to the last finite edge). Feed it
+        seeded uniforms and a million draws replay the engine's own
+        latency shape deterministically."""
+        return self.quantile(min(1.0, max(0.0, float(u))))
+
+
+class TimedWindow:
+    """Bounded, thread-safe deque of ``(t, item)`` samples with horizon
+    pruning and trailing-window queries — the one owner of the sliding-
+    window math the SLO tracker, the demand forecaster's rate sampler,
+    and anything else windowing a timeline kept re-implementing.
+
+    ``clock`` is injectable (synthetic timelines in tests and the fleet
+    simulator); the horizon and the item cap both prune on append, so a
+    flood can never grow the window without bound."""
+
+    def __init__(self, horizon_s: float, max_items: int = 65536,
+                 clock=time.monotonic) -> None:
+        self.horizon_s = float(horizon_s)
+        self.max_items = max(1, int(max_items))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._items: deque[tuple[float, object]] = deque()
+
+    def append(self, item, t: float | None = None) -> float:
+        """Record one sample (at ``t``, default now); returns the
+        timestamp used."""
+        now = self._clock() if t is None else float(t)
+        with self._lock:
+            self._items.append((now, item))
+            floor = now - self.horizon_s
+            while self._items and (len(self._items) > self.max_items
+                                   or self._items[0][0] < floor):
+                self._items.popleft()
+        return now
+
+    def window(self, window_s: float,
+               now: float | None = None) -> list:
+        """Items whose timestamp falls inside the trailing window."""
+        if now is None:
+            now = self._clock()
+        floor = now - float(window_s)
+        with self._lock:
+            return [item for t, item in self._items if t >= floor]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class WindowRate:
+    """Windowed per-second rate over a monotone counter reading.
+
+    ``read`` is any zero-arg callable returning the counter's current
+    value (e.g. ``family.total``); :meth:`sample` records one
+    ``(t, value)`` observation and :meth:`rate` differences the newest
+    sample against the last sample at or before the window floor, so
+    the rate covers the whole window instead of whatever sub-span two
+    in-window samples happen to straddle. This is the counter
+    ``rate(window_s)`` the forecaster consumes — callers stop keeping
+    their own (t, value) deques."""
+
+    def __init__(self, read, clock=time.monotonic,
+                 horizon_s: float = 7200.0,
+                 max_samples: int = 4096) -> None:
+        self._read = read
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._samples: deque[tuple[float, float]] = deque()
+        self.horizon_s = float(horizon_s)
+        self.max_samples = max(2, int(max_samples))
+
+    def sample(self, t: float | None = None,
+               value: float | None = None) -> tuple[float, float]:
+        """Record one observation (``value`` defaults to ``read()``,
+        ``t`` to now); returns the ``(t, value)`` pair recorded."""
+        now = self._clock() if t is None else float(t)
+        val = float(self._read() if value is None else value)
+        with self._lock:
+            self._samples.append((now, val))
+            floor = now - self.horizon_s
+            # keep ONE sample below the floor as the differencing base
+            while (len(self._samples) > self.max_samples
+                   or (len(self._samples) > 2
+                       and self._samples[1][0] <= floor)):
+                self._samples.popleft()
+        return now, val
+
+    def rate(self, window_s: float, now: float | None = None) -> float:
+        """Per-second rate over the trailing window; 0.0 with fewer
+        than two samples. A counter that stepped backwards (a correction
+        outpacing admissions) clamps to 0 — a demand rate is never
+        negative."""
+        if now is None:
+            now = self._clock()
+        floor = now - float(window_s)
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            t1, v1 = self._samples[-1]
+            base = self._samples[0]
+            for t, v in self._samples:
+                if t > floor:
+                    break
+                base = (t, v)
+            t0, v0 = base
+        if t1 <= t0:
+            return 0.0
+        return max(0.0, (v1 - v0) / (t1 - t0))
 
 
 class Registry:

@@ -280,6 +280,32 @@ def prometheus_rule(name: str, selector_label: str,
             },
         })
         rules.append({
+            "alert": "M2KTAutoscaleActuationStalled",
+            # the predictive controller wants capacity it is not
+            # getting: target held above actual for 10m means scale
+            # patches are failing (RBAC, quota) or new pods cannot
+            # schedule (no TPU nodes) — either way the forecasted
+            # demand will land on a fleet that never grew. No threshold
+            # knob: any sustained gap is wrong (M2KTNonFiniteSteps
+            # precedent).
+            "expr": (f"m2kt_autoscale_target_replicas{sel} "
+                     f"> m2kt_autoscale_actual_replicas{sel}"),
+            "for": "10m",
+            "labels": {"severity": "warning", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: predictive autoscaler cannot "
+                           "actuate",
+                "description": (
+                    "The autoscaler's target replica count has stayed "
+                    "above what the fleet actually runs. Check the "
+                    "controller pod's logs for scale-subresource patch "
+                    "failures (RBAC), the decode Deployment's events "
+                    "for unschedulable pods (TPU node pool at quota), "
+                    "and m2kt_autoscale_forecast_tps for whether the "
+                    "demand it is provisioning for is real."),
+            },
+        })
+        rules.append({
             "alert": "M2KTSLOTenantTTFTHigh",
             "expr": (f"m2kt_slo_tenant_ttft_p95_seconds{sel} "
                      f"> {th['tpuslottftp95']}"),
@@ -414,6 +440,20 @@ def grafana_dashboard(name: str, selector_label: str,
             23, "Chunked prefill rate by reason",
             f"sum(rate(m2kt_sched_chunked_total{sel}[5m])) by (reason)",
             0, 88))
+        # autoscaling row (serving/fleet/autoscaler.py): the
+        # controller's plan vs what the fleet actually runs (the
+        # ActuationStalled alert is the gap between these two lines),
+        # and its forecast vs the admitted-token demand it predicts —
+        # a forecast tracking above demand by more than the lead
+        # time's trend is over-provisioning money away
+        panels.append(_panel(
+            24, "Autoscale target vs actual replicas",
+            f"m2kt_autoscale_target_replicas{sel} "
+            f"or m2kt_autoscale_actual_replicas{sel}", 12, 88))
+        panels.append(_panel(
+            25, "Forecast vs admitted token demand (tok/s)",
+            f"m2kt_autoscale_forecast_tps{sel} or sum(rate("
+            f"m2kt_router_admitted_tokens_total{sel}[5m]))", 0, 96))
     return {
         "title": f"move2kube-tpu: {name}",
         "uid": f"m2kt-{name}",

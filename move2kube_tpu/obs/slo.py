@@ -30,12 +30,10 @@ Stdlib-only: vendored into emitted images with the rest of ``obs/``.
 from __future__ import annotations
 
 import os
-import threading
 import time
-from collections import deque
 from dataclasses import dataclass
 
-from move2kube_tpu.obs.metrics import OVERFLOW_LABEL, Registry
+from move2kube_tpu.obs.metrics import OVERFLOW_LABEL, Registry, TimedWindow
 
 TTFT_P95_ENV = "M2KT_SLO_TTFT_P95_S"
 TOKEN_P95_ENV = "M2KT_SLO_TOKEN_P95_S"
@@ -156,12 +154,14 @@ class SLOTracker:
                  tenant_cap: int | None = None) -> None:
         self.spec = spec or SLOSpec.from_env()
         self._clock = clock
-        self._lock = threading.Lock()
-        # (t, tenant, good, ttft_s or None)
-        self._events: deque[tuple[float, str, bool, float | None]] = deque()
-        self._max_events = max(1, int(max_events))
         self._horizon = max(self.spec.fast_windows[0],
                             self.spec.slow_windows[0])
+        # (t, tenant, good, ttft_s or None) events; the shared
+        # TimedWindow (obs/metrics.py) owns horizon/cap pruning and the
+        # trailing-window queries — same math the demand forecaster uses
+        self._events = TimedWindow(self._horizon,
+                                   max_items=max(1, int(max_events)),
+                                   clock=clock)
         self.tenant_cap = tenant_cap if tenant_cap is not None else (
             max_tenants())
         self._registry = registry
@@ -225,22 +225,18 @@ class SLOTracker:
         """Record one request outcome; returns its good/bad verdict."""
         good = self.judge(ok, ttft_s, token_s)
         now = self._clock()
-        with self._lock:
-            self._events.append((now, clean_tenant(tenant), good, ttft_s))
-            floor = now - self._horizon
-            while self._events and (len(self._events) > self._max_events
-                                    or self._events[0][0] < floor):
-                self._events.popleft()
+        self._events.append((now, clean_tenant(tenant), good, ttft_s),
+                            t=now)
         return good
 
     # -- windows -----------------------------------------------------------
 
     def _window(self, window_s: float,
                 tenant: str | None = None) -> list[tuple]:
-        floor = self._clock() - window_s
-        with self._lock:
-            return [e for e in self._events
-                    if e[0] >= floor and (tenant is None or e[1] == tenant)]
+        events = self._events.window(window_s)
+        if tenant is None:
+            return events
+        return [e for e in events if e[1] == tenant]
 
     def attainment(self, window_s: float | None = None,
                    tenant: str | None = None) -> float:

@@ -290,6 +290,19 @@ def tpu_fleet_optimizer(ir: IR) -> IR:
         ]
         if knobs.get("salt"):
             entries.append(("M2KT_FLEET_AFFINITY_SALT", str(knobs["salt"])))
+        # predictive autoscaling: baked so the autoscaler-role pod and
+        # the fleet_wiring HPA-suppression guard read the same answer
+        entries.append(("M2KT_AUTOSCALE",
+                        "1" if knobs.get("autoscale") else "0"))
+        if knobs.get("autoscale"):
+            entries.extend([
+                ("M2KT_AUTOSCALE_LEAD_S",
+                 f"{knobs.get('autoscalelead', 120.0):g}"),
+                ("M2KT_AUTOSCALE_MAX",
+                 str(int(knobs.get("autoscalemax", 8)))),
+                ("M2KT_AUTOSCALE_TARGET_UTIL",
+                 f"{knobs.get('autoscaleutil', 0.7):g}"),
+            ])
         for container in svc.containers:
             env = container.setdefault("env", [])
             existing = {e.get("name") for e in env}

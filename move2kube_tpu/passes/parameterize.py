@@ -130,7 +130,14 @@ def tpu_fleet_parameterizer(ir: IR) -> IR:
               "M2KT_FLEET_MIN_AVAILABLE": "tpufleetminavailable",
               # weight plane (P2P streaming + live swap)
               "M2KT_FLEET_SWAP": "tpufleetswap",
-              "M2KT_WEIGHTS_PORT": "tpufleetweightsport"}
+              "M2KT_WEIGHTS_PORT": "tpufleetweightsport",
+              # predictive autoscaling (serving/fleet/autoscaler.py):
+              # retune the forecast lead / ceiling / utilization per
+              # environment with --set tpufleetautoscale*
+              "M2KT_AUTOSCALE": "tpufleetautoscale",
+              "M2KT_AUTOSCALE_LEAD_S": "tpufleetautoscalelead",
+              "M2KT_AUTOSCALE_MAX": "tpufleetautoscalemax",
+              "M2KT_AUTOSCALE_TARGET_UTIL": "tpufleetautoscaleutil"}
     for svc in ir.services.values():
         acc = getattr(svc, "accelerator", None)
         if acc is None or not getattr(acc, "serving", False):

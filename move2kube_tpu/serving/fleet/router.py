@@ -49,7 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from move2kube_tpu.obs import tracing
 from move2kube_tpu.obs.metrics import Registry
-from move2kube_tpu.obs.slo import TENANT_HEADER, clean_tenant
+from move2kube_tpu.obs.slo import TENANT_HEADER, clean_tenant, max_tenants
 from move2kube_tpu.obs.tracing import TRACEPARENT_HEADER
 from move2kube_tpu.serving.engine import (
     DeadlineExceeded,
@@ -690,6 +690,23 @@ class Router:
             "m2kt_router_swap_total",
             "Live weight-swap fan-out, by per-replica outcome",
             labels=("outcome",))
+        # demand attribution in TOKENS, not requests: prompt + max_new
+        # estimated at admission (the forecaster needs the demand the
+        # moment it is admitted, not after generation finishes), then
+        # corrected at completion — over-estimates land in the paired
+        # unused counter because a Prometheus counter cannot go down.
+        # Net demand = admitted - unused (admitted_tokens()).
+        tenant_cap = max_tenants() + 1
+        self._admitted_tokens = reg.counter(
+            "m2kt_router_admitted_tokens_total",
+            "Admitted demand in tokens by tenant (prompt + max_new at "
+            "admission, under-estimates topped up at completion)",
+            labels=("tenant",), max_series=tenant_cap)
+        self._admitted_unused = reg.counter(
+            "m2kt_router_admitted_tokens_unused_total",
+            "Admission-estimate tokens the completion did not use "
+            "(early EOS / shed) — subtract from admitted for net demand",
+            labels=("tenant",), max_series=tenant_cap)
         # optional pull source for POST /swap with no inline tree:
         # a callable returning (variables, version)
         self.weight_source = None
@@ -819,6 +836,14 @@ class Router:
             parent=root, detached=True)
         return span, span.traceparent()
 
+    def admitted_tokens(self) -> float:
+        """Net admitted token demand across every tenant: the admission
+        estimates minus the completion corrections. Monotone except for
+        the moment a correction lands, which a windowed rate absorbs —
+        this is the counter the demand forecaster differences."""
+        return (self._admitted_tokens.total()
+                - self._admitted_unused.total())
+
     def generate(self, prompt, max_new_tokens: int | None = None,
                  rid: str | None = None, tenant: str = "",
                  traceparent: str | None = None,
@@ -834,6 +859,12 @@ class Router:
         except SchedThrottled:
             self._requests.labels(outcome="throttled").inc()
             raise
+        # token-demand attribution at admission: the forecaster reads
+        # this rate, so it must move when demand ARRIVES, not when the
+        # decode finishes minutes later
+        est_tokens = len(prompt) + int(
+            max_new_tokens or EngineConfig.max_new_tokens)
+        self._admitted_tokens.labels(tenant=tenant).inc(est_tokens)
         self._inflight.inc()
         # ONE absolute deadline per request (caller's X-M2KT-Deadline
         # remainder, else the configured default): the disagg attempt,
@@ -853,6 +884,7 @@ class Router:
                 attrs={"prompt_len": len(prompt), "tenant": tenant},
                 detached=True, remote_parent=traceparent)
         try:
+            out = None
             if (self.config.disagg_threshold
                     and len(prompt) >= self.config.disagg_threshold
                     and self.prefill_replicas):
@@ -860,15 +892,23 @@ class Router:
                     out = self._generate_disagg(prompt, max_new_tokens,
                                                 rid, tenant, root,
                                                 deadline)
-                    self._requests.labels(outcome="ok").inc()
-                    return out
                 except DeadlineExceeded:
                     raise  # no budget left for the direct fallback either
                 except Exception:  # noqa: BLE001 - fall back to direct path
-                    pass
-            out = self._generate_direct(prompt, max_new_tokens, rid,
-                                        tenant, root, deadline,
-                                        adapter=adapter)
+                    out = None
+            if out is None:
+                out = self._generate_direct(prompt, max_new_tokens, rid,
+                                            tenant, root, deadline,
+                                            adapter=adapter)
+            # completion correction: top up an under-estimate, park an
+            # over-estimate (early EOS) in the unused counter
+            actual = len(prompt) + len(out.get("tokens", ()))
+            if actual > est_tokens:
+                self._admitted_tokens.labels(tenant=tenant).inc(
+                    actual - est_tokens)
+            elif actual < est_tokens:
+                self._admitted_unused.labels(tenant=tenant).inc(
+                    est_tokens - actual)
             self._requests.labels(outcome="ok").inc()
             return out
         except Exception as err:

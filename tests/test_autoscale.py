@@ -1,0 +1,532 @@
+"""Predictive autoscaler plane: forecaster goldens, controller
+hysteresis, the discrete-event fleet simulator (determinism,
+replica-hours accounting, predictive-vs-reactive gate), drain-based
+in-process actuation, the emitted controller Deployment wiring and the
+dueling-controller guard (autoscale on => no reactive HPAs).
+
+No jax anywhere: the router is exercised with fake replica handles and
+the simulator never executes a model, which keeps this file inside the
+tier-1 CPU budget."""
+
+import math
+
+import numpy as np
+import pytest
+
+from move2kube_tpu.obs.metrics import Registry, WindowRate
+from move2kube_tpu.serving.fleet.autoscaler import (
+    AutoscaleConfig, FleetActuator, PredictiveAutoscaler,
+    capacity_from_cost_report, parse_counter_total, replica_capacity_tps,
+    run_controller)
+from move2kube_tpu.serving.fleet.forecast import (
+    CounterDemand, DemandForecaster, ForecastConfig)
+
+DAY = 86400.0
+
+
+# ----------------------------------------------------------------------
+# forecaster
+# ----------------------------------------------------------------------
+
+def _diurnal(t, base=1000.0, amp=0.6, peak_h=14.0):
+    return base * (1.0 + amp * math.cos(
+        2.0 * math.pi * (t / DAY - peak_h / 24.0)))
+
+
+def test_forecaster_empty_and_first_observation():
+    f = DemandForecaster(clock=lambda: 0.0)
+    assert f.forecast(600.0) == 0.0
+    f.observe(500.0, t=0.0)
+    assert f.forecast(0.0, now=0.0) == pytest.approx(500.0, rel=0.35)
+
+
+def test_forecaster_diurnal_beats_persistence():
+    # golden: after one day of warmup on a clean diurnal signal, the
+    # seasonal field must price tomorrow's curve into a 1h-ahead
+    # forecast better than "demand stays what it is now"
+    f = DemandForecaster(ForecastConfig(), clock=lambda: 0.0, epoch=0.0)
+    step, horizon = 1800.0, 3600.0
+    t = 0.0
+    while t < DAY:                      # day 1: warmup
+        f.observe(_diurnal(t), t=t)
+        t += step
+    err_fc, err_persist = [], []
+    while t < 2 * DAY - horizon:        # day 2: score
+        now_tps = _diurnal(t)
+        f.observe(now_tps, t=t)
+        truth = _diurnal(t + horizon)
+        err_fc.append(abs(f.forecast(horizon, now=t) - truth))
+        err_persist.append(abs(now_tps - truth))
+        t += step
+    assert float(np.mean(err_fc)) < 0.5 * float(np.mean(err_persist))
+
+
+def test_forecaster_trend_extrapolates_ramp():
+    # a ramp must project forward, not lag one smoothing constant; the
+    # clamp is opened and the reference mean sped up so the test
+    # isolates the trend term itself
+    f = DemandForecaster(ForecastConfig(max_trend_frac=1.0,
+                                        mean_tau_s=500.0),
+                         clock=lambda: 0.0, epoch=0.0)
+    for i in range(200):
+        f.observe(100.0 + 2.0 * i, t=10.0 * i)   # +0.2 tok/s per second
+    now = 10.0 * 199
+    flat, ahead = f.forecast(0.0, now=now), f.forecast(300.0, now=now)
+    assert ahead > flat
+    assert ahead - flat == pytest.approx(f.trend * 300.0, rel=1e-6)
+    assert f.trend == pytest.approx(0.2, rel=0.25)
+
+
+def test_forecaster_trend_clamp_bounds_burst():
+    f = DemandForecaster(ForecastConfig(max_trend_frac=0.01),
+                         clock=lambda: 0.0, epoch=0.0)
+    f.observe(100.0, t=0.0)
+    f.observe(100000.0, t=1.0)          # one absurd burst sample
+    assert abs(f.trend) <= abs(f.level) * 0.01 + 1e-9
+
+
+def test_window_rate_and_counter_demand_fake_clock():
+    now = {"t": 0.0}
+    val = {"v": 0.0}
+    wr = WindowRate(lambda: val["v"], clock=lambda: now["t"])
+    assert wr.rate(60.0, now=0.0) == 0.0          # <2 samples
+    for t, v in ((0.0, 0.0), (30.0, 300.0), (60.0, 600.0)):
+        now["t"], val["v"] = t, v
+        wr.sample()
+    assert wr.rate(60.0, now=60.0) == pytest.approx(10.0)
+    # counter stepping backwards (completion correction) clamps to 0
+    now["t"], val["v"] = 90.0, 200.0
+    wr.sample()
+    assert wr.rate(30.0, now=90.0) == 0.0
+    # CounterDemand feeds the same windowed rate into the forecaster
+    f = DemandForecaster(clock=lambda: now["t"], epoch=0.0)
+    cd = CounterDemand(lambda: val["v"], f, clock=lambda: now["t"],
+                       window_s=60.0)
+    for t, v in ((100.0, 0.0), (130.0, 600.0), (160.0, 1200.0)):
+        now["t"], val["v"] = t, v
+        tps = cd.tick()
+    assert tps == pytest.approx(20.0)
+    assert f.observations == 3
+
+
+# ----------------------------------------------------------------------
+# controller hysteresis
+# ----------------------------------------------------------------------
+
+class _ScriptedForecaster:
+    """Stands in for DemandForecaster: forecast() replays a preset."""
+
+    def __init__(self, tps=0.0):
+        self.tps = tps
+        self.observations = 1
+
+    def forecast(self, horizon_s=0.0, now=None):
+        return self.tps
+
+
+def _scaler(tps, **cfg):
+    fc = _ScriptedForecaster(tps)
+    defaults = dict(interval_s=1.0, min_replicas=1, max_replicas=8,
+                    target_util=0.7, lead_time_s=60.0, down_delay_s=30.0)
+    defaults.update(cfg)
+    return fc, PredictiveAutoscaler(
+        fc, 100.0, config=AutoscaleConfig(**defaults),
+        clock=lambda: 0.0, registry=Registry())
+
+
+def test_hysteresis_up_immediate_down_delayed_one_step():
+    fc, sc = _scaler(70.0)              # 70 tok/s / (100*0.7) -> 1
+    assert sc.decide(1, now=0.0) == 1
+    fc.tps = 350.0                      # -> ceil(350/70) = 5, up NOW
+    assert sc.decide(1, now=1.0) == 5
+    fc.tps = 70.0                       # target 1 < 5: wait out delay
+    assert sc.decide(5, now=2.0) == 5
+    assert sc.decide(5, now=20.0) == 5
+    assert sc.decide(5, now=32.5) == 4  # 30s held low -> ONE step down
+    # timer re-armed: the next step needs another full delay window
+    assert sc.decide(4, now=33.0) == 4
+    assert sc.decide(4, now=62.0) == 4
+    assert sc.decide(4, now=63.0) == 3
+
+
+def test_hysteresis_blip_resets_down_timer():
+    fc, sc = _scaler(70.0)
+    assert sc.decide(4, now=0.0) == 4   # target 1, timer starts
+    assert sc.decide(4, now=25.0) == 4
+    fc.tps = 300.0                      # blip back up to target 5
+    assert sc.decide(4, now=26.0) == 5
+    fc.tps = 70.0
+    assert sc.decide(5, now=27.0) == 5  # timer restarted at 27
+    assert sc.decide(5, now=50.0) == 5  # 23s < 30s: still holding
+    assert sc.decide(5, now=57.5) == 4
+
+
+def test_never_thrash_on_noisy_boundary():
+    # demand noisy around exactly one-replica capacity: the controller
+    # may step between the two adjacent sizes but must never jump
+    rng = np.random.default_rng(3)
+    fc, sc = _scaler(70.0, down_delay_s=10.0)
+    cur, sizes = 1, []
+    for i in range(400):
+        fc.tps = float(max(0.0, rng.normal(70.0, 10.0)))
+        new = sc.decide(cur, now=float(i))
+        assert abs(new - cur) <= 1 or new == sc.desired(now=float(i))
+        cur = new
+        sizes.append(cur)
+    assert set(sizes) <= {1, 2}
+
+
+def test_autoscale_config_env_tolerant(monkeypatch):
+    monkeypatch.setenv("M2KT_AUTOSCALE_MAX", "not-a-number")
+    monkeypatch.setenv("M2KT_AUTOSCALE_TARGET_UTIL", "0.5")
+    monkeypatch.setenv("M2KT_AUTOSCALE_LEAD_S", "")
+    cfg = AutoscaleConfig.from_env()
+    assert cfg.max_replicas == 8        # warn + default, never crash
+    assert cfg.target_util == 0.5
+    assert cfg.lead_time_s == 120.0
+
+
+def test_replica_capacity_sources(monkeypatch):
+    class _Eng:
+        def stats(self):
+            return {"decode_throughput_tokens_s": 42.0}
+
+    assert replica_capacity_tps(default=7.0) == 7.0
+    assert replica_capacity_tps(engine=_Eng(), default=7.0) == 42.0
+    monkeypatch.setenv("M2KT_AUTOSCALE_REPLICA_TPS", "99")
+    assert replica_capacity_tps(engine=_Eng(), default=7.0) == 99.0
+
+
+def test_capacity_from_cost_report_roofline():
+    class _Report:
+        flops = 2.0e12
+        bytes_accessed = 1.0e12
+
+    class _Spec:
+        peak_bf16_flops = 2.0e14          # compute: 10ms
+        hbm_bandwidth = 1.0e12            # memory: 1s  <- binding
+    tps = capacity_from_cost_report(_Report(), _Spec(), 256.0)
+    assert tps == pytest.approx(256.0)    # 256 tokens / 1s step
+    # degraded report (CPU backends): None, caller falls back
+
+    class _Empty:
+        flops = 0
+        bytes_accessed = 0
+    assert capacity_from_cost_report(_Empty(), _Spec(), 256.0) is None
+
+
+# ----------------------------------------------------------------------
+# discrete-event simulator
+# ----------------------------------------------------------------------
+
+def _small_trace(seed=0, requests=60_000):
+    from move2kube_tpu.serving.fleet.sim import (
+        LatencyModel, Trace, TraceConfig)
+    cfg = TraceConfig(requests_total=requests, user_pool=500_000,
+                      seed=seed)
+    return Trace(cfg, LatencyModel.synthetic())
+
+
+def test_sim_deterministic_under_fixed_seed():
+    from move2kube_tpu.serving.fleet.sim import (
+        FleetConfig, ReactiveHPAPolicy, simulate)
+    fleet = FleetConfig()
+    a = simulate(_small_trace(seed=5), fleet, ReactiveHPAPolicy(fleet))
+    b = simulate(_small_trace(seed=5), fleet, ReactiveHPAPolicy(fleet))
+    da, db = a.to_dict(), b.to_dict()
+    da.pop("wall_s"), db.pop("wall_s")
+    assert da == db                       # bit-equal, not approximately
+    c = simulate(_small_trace(seed=6), fleet, ReactiveHPAPolicy(fleet))
+    assert c.attainment != a.attainment or c.requests != a.requests
+
+
+def test_sim_replica_hours_static_policy_exact():
+    from move2kube_tpu.serving.fleet.sim import FleetConfig, simulate
+
+    class _Static:
+        name = "static"
+        interval_s = 60.0
+
+        def decide(self, now, busy, active, provisioned, tps):
+            return provisioned            # never scales
+
+    fleet = FleetConfig(initial_replicas=6, min_replicas=6)
+    res = simulate(_small_trace(), fleet, _Static())
+    # no scale events => billing integral is exactly replicas * duration
+    assert res.scale_events == 0
+    assert res.replica_hours == pytest.approx(6 * DAY / 3600.0)
+    assert res.mean_replicas == pytest.approx(6.0)
+    assert res.peak_replicas == 6
+
+
+def test_sim_trace_shape_and_tenants():
+    tr = _small_trace()
+    assert tr.n > 0 and tr.distinct_users > 0
+    assert np.all(np.diff(tr.arrival_s) >= 0)        # sorted arrivals
+    assert tr.tokens_per_tick.sum() == pytest.approx(tr.tokens.sum())
+    counts = np.bincount(tr.tenant, minlength=tr.cfg.tenants)
+    assert np.all(np.diff(counts) <= 0) or counts[0] == counts.max()
+
+
+def test_sim_gate_predictive_beats_reactive_at_scale():
+    # the bench acceptance gate itself: full 24h default trace, >1M
+    # distinct users, both policies on the SAME trace, inside the CI
+    # wall budget, predictive wins BOTH axes, zero lost streams
+    from move2kube_tpu.serving.fleet.sim import compare_policies
+    out = compare_policies()
+    assert out["trace"]["duration_s"] >= DAY
+    assert out["trace"]["distinct_users"] >= 1_000_000
+    assert out["wall_s"] < 60.0
+    assert out["reactive"]["lost_streams"] == 0
+    assert out["predictive"]["lost_streams"] == 0
+    assert out["predictive_wins"], (
+        f"predictive attainment={out['predictive']['attainment']:.4f} "
+        f"hours={out['predictive']['replica_hours']:.1f} vs reactive "
+        f"attainment={out['reactive']['attainment']:.4f} "
+        f"hours={out['reactive']['replica_hours']:.1f}")
+    assert out["predictive"]["per_tenant_attainment"]   # zipf attribution
+
+
+def test_sim_histogram_snapshot_sampler():
+    from move2kube_tpu.serving.fleet.sim import _snapshot_sampler
+    reg = Registry()
+    h = reg.histogram("t_lat", "", buckets=(0.1, 0.2, 0.4, 0.8))
+    rng0 = np.random.default_rng(0)
+    for v in rng0.uniform(0.05, 0.35, 2000):
+        h.observe(float(v))
+    sample = _snapshot_sampler(h.snapshot())
+    draws = sample(4000, np.random.default_rng(1))
+    assert draws.shape == (4000,)
+    assert float(draws.max()) <= 0.8 + 1e-9          # +Inf clamped
+    assert abs(float(draws.mean()) - 0.2) < 0.05     # shape replayed
+    # empty histogram degrades to zeros, not a crash
+    empty = reg.histogram("t_empty", "", buckets=(1.0,))
+    assert _snapshot_sampler(empty.snapshot())(8, rng0).sum() == 0.0
+
+
+# ----------------------------------------------------------------------
+# in-process actuation: drain-based scale-down
+# ----------------------------------------------------------------------
+
+class _FakeReplica:
+    def __init__(self, name, tokens=(7, 8), drain_clean=True):
+        self.name = name
+        self._tokens = list(tokens)
+        self._drain_clean = drain_clean
+        self.drained = False
+        self.closed = False
+
+    def queue_depth(self):
+        return 0.0
+
+    def generate(self, prompt, max_new_tokens=None, rid=None, **kw):
+        return {"tokens": list(self._tokens), "text": "", "rid": rid}
+
+    def drain(self, grace_s):
+        self.drained = True
+        return self._drain_clean
+
+    def close(self):
+        self.closed = True
+
+
+def _fake_router(n=1, **replica_kw):
+    from move2kube_tpu.serving.fleet.router import Router, RouterConfig
+    reps = [_FakeReplica(f"replica-{i}", **replica_kw) for i in range(n)]
+    return Router(reps, RouterConfig(deadline_s=None), registry=Registry())
+
+
+def test_fleet_actuator_scale_up_down_zero_lost_streams():
+    router = _fake_router(1)
+    actuator = FleetActuator(router, _FakeReplica, drain_grace_s=5.0)
+    assert actuator.scale_to(3) == 3
+    assert [r.name for r in router.replicas] == \
+        ["replica-0", "replica-1", "replica-2"]
+    assert all(router._up[r.name] for r in router.replicas)
+    old = list(router.replicas)
+    assert actuator.scale_to(1) == 1
+    assert actuator.lost_streams == 0
+    # the shrunk tail went through mark-down -> drain -> close
+    for r in old[1:]:
+        assert r.drained and r.closed
+        assert r.name not in router._up
+    # requests still route on the survivor
+    assert router.generate([1, 2, 3], max_new_tokens=4)["tokens"] == [7, 8]
+
+
+def test_fleet_actuator_counts_unclean_drains():
+    router = _fake_router(2, drain_clean=False)
+    actuator = FleetActuator(router, _FakeReplica, drain_grace_s=0.1)
+    actuator.scale_to(1)
+    assert actuator.lost_streams == 1    # evidence, and still closed
+    assert len(router.replicas) == 1
+
+
+def test_router_admitted_tokens_estimate_and_correction():
+    router = _fake_router(1)             # fake replica emits 2 tokens
+    out = router.generate([1, 2, 3, 4], max_new_tokens=8, tenant="acme")
+    assert out["tokens"] == [7, 8]
+    # admission estimated 4+8=12; completion corrected 6 into unused;
+    # net demand = prompt + actual decode = 6
+    assert router._admitted_tokens.total() == 12.0
+    assert router._admitted_unused.total() == 6.0
+    assert router.admitted_tokens() == 6.0
+
+
+# ----------------------------------------------------------------------
+# emitted controller loop
+# ----------------------------------------------------------------------
+
+def test_parse_counter_total_sums_label_sets():
+    text = "\n".join((
+        "# HELP m2kt_router_admitted_tokens_total demand",
+        "# TYPE m2kt_router_admitted_tokens_total counter",
+        'm2kt_router_admitted_tokens_total{tenant="a"} 120',
+        'm2kt_router_admitted_tokens_total{tenant="b"} 30.5',
+        "m2kt_router_admitted_tokens_totally_not 999",
+        "m2kt_other_metric 5",
+        "m2kt_router_admitted_tokens_total 9",
+        "garbage line",
+    ))
+    assert parse_counter_total(
+        text, "m2kt_router_admitted_tokens_total") == pytest.approx(159.5)
+    assert parse_counter_total(text, "m2kt_missing") == 0.0
+
+
+def test_run_controller_shadow_mode(monkeypatch):
+    import move2kube_tpu.serving.fleet.autoscaler as mod
+    monkeypatch.setenv("M2KT_AUTOSCALE_METRICS_URL", "http://x/metrics")
+    monkeypatch.setenv("M2KT_AUTOSCALE_INTERVAL_S", "30")
+    monkeypatch.setenv("M2KT_AUTOSCALE_REPLICA_TPS", "100")
+    monkeypatch.setenv("M2KT_AUTOSCALE_LEAD_S", "0")
+    now = {"t": 0.0}
+    counter = {"v": 0.0}
+
+    def fake_scrape(url, timeout_s=5.0):
+        assert url == "http://x/metrics"
+        counter["v"] += 30.0 * 700.0     # 700 tok/s sustained
+        return counter["v"]
+
+    def fake_sleep(s):
+        now["t"] += s
+
+    monkeypatch.setattr(mod, "scrape_admitted_tokens", fake_scrape)
+    reg = Registry()
+    last = run_controller(loops=12, registry=reg,
+                          clock=lambda: now["t"], sleep=fake_sleep)
+    # 700 tok/s over 100*0.7 usable tok/s per replica wants 10, the
+    # default ceiling clamps to 8 — tracked in shadow mode (no
+    # actuator) and exported as gauges
+    assert last == 8
+    page = reg.render()
+    assert "m2kt_autoscale_target_replicas 8" in page
+    assert "m2kt_autoscale_forecast_tps" in page
+
+
+def test_run_controller_requires_metrics_url(monkeypatch):
+    monkeypatch.delenv("M2KT_AUTOSCALE_METRICS_URL", raising=False)
+    with pytest.raises(SystemExit):
+        run_controller(loops=1, registry=Registry())
+
+
+# ----------------------------------------------------------------------
+# emission: dueling-controller guard + Helm lift
+# ----------------------------------------------------------------------
+
+from tests.test_fleet import _fleet_env, _serving_ir  # noqa: E402
+
+
+def test_emission_autoscale_suppresses_hpas(monkeypatch):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    _fleet_env(monkeypatch)
+    monkeypatch.setenv("M2KT_AUTOSCALE", "1")
+    objs = DeploymentAPIResource().create_new_resources(
+        _serving_ir()[0], {"Deployment", "JobSet"})
+    by = {(o["kind"], o["metadata"]["name"]): o for o in objs}
+    # dueling-controller guard: the predictive controller owns the
+    # replica counts, so NO reactive HPA may be emitted for any role
+    assert not [k for k in by if k[0] == "HorizontalPodAutoscaler"]
+    ctrl = by[("Deployment", "llm-autoscaler")]
+    assert ctrl["spec"]["replicas"] == 1
+    c = ctrl["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["M2KT_FLEET_ROLE"] == "autoscaler"
+    assert env["M2KT_AUTOSCALE"] == "1"
+    assert env["M2KT_AUTOSCALE_METRICS_URL"] == "http://llm:8080/metrics"
+    assert env["M2KT_AUTOSCALE_TARGET"] == "llm-decode"
+    assert env["M2KT_AUTOSCALE_MIN"] == "3"     # decode floor
+    assert env["M2KT_AUTOSCALE_LEAD_S"] == "120"
+    assert env["M2KT_AUTOSCALE_MAX"] == "8"
+    assert env["M2KT_AUTOSCALE_TARGET_UTIL"] == "0.7"
+    # the controller is a stdlib-HTTP pod: it must never request TPU
+    assert "google.com/tpu" not in c.get("resources", {}).get("limits", {})
+    # serving roles are still emitted; default path still has HPAs
+    assert ("Deployment", "llm-decode") in by
+
+
+def test_emission_autoscale_off_keeps_hpas(monkeypatch):
+    from move2kube_tpu.apiresource.deployment import DeploymentAPIResource
+
+    _fleet_env(monkeypatch)
+    monkeypatch.setenv("M2KT_AUTOSCALE", "0")
+    objs = DeploymentAPIResource().create_new_resources(
+        _serving_ir()[0], {"Deployment", "JobSet"})
+    names = {(o["kind"], o["metadata"]["name"]) for o in objs}
+    assert ("HorizontalPodAutoscaler", "llm-decode") in names
+    assert ("Deployment", "llm-autoscaler") not in names
+
+
+def test_emission_knative_autoscale_minscale_only(monkeypatch):
+    from move2kube_tpu.apiresource.knative import KnativeServiceAPIResource
+
+    _fleet_env(monkeypatch)
+    monkeypatch.setenv("M2KT_AUTOSCALE", "1")
+    objs = KnativeServiceAPIResource(create=True).create_new_resources(
+        _serving_ir()[0], {"Service"})
+    kn = {o["metadata"]["name"]: o for o in objs if o["kind"] == "Service"}
+    ann = kn["llm-decode"]["spec"]["template"]["metadata"]["annotations"]
+    # guard on the Knative path: KPA metric targets are dropped, only
+    # the floor is pinned — the predictive controller does the rest
+    assert ann["autoscaling.knative.dev/minScale"] == "3"
+    assert "autoscaling.knative.dev/metric" not in ann
+    assert "autoscaling.knative.dev/class" not in ann
+
+
+def test_autoscale_optimizer_and_helm_round_trip(monkeypatch):
+    from move2kube_tpu.passes.optimize import tpu_fleet_optimizer
+    from move2kube_tpu.passes.parameterize import tpu_fleet_parameterizer
+
+    _fleet_env(monkeypatch)
+    monkeypatch.setenv("M2KT_AUTOSCALE", "1")
+    monkeypatch.setenv("M2KT_AUTOSCALE_LEAD_S", "90")
+    monkeypatch.setenv("M2KT_AUTOSCALE_MAX", "12")
+    ir, svc = _serving_ir()
+    ir = tpu_fleet_optimizer(ir)
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_AUTOSCALE"] == "1"
+    assert env["M2KT_AUTOSCALE_LEAD_S"] == "90"
+    assert env["M2KT_AUTOSCALE_MAX"] == "12"
+    ir = tpu_fleet_parameterizer(ir)
+    gv = ir.values.global_variables
+    assert gv["tpufleetautoscale"] == "1"
+    assert gv["tpufleetautoscalelead"] == "90"
+    assert gv["tpufleetautoscalemax"] == "12"
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_AUTOSCALE"] == "{{ .Values.tpufleetautoscale }}"
+    assert env["M2KT_AUTOSCALE_LEAD_S"] == \
+        "{{ .Values.tpufleetautoscalelead }}"
+    # idempotent: a second lift does not double-wrap
+    ir = tpu_fleet_parameterizer(ir)
+    env = {e["name"]: e["value"] for e in svc.containers[0]["env"]}
+    assert env["M2KT_AUTOSCALE_MAX"] == "{{ .Values.tpufleetautoscalemax }}"
+
+
+def test_autoscaler_vendored_into_emitted_images():
+    from move2kube_tpu.containerizer.jax_emit import _vendor_package
+    from move2kube_tpu.types.ir import Container
+
+    c = Container()
+    _vendor_package(c)
+    for mod in ("autoscaler", "forecast"):
+        assert f"move2kube_tpu/serving/fleet/{mod}.py" in c.new_files
