@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke trainkernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke sched-smoke autoscale-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke trainkernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke sched-smoke autoscale-smoke asyncserve-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -219,6 +219,13 @@ sched-smoke:
 autoscale-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_autoscale.py -q
 	$(CPU_ENV) $(PY) bench.py --model autoscale
+
+# async decode pipeline (PR 19): token-exactness + lag-1 journal tests,
+# then the interleaved async-vs-sync bench gate (async must win and the
+# dispatch gap must shrink)
+asyncserve-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_async.py -q
+	$(CPU_ENV) $(PY) bench.py --model serving
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

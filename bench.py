@@ -962,14 +962,16 @@ def run_scaling_probe() -> int:
 def bench_serving(n: int) -> dict:
     """Continuous-batching decode throughput on forced host devices: a
     16-request mixed-length stream through the paged-KV ServingEngine
-    (serving/engine.py) on the tiny llama. Reports decode tokens/s plus
-    p50/p95 per-token step latency and the compiled-executable count (the
-    engine's shape discipline bounds it by num_buckets + 2). CPU host
-    numbers are only comparable across rounds of this repo — the phase
-    guards that the prefill-bucketing + slot-recycling machinery holds its
-    compile bound and throughput doesn't collapse. Own subprocess for the
-    same reason as the scaling phase: the probe must own jax's platform
-    env before import, independent of this child's backend."""
+    (serving/engine.py) on the tiny llama, run through BOTH the async
+    double-buffered pipeline (substeps=4) and the synchronous reference
+    loop, interleaved round by round so CPU load drift can't invert the
+    comparison. The phase FAILS unless async ≥ sync tok/s, the dispatch
+    gap shrinks, the greedy streams are byte-identical, the async chaos
+    drill journals exactly N tokens, and the compiled-executable count
+    holds the num_buckets + 2 budget. CPU host numbers are only
+    comparable across rounds of this repo. Own subprocess for the same
+    reason as the scaling phase: the probe must own jax's platform env
+    before import, independent of this child's backend."""
     import subprocess
 
     env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
@@ -987,8 +989,40 @@ def bench_serving(n: int) -> dict:
             f"serving probe rc={res.returncode}: {res.stderr[-300:]}")
     probe = json.loads(res.stdout.strip().splitlines()[-1])
     dt = time.perf_counter() - t0
-    print(f"[bench] serving {probe['decode_throughput_tokens_s']:.1f} tok/s "
-          f"(p50 {probe['decode_p50_latency_ms']:.2f}ms, "
+    # async-pipeline gates (PR 19): the interleaved capture must show the
+    # overlap paying for itself, token streams must be byte-identical to
+    # the synchronous reference, and the chaos drill's journal must hold
+    # exactly N tokens — a faster pipeline that drops or invents tokens
+    # is a regression, not a data point
+    if not probe["compile_bound_ok"]:
+        raise RuntimeError(
+            f"serving: {probe['total_executables']} executables for "
+            f"{probe['num_buckets']} buckets breaks the num_buckets+2 "
+            "budget under async decode")
+    if probe["token_exact_fraction"] < 1.0:
+        raise RuntimeError(
+            f"serving: async-vs-sync token exactness "
+            f"{probe['token_exact_fraction']:.3f} < 1.0")
+    if not probe["chaos_exact"]:
+        raise RuntimeError(
+            f"serving: async chaos drill journaled "
+            f"{probe['chaos_journal_tokens']} tokens, expected the "
+            "kill point exactly")
+    if probe["async_tokens_s"] < probe["sync_tokens_s"]:
+        raise RuntimeError(
+            f"serving: async {probe['async_tokens_s']} tok/s did not "
+            f"beat sync {probe['sync_tokens_s']} tok/s on the "
+            "interleaved capture")
+    if probe["dispatch_gap_async_s"] >= probe["dispatch_gap_sync_s"]:
+        raise RuntimeError(
+            f"serving: async dispatch gap {probe['dispatch_gap_async_s']}s "
+            f"did not shrink vs sync {probe['dispatch_gap_sync_s']}s")
+    print(f"[bench] serving async {probe['async_tokens_s']:.1f} vs sync "
+          f"{probe['sync_tokens_s']:.1f} tok/s "
+          f"(x{probe['async_speedup']:.2f} interleaved, gap "
+          f"{probe['dispatch_gap_async_s']:.3f}s vs "
+          f"{probe['dispatch_gap_sync_s']:.3f}s, "
+          f"p50 {probe['decode_p50_latency_ms']:.2f}ms, "
           f"p95 {probe['decode_p95_latency_ms']:.2f}ms, "
           f"{probe['total_executables']} executables for "
           f"{probe['num_buckets']} buckets) in {dt:.1f}s", file=sys.stderr)
@@ -996,7 +1030,7 @@ def bench_serving(n: int) -> dict:
     # no published baseline: host-CPU decode throughput of a toy model is
     # not a literature number — only cross-round comparable
     return {"phase": "serving", "metric": metric,
-            "value": probe["decode_throughput_tokens_s"], "unit": unit,
+            "value": probe["async_tokens_s"], "unit": unit,
             "vs_baseline": 0.0, "baseline": "none_published",
             "decode_p50_latency_ms": probe["decode_p50_latency_ms"],
             "decode_p95_latency_ms": probe["decode_p95_latency_ms"],
@@ -1005,13 +1039,26 @@ def bench_serving(n: int) -> dict:
             "num_buckets": probe["num_buckets"],
             "total_executables": probe["total_executables"],
             "compile_bound_ok": probe["compile_bound_ok"],
+            "async_tokens_s": probe["async_tokens_s"],
+            "sync_tokens_s": probe["sync_tokens_s"],
+            "async_speedup": probe["async_speedup"],
+            "dispatch_gap_async_s": probe["dispatch_gap_async_s"],
+            "dispatch_gap_sync_s": probe["dispatch_gap_sync_s"],
+            "token_exact_fraction": probe["token_exact_fraction"],
+            "chaos_exact": probe["chaos_exact"],
+            "host_overhead_ratio": probe.get("host_overhead_ratio"),
             "wall_s": round(dt, 2)}
 
 
 def run_serving_probe() -> int:
     """In-process half of the serving phase (spawned by bench_serving with
     jax forced onto host devices). Drives the continuous-batching engine
-    over a mixed-length 16-request stream and prints one JSON line."""
+    over a mixed-length 16-request stream twice over — an async
+    double-buffered pipeline (substeps=4) and the synchronous reference
+    loop — INTERLEAVED round by round (the PR-10 lesson: sequential
+    measurement lets CPU load drift invert results), plus a chaos drill
+    (kill at token N under async) proving the journal hook still sees
+    exactly N tokens. Prints one JSON line."""
     import dataclasses
 
     import jax
@@ -1029,31 +1076,95 @@ def run_serving_probe() -> int:
     model = Llama(cfg)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, 8), jnp.int32))
-    engine = ServingEngine(model, variables, EngineConfig(
-        max_batch=4, max_seq=64, block_size=8, buckets=(8, 16, 32),
-        max_new_tokens=8))
+
+    # decode-heavy shape (24 generated tokens per request): the serving
+    # regime the pipeline exists for — a prefill-dominated stream hides
+    # the decode loop the phase is gating
+    def build(async_mode: str, substeps: int) -> ServingEngine:
+        return ServingEngine(model, variables, EngineConfig(
+            max_batch=4, max_seq=128, block_size=8, buckets=(8, 16, 32),
+            max_new_tokens=24, async_decode=async_mode, substeps=substeps))
+
     # mixed prompt lengths spanning all three buckets; enough requests
     # that slots recycle mid-flight (16 requests through 4 slots)
     lengths = [3, 7, 12, 20, 30, 5, 16, 25, 9, 31, 4, 14, 22, 6, 28, 11]
-    rng = np.random.default_rng(0)
-    requests = [
-        Request(rid=f"r{i}",
-                prompt=rng.integers(1, cfg.vocab_size, size=n).tolist())
-        for i, n in enumerate(lengths)]
-    completions = engine.run(requests)
-    assert len(completions) == len(requests), (
-        f"{len(completions)}/{len(requests)} requests completed")
-    stats = engine.stats()
-    report = engine.compile_report()
+
+    def make_requests() -> list:
+        rng = np.random.default_rng(0)
+        return [
+            Request(rid=f"r{i}",
+                    prompt=rng.integers(1, cfg.vocab_size, size=n).tolist())
+            for i, n in enumerate(lengths)]
+
+    engines = {"async": build("on", 4), "sync": build("off", 1)}
+    # warmup pass per mode: compiles + first-touch costs, uncounted —
+    # and the token-exactness capture (greedy fp32 streams must match
+    # byte for byte between the pipelines)
+    streams: dict = {}
+    for mode, eng in engines.items():
+        comps = eng.run(make_requests())
+        assert len(comps) == len(lengths), (
+            f"{mode}: {len(comps)}/{len(lengths)} requests completed")
+        streams[mode] = {c.rid: list(c.tokens) for c in comps}
+    exact = sum(1 for rid in streams["sync"]
+                if streams["async"].get(rid) == streams["sync"][rid])
+    token_exact_fraction = exact / len(streams["sync"])
+
+    totals = {"async": [0.0, 0], "sync": [0.0, 0]}  # wall_s, tokens
+    rounds = 4
+    for r in range(rounds):
+        order = ("async", "sync") if r % 2 == 0 else ("sync", "async")
+        for mode in order:
+            t0 = time.perf_counter()
+            comps = engines[mode].run(make_requests())
+            wall = time.perf_counter() - t0
+            totals[mode][0] += wall
+            totals[mode][1] += sum(len(c.tokens) for c in comps)
+    async_tps = totals["async"][1] / max(totals["async"][0], 1e-9)
+    sync_tps = totals["sync"][1] / max(totals["sync"][0], 1e-9)
+
+    # chaos drill on a fresh async engine: the journal hook raises on
+    # its Nth token (PR-13 kill-at-token-N). Lag-1 must never have
+    # journaled a token the host hadn't consumed — exactly N survive.
+    kill_at = 5
+    drill = build("on", 4)
+    journal: list = []
+
+    def _cb(rid, tok):
+        journal.append((rid, tok))
+        if len(journal) == kill_at:
+            raise RuntimeError("chaos: kill at token N")
+
+    drill.on_token = _cb
+    killed = False
+    try:
+        drill.run([Request(rid="drill", prompt=[1, 2, 3, 4, 5])])
+    except RuntimeError:
+        killed = True
+    chaos_exact = bool(killed and len(journal) == kill_at)
+
+    stats = engines["async"].stats()
+    sync_stats = engines["sync"].stats()
+    report = engines["async"].compile_report()
     total = report.get("total_executables", -1)
     print(json.dumps({
         **{k: round(v, 3) if isinstance(v, float) else v
            for k, v in stats.items()},
-        "requests": len(requests),
+        "requests": len(lengths),
         "num_buckets": report["num_buckets"],
         "total_executables": total,
         "compile_bound_ok": bool(
             0 <= total <= report["num_buckets"] + 2),
+        "rounds": rounds,
+        "async_tokens_s": round(async_tps, 2),
+        "sync_tokens_s": round(sync_tps, 2),
+        "async_speedup": round(async_tps / max(sync_tps, 1e-9), 3),
+        "dispatch_gap_async_s": round(stats["dispatch_gap_total_s"], 4),
+        "dispatch_gap_sync_s": round(
+            sync_stats["dispatch_gap_total_s"], 4),
+        "token_exact_fraction": token_exact_fraction,
+        "chaos_exact": chaos_exact,
+        "chaos_journal_tokens": len(journal),
     }), flush=True)
     return 0
 

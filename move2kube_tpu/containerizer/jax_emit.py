@@ -296,6 +296,27 @@ def _ask_serving_knobs(name: str) -> dict:
         log.warning("invalid serve.speck answer %r for %s; using 0",
                     raw, name)
         knobs["spec_k"] = 0
+    raw = qa.fetch_select(
+        f"m2kt.services.{name}.serve.async",
+        f"Select the async decode pipeline mode for [{name}]",
+        ["auto overlaps host-side token consumption with the next "
+         "device decode step whenever spec decoding is off; off "
+         "keeps the synchronous reference loop"],
+        "auto", ["auto", "on", "off"])
+    knobs["async"] = raw if raw in ("auto", "on", "off") else "auto"
+    raw = qa.fetch_input(
+        f"m2kt.services.{name}.serve.substeps",
+        f"Enter the in-graph decode substeps for [{name}]",
+        ["decode micro-steps fused into one dispatch (fori_loop); "
+         "the host touches the device once per N tokens — needs the "
+         "async pipeline, 1 = one token per dispatch"],
+        "1")
+    try:
+        knobs["substeps"] = max(1, int(raw))
+    except (TypeError, ValueError):
+        log.warning("invalid serve.substeps answer %r for %s; using 1",
+                    raw, name)
+        knobs["substeps"] = 1
     return knobs
 
 
@@ -572,6 +593,8 @@ def emit_container(service: PlanService, plan=None) -> Container:
                     "serve_quant": serve_knobs["quant"],
                     "serve_kernels": serve_knobs["kernels"],
                     "spec_k": serve_knobs["spec_k"],
+                    "serve_async": serve_knobs["async"],
+                    "serve_substeps": serve_knobs["substeps"],
                     "slo_ttft_p95": slo_knobs["ttft_p95"],
                     "slo_availability": slo_knobs["availability"],
                     "slo_max_tenants": slo_knobs["max_tenants"],

@@ -454,6 +454,20 @@ def grafana_dashboard(name: str, selector_label: str,
             25, "Forecast vs admitted token demand (tok/s)",
             f"m2kt_autoscale_forecast_tps{sel} or sum(rate("
             f"m2kt_router_admitted_tokens_total{sel}[5m]))", 0, 96))
+        # async-pipeline row (serving/engine.py PR 19): the host gap
+        # between consuming step k and dispatching k+1 — the tax the
+        # double-buffered pipeline exists to erase — and the fraction
+        # of wall time it still eats. Overlap working = gap p95 near
+        # zero and the ratio flat near zero under load.
+        panels.append(_panel(
+            26, "Decode dispatch gap p95",
+            "histogram_quantile(0.95, sum(rate("
+            f"m2kt_serve_dispatch_gap_seconds_bucket{sel}[5m])) by (le))",
+            12, 96, "s"))
+        panels.append(_panel(
+            27, "Host overhead ratio (gap / wall)",
+            f"m2kt_serve_host_overhead_ratio{sel}", 0, 104,
+            "percentunit"))
     return {
         "title": f"move2kube-tpu: {name}",
         "uid": f"m2kt-{name}",

@@ -242,6 +242,26 @@ def tpu_serving_optimizer(ir: IR) -> IR:
             knobs["M2KT_SPEC_K"] = str(max(0, int(raw)))
         except (TypeError, ValueError):
             knobs["M2KT_SPEC_K"] = "0"
+        raw = qa.fetch_select(
+            f"m2kt.services.{name}.serve.async",
+            f"Select the async decode pipeline mode for [{name}]",
+            ["auto overlaps host-side token consumption with the next "
+             "device decode step whenever spec decoding is off; off "
+             "keeps the synchronous reference loop"],
+            "auto", ["auto", "on", "off"])
+        knobs["M2KT_ASYNC_DECODE"] = (
+            raw if raw in ("auto", "on", "off") else "auto")
+        raw = qa.fetch_input(
+            f"m2kt.services.{name}.serve.substeps",
+            f"Enter the in-graph decode substeps for [{name}]",
+            ["decode micro-steps fused into one dispatch (fori_loop); "
+             "the host touches the device once per N tokens — needs the "
+             "async pipeline, 1 = one token per dispatch"],
+            "1")
+        try:
+            knobs["M2KT_DECODE_SUBSTEPS"] = str(max(1, int(raw)))
+        except (TypeError, ValueError):
+            knobs["M2KT_DECODE_SUBSTEPS"] = "1"
         for container in svc.containers:
             env = container.setdefault("env", [])
             existing = {e.get("name") for e in env}

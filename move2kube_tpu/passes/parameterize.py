@@ -81,7 +81,8 @@ def tpu_serving_parameterizer(ir: IR) -> IR:
     """Lift the serving capacity knobs the serving optimizer injected
     (``M2KT_SERVE_MAX_BATCH`` / ``M2KT_SERVE_MAX_SEQ`` /
     ``M2KT_KV_BLOCK_SIZE`` / ``M2KT_SERVE_QUANT`` /
-    ``M2KT_SERVE_KERNELS`` / ``M2KT_SPEC_K``)
+    ``M2KT_SERVE_KERNELS`` / ``M2KT_SPEC_K`` / ``M2KT_ASYNC_DECODE`` /
+    ``M2KT_DECODE_SUBSTEPS``)
     into chart values, so a Helm install resizes the decode batch,
     context length, and KV page size — or flips quantization and
     speculative decoding — per environment
@@ -93,7 +94,9 @@ def tpu_serving_parameterizer(ir: IR) -> IR:
               "M2KT_KV_BLOCK_SIZE": "tpukvblocksize",
               "M2KT_SERVE_QUANT": "tpuservequant",
               "M2KT_SERVE_KERNELS": "tpuservekernels",
-              "M2KT_SPEC_K": "tpuspeck"}
+              "M2KT_SPEC_K": "tpuspeck",
+              "M2KT_ASYNC_DECODE": "tpuserveasync",
+              "M2KT_DECODE_SUBSTEPS": "tpuservesubsteps"}
     for svc in ir.services.values():
         acc = getattr(svc, "accelerator", None)
         if acc is None or not getattr(acc, "serving", False):

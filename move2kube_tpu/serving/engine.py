@@ -54,6 +54,10 @@ Env knobs (docs/USAGE.md):
 - ``M2KT_SCHED_MAX_LORAS``  resident paged LoRA adapter rows
   (serving/sched/lora.py); 0 disables (default 0)
 - ``M2KT_LORA_RANK``        max adapter rank the stacks hold (default 8)
+- ``M2KT_ASYNC_DECODE``     async double-buffered decode pipeline
+  auto|on|off (auto = on whenever spec decode is off; default auto)
+- ``M2KT_DECODE_SUBSTEPS``  in-graph decode micro-steps per dispatch
+  (a fori_loop inside ONE executable; default 1)
 
 Scheduler plane (``serving/sched/``, PR 17): when the tenant spec ranks
 tenants into distinct priority classes, an admission that finds no free
@@ -87,6 +91,25 @@ engine loop, so the target-model executable count stays
 small-model executables, reported separately by ``compile_report``.
 Acceptance is greedy-exact: emitted tokens are always the target's own
 argmax choices, so spec-on and spec-off decode the same token stream.
+
+Async decode pipeline (``async_decode`` != off, PR 19): the decode
+executable feeds its own sampled tokens back as *device-resident*
+operands (tokens and ``seq_lens`` advance in-graph), so the host
+dispatches window k+1 before it has read window k, and consumes window
+k's tokens while the device computes — journaling, stream fan-out,
+TTFT/latency records, admissions, evictions and preemptions all happen
+at a lag-1 window boundary. ``substeps`` > 1 additionally folds N decode
+micro-steps into ONE dispatch (a fori_loop inside the same executable;
+EOS is handled host-side at substep granularity, over-generated rows
+are trimmed and their pages released through the refcounted allocator),
+cutting the host's per-token dispatch tax by N. The multi-step
+executable REPLACES the synchronous decode step — jit is lazy, the
+unused variant never compiles — so the executable budget stays
+``num_buckets + 1``. Spec decoding is host-synchronous by construction
+(greedy-exact acceptance is a host decision) and forces the synchronous
+path. Token streams are bit-identical across sync/async/substeps; the
+async tests and the bench's interleaved async-vs-sync capture gate on
+exactly that.
 """
 
 from __future__ import annotations
@@ -117,6 +140,7 @@ from move2kube_tpu.serving.kvcache import (
     init_cache,
     install_block_table,
     pages_for,
+    sanitized_views,
     scatter_prefill,
     spec_for_model,
 )
@@ -193,6 +217,13 @@ class EngineConfig:
     # max rank the stacked A/B weights hold
     max_loras: int = 0
     lora_rank: int = 8
+    # async decode pipeline (PR 19): "auto" engages whenever spec decode
+    # is off, "on" insists (warns and falls back when spec decode wins),
+    # "off" keeps the synchronous reference loop. substeps folds N
+    # decode micro-steps into one dispatched executable (1 = one
+    # token per dispatch)
+    async_decode: str = "auto"
+    substeps: int = 1
 
     def resolved_buckets(self) -> tuple[int, ...]:
         buckets = self.buckets or _default_buckets(self.max_seq)
@@ -243,6 +274,9 @@ class EngineConfig:
                                       cls.chunk_prefill)),
             max_loras=max(0, _int("M2KT_SCHED_MAX_LORAS", cls.max_loras)),
             lora_rank=max(1, _int("M2KT_LORA_RANK", cls.lora_rank)),
+            async_decode=(os.environ.get("M2KT_ASYNC_DECODE", "")
+                          or cls.async_decode),
+            substeps=max(1, _int("M2KT_DECODE_SUBSTEPS", cls.substeps)),
         )
         cfg.update(overrides)
         return cls(**cfg)
@@ -316,6 +350,16 @@ class _Slot:
     priority: int = 1
     adapter_row: int = 0
     chunking: bool = False
+    # async pipeline: True while the slot's next input token lives only
+    # on the device (the feedback carry of the newest dispatched
+    # window) — the host hasn't consumed it yet, so a dispatch must
+    # seed from the carry instead of force-feeding ``last_token``
+    feedback: bool = False
+    # tokens this slot will append once its dispatched, not-yet-consumed
+    # windows land; the dispatcher skips rows whose length budget is
+    # already fully scheduled instead of burning substeps on output the
+    # consume side would only trim
+    inflight_scheduled: int = 0
 
 
 @dataclasses.dataclass
@@ -324,6 +368,23 @@ class _ChunkJob:
     prompt runs per engine step, interleaved with the decode batch."""
     slot_idx: int
     done: int = 0  # prompt tokens already written into the slot's pages
+
+
+@dataclasses.dataclass
+class _Window:
+    """One in-flight async decode dispatch: ``substeps`` micro-steps of
+    generation for the slots captured in ``entries``. ``toks``/``logits``
+    are *unfulfilled* device arrays until :meth:`_consume_window`
+    materializes them — dispatch returns before the device computed
+    anything, which is the whole point."""
+    toks: object    # [max_batch, substeps] int32 device array
+    logits: object  # [max_batch, substeps, vocab]
+    # (slot_idx, rid, keep): outputs j < keep re-fed a cached prompt's
+    # suffix and are discarded, exactly mirroring the synchronous
+    # pending-token rule; rid guards against a slot released (EOS /
+    # preemption) after this window was dispatched at lag-1
+    entries: list
+    t0: float       # dispatch timestamp
 
 
 class ServingEngine:
@@ -413,9 +474,55 @@ class ServingEngine:
                   "disabling chunked prefill", flush=True)
             self.chunk_prefill = 0
         self._chunk_job: _ChunkJob | None = None
+        # ---- async decode pipeline (PR 19) ---------------------------
+        # spec decode is host-synchronous by design (greedy-exact
+        # acceptance is a host decision), so async engages only without
+        # it — "auto" is therefore on for every plain-decode engine
+        self.spec_k = max(0, self.config.spec_k)
+        mode = (self.config.async_decode or "auto").strip().lower()
+        if mode not in ("auto", "on", "off"):
+            print(f"[m2kt] WARNING: M2KT_ASYNC_DECODE={mode!r} is not "
+                  "auto|on|off; using auto", flush=True)
+            mode = "auto"
+        self.async_mode = mode
+        if mode == "on" and self.spec_k:
+            print("[m2kt] WARNING: M2KT_ASYNC_DECODE=on is incompatible "
+                  "with spec decode (M2KT_SPEC_K); running the "
+                  "synchronous loop", flush=True)
+        self.async_decode = mode != "off" and not self.spec_k
+        self.substeps = max(1, int(self.config.substeps))
+        if self.substeps > 1 and not self.async_decode:
+            print("[m2kt] WARNING: M2KT_DECODE_SUBSTEPS>1 needs the "
+                  "async pipeline (M2KT_ASYNC_DECODE != off, spec "
+                  "decode off); running 1 substep", flush=True)
+            self.substeps = 1
+        # capacity slack: a spec verify window or an in-flight async
+        # window pair may write K/V past the point a stream finishes —
+        # async overruns by up to 2*substeps-1 positions (the tail of
+        # the window that emitted EOS plus one whole lag-1 window
+        # already dispatched). Those writes are stale-by-construction
+        # but must land inside the slot's own block table, so every
+        # capacity check reserves the positions like the spec scratch.
+        self._spec_slack = self.spec_k
+        self._async_slack = (2 * self.substeps - 1 if self.async_decode
+                             else 0)
+        self._overrun_slack = self._spec_slack + self._async_slack
+        # double-buffer state: windows dispatched but not yet consumed,
+        # the device-resident feedback token of the newest window, and
+        # completions surfaced by an out-of-step pipeline flush
+        self._inflight: deque[_Window] = deque()
+        self._carry_tok = None
+        self._flush_backlog: list[Completion] = []
+        self._last_consume_done: float | None = None
+        self._gap_total = 0.0
+        self._busy_total = 0.0
         # --------------------------------------------------------------
         self._prefill = self._make_prefill()
-        self._decode = self._make_decode()
+        # the async multi-substep executable REPLACES the synchronous
+        # decode step (jit is lazy — the unused variant never compiles),
+        # keeping the target-model executable budget at num_buckets + 1
+        self._decode = (self._make_decode_multi() if self.async_decode
+                        else self._make_decode())
         self._install, self._copy, self._install_kv = self._make_table_ops()
         self._chunk = (self._make_chunk_prefill()
                        if self.chunk_prefill else None)
@@ -423,9 +530,7 @@ class ServingEngine:
         # sharing the target's embeddings/head) + its own paged cache with
         # IDENTICAL page geometry, so page indices map 1:1 and every
         # allocator/prefix-cache decision covers both caches
-        self.spec_k = max(0, self.config.spec_k)
-        self._spec_slack = self.spec_k  # scratch positions a verify window
-        self._draft_cache = None        # may write past the sequence end
+        self._draft_cache = None
         if self.spec_k:
             draft_cfg = quantlib.draft_config(
                 model.cfg, self.config.spec_draft_factor)
@@ -586,8 +691,25 @@ class ServingEngine:
             "m2kt_weights_version",
             "Weight generation currently installed in the engine")
         self._weights_version_gauge.set(self.weights_version)
+        self._dispatch_gap = reg.histogram(
+            "m2kt_serve_dispatch_gap_seconds",
+            "Host time between consuming decode step k and dispatching "
+            "k+1 (0 when the async pipeline kept the device fed)",
+            buckets=LATENCY_BUCKETS)
+        self._host_overhead = reg.gauge(
+            "m2kt_serve_host_overhead_ratio",
+            "Fraction of serving wall time the device spent starved on "
+            "the host: dispatch gaps / (gaps + device-busy time)")
+        self._inflight_gauge = reg.gauge(
+            "m2kt_serve_inflight_windows",
+            "Async decode windows dispatched but not yet consumed")
         self._total_pages = max(1, self.cache_cfg.num_pages - 1)  # page 0 reserved
+        # /metrics re-renders gauges from the host-side snapshot taken
+        # at the last step-sync point — a tight Prometheus scrape can
+        # never add a host-device sync to the decode hot loop
+        self._gauge_snapshot: dict = {}
         self._update_occupancy()
+        reg.add_collect_hook(self._refresh_gauges)
 
     def _close_ttft(self, rid: str, ttft: float) -> None:
         """Per-tenant side of a TTFT close: the tenant histogram and the
@@ -597,14 +719,39 @@ class ServingEngine:
         self.slo.record(tenant, ok=True, ttft_s=ttft)
 
     def _update_occupancy(self) -> None:
+        """Snapshot the occupancy gauges' inputs at a step-sync point.
+        Everything here is HOST state (slot list, allocator free list,
+        prefix index) — the one rule that keeps /metrics off the device:
+        anything derived from device arrays (seq_lens, the async carry)
+        must be captured into the snapshot HERE, never read at scrape
+        time (:meth:`_refresh_gauges`)."""
         active = sum(1 for s in self._slots if s is not None)
-        self._queue_depth.set(len(self._pending))
-        self._active_slots.set(active)
-        self._slot_occupancy.set(active / max(1, self.config.max_batch))
-        self._page_util.set(
-            1.0 - self._allocator.available / self._total_pages)
+        snap = {
+            "queue_depth": len(self._pending),
+            "active_slots": active,
+            "slot_occupancy": active / max(1, self.config.max_batch),
+            "page_util": 1.0 - self._allocator.available / self._total_pages,
+            "inflight": len(self._inflight),
+        }
         if self._prefix is not None:
-            self._prefix_pages.set(self._prefix.total_pages)
+            snap["prefix_pages"] = self._prefix.total_pages
+        self._gauge_snapshot = snap
+        self._refresh_gauges()
+
+    def _refresh_gauges(self) -> None:
+        """Re-set the occupancy gauges from the cached snapshot — runs
+        as a registry collect hook on every /metrics render, touching
+        nothing but host floats."""
+        snap = self._gauge_snapshot
+        if not snap:
+            return
+        self._queue_depth.set(snap["queue_depth"])
+        self._active_slots.set(snap["active_slots"])
+        self._slot_occupancy.set(snap["slot_occupancy"])
+        self._page_util.set(snap["page_util"])
+        self._inflight_gauge.set(snap["inflight"])
+        if "prefix_pages" in snap:
+            self._prefix_pages.set(snap["prefix_pages"])
 
     # ------------------------------------------------------------------
     # jitted device steps (the ONLY code that runs on the accelerator)
@@ -635,10 +782,8 @@ class ServingEngine:
         @functools.partial(jax.jit, donate_argnums=(1,))
         def decode(variables, cache, tokens, active, *lora):
             # sanitize freed/idle slots: their stale tables must not write
-            # into pages the allocator may have handed to someone else —
-            # redirect them to the reserved null page
-            bt = jnp.where(active[:, None], cache["block_tables"], NULL_PAGE)
-            pos = jnp.where(active, cache["seq_lens"], 0)
+            # into pages the allocator may have handed to someone else
+            bt, pos = sanitized_views(cache, active)
             model_cache = {k: cache[k] for k in PAGE_KEYS if k in cache}
             model_cache["block_tables"] = bt
             model_cache["seq_lens"] = pos + 1
@@ -653,6 +798,68 @@ class ServingEngine:
             return logits, next_tokens, new_cache
 
         return decode
+
+    def _make_decode_multi(self):
+        """The async pipeline's decode executable: ``substeps`` decode
+        micro-steps folded into ONE dispatch by a fori_loop, with the
+        sampled token fed back in-graph — the host touches the device
+        once per N tokens, and never between a window's micro-steps.
+
+        Per-row input selection makes the window token-exact with the
+        synchronous loop: micro-step j consumes ``forced[:, j]`` while
+        ``j < fcount`` (the slot's last token followed by a prefix-hit's
+        still-owed prompt suffix — ground truth, not the model's to
+        choose) and the previous micro-step's argmax after. A slot whose
+        next input only exists on the device (``_Slot.feedback``) seeds
+        from ``seed`` — the carry returned by the PREVIOUS window, still
+        unread by the host when this one is dispatched. ``seq_lens``
+        advances to ``base + substeps`` in-graph for active rows, so the
+        next window can be dispatched before this one is consumed.
+
+        Returns ``(tokens [B, N], logits [B, N, vocab], carry [B],
+        cache)``; the carry is the last micro-step's argmax, the next
+        window's seed."""
+        model, dq, N = self.model, self._dq, self.substeps
+        vocab = model.cfg.vocab_size
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def decode_multi(variables, cache, seed, forced, fcount, active,
+                         *lora):
+            params = dq(variables)
+            bt, base = sanitized_views(cache, active)
+            pages = {k: cache[k] for k in PAGE_KEYS if k in cache}
+            B = seed.shape[0]
+            toks0 = jnp.zeros((B, N), jnp.int32)
+            logits0 = jnp.zeros((B, N, vocab), jnp.float32)
+
+            def body(j, carry):
+                pages, tok, toks_out, logits_out = carry
+                pos = base + j
+                mc = dict(pages)
+                mc["block_tables"] = bt
+                mc["seq_lens"] = pos + 1
+                logits, mc = model.apply(params, tok, positions=pos,
+                                         cache=mc,
+                                         lora=lora if lora else None)
+                pages = {k: mc[k] for k in pages}
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                toks_out = toks_out.at[:, j].set(nxt)
+                logits_out = logits_out.at[:, j].set(
+                    logits.astype(jnp.float32))
+                jn = jnp.minimum(j + 1, N - 1)
+                nxt_in = jnp.where(j + 1 < fcount, forced[:, jn], nxt)
+                return pages, nxt_in.astype(jnp.int32), toks_out, logits_out
+
+            tok0 = jnp.where(fcount > 0, forced[:, 0], seed)
+            pages, carry_tok, toks_out, logits_out = jax.lax.fori_loop(
+                0, N, body, (pages, tok0.astype(jnp.int32), toks0, logits0))
+            new_cache = dict(cache)
+            new_cache.update(pages)
+            new_cache["seq_lens"] = jnp.where(
+                active, base + N, cache["seq_lens"]).astype(jnp.int32)
+            return toks_out, logits_out, carry_tok, new_cache
+
+        return decode_multi
 
     def _make_verify(self):
         """The spec-decode verify step: ``spec_k + 1`` single-token decode
@@ -669,8 +876,7 @@ class ServingEngine:
         @functools.partial(jax.jit, donate_argnums=(1,))
         def verify(variables, cache, tokens, active):
             params = dq(variables)
-            bt = jnp.where(active[:, None], cache["block_tables"], NULL_PAGE)
-            base = jnp.where(active, cache["seq_lens"], 0)
+            bt, base = sanitized_views(cache, active)
             pages = {k: cache[k] for k in PAGE_KEYS if k in cache}
             all_logits = []
             for j in range(W):
@@ -780,9 +986,9 @@ class ServingEngine:
                 raise ValueError(
                     f"{req.rid}: prompt length {plen} exceeds the largest "
                     f"prefill bucket {self.buckets[-1]}")
-            if plen + max_new + self._spec_slack > self.cache_cfg.max_seq:
-                slack = (f" + spec_k {self._spec_slack}"
-                         if self._spec_slack else "")
+            if plen + max_new + self._overrun_slack > self.cache_cfg.max_seq:
+                slack = (f" + overrun slack {self._overrun_slack}"
+                         if self._overrun_slack else "")
                 raise ValueError(
                     f"{req.rid}: prompt + max_new_tokens = {plen + max_new}"
                     f"{slack} exceeds max_seq {self.cache_cfg.max_seq}")
@@ -822,7 +1028,9 @@ class ServingEngine:
                        "tenant": tenant},
                 detached=True, remote_parent=req.traceparent or None)
         self._pending.append(req)
-        self._queue_depth.set(len(self._pending))
+        # refresh the snapshot, not the bare gauge: the /metrics collect
+        # hook re-renders from the snapshot and would clobber a direct set
+        self._update_occupancy()
 
     def _deadline_verdict(self, deadline_s: float | None,
                           max_new: int) -> str | None:
@@ -868,7 +1076,7 @@ class ServingEngine:
             if root is not None:
                 self.tracer.end(root, attrs={"finish_reason": "shed",
                                              "shed_reason": reason})
-        self._queue_depth.set(len(self._pending))
+        self._update_occupancy()
         return Completion(rid=req.rid, prompt_len=len(req.prompt),
                           tokens=[], finish_reason="shed")
 
@@ -878,18 +1086,25 @@ class ServingEngine:
             cb(rid, tok)
 
     def has_work(self) -> bool:
-        return bool(self._pending) or any(
-            s is not None for s in self._slots)
+        return (bool(self._pending) or bool(self._inflight)
+                or bool(self._flush_backlog)
+                or any(s is not None for s in self._slots))
 
     def step(self) -> list[Completion]:
         """One engine iteration: admit pending requests into free slots
         (up to ``admit_burst``; bucketed prefill, or block-table install
         on a prefix-cache hit), then run one decode step for every
         active slot. Returns the sequences that finished this
-        iteration."""
-        finished = self._admit_pending()
+        iteration. Under the async pipeline a step *dispatches* one
+        window and *consumes* the window before it (lag-1), so tokens
+        surface one step after their window was dispatched."""
+        finished = self._flush_backlog
+        self._flush_backlog = []
+        finished.extend(self._admit_pending())
         if self.spec_k:
             return self._spec_step(finished)
+        if self.async_decode:
+            return self._async_step(finished)
         # a chunking slot owns pages and a block table but has no prompt
         # resident yet: it sits out the decode batch until _chunk_step
         # lands its final chunk
@@ -898,16 +1113,29 @@ class ServingEngine:
         if not active_mask.any():
             self._chunk_step(finished)
             self._update_occupancy()
+            self._maybe_reset_gap()
             return finished
         tokens = np.array(
             [s.last_token if s is not None and not s.chunking else 0
              for s in self._slots], np.int32)
         t0 = time.perf_counter()
+        if self._last_consume_done is not None:
+            # the synchronous loop's dispatch gap: every microsecond of
+            # host bookkeeping between reading step k and dispatching
+            # k+1 is device idle time — the async pipeline's baseline
+            gap = max(0.0, t0 - self._last_consume_done)
+            self._dispatch_gap.observe(gap)
+            self._gap_total += gap
         logits, next_tokens, cache = self._decode(
             self.variables, self._cache, tokens, active_mask,
             *self._lora_args())
         next_tokens = np.asarray(next_tokens)  # blocks until ready
         dt = time.perf_counter() - t0
+        self._busy_total += dt
+        self._last_consume_done = t0 + dt
+        denom = self._gap_total + self._busy_total
+        if denom > 0:
+            self._host_overhead.set(self._gap_total / denom)
         self._cache = cache
         # slots still force-feeding a cached prompt's suffix consume the
         # step but produce nothing: their argmax is discarded below
@@ -961,7 +1189,16 @@ class ServingEngine:
                 finished.append(self._release(i, done))
         self._chunk_step(finished)
         self._update_occupancy()
+        self._maybe_reset_gap()
         return finished
+
+    def _maybe_reset_gap(self) -> None:
+        """Restart dispatch-gap accounting when the engine goes idle:
+        the wait for the NEXT request stream is load, not host overhead
+        — without this, inter-stream idle dwarfs the per-step gaps the
+        metric exists to expose."""
+        if not self.has_work():
+            self._last_consume_done = None
 
     def _lora_args(self, rows=None) -> tuple:
         """Extra traced operands for the jitted steps when multi-LoRA is
@@ -975,6 +1212,210 @@ class ServingEngine:
             rows = [s.adapter_row if s is not None else 0
                     for s in self._slots]
         return (a, b, np.asarray(rows, np.int32))
+
+    # ------------------------------------------------------------------
+    # async double-buffered decode pipeline (PR 19)
+    # ------------------------------------------------------------------
+
+    def _async_step(self, finished: list[Completion]) -> list[Completion]:
+        """One async engine iteration: dispatch window k+1, then consume
+        window k's tokens while the device computes. The pipeline holds
+        at most two windows — dispatch deepens it to two, consume brings
+        it back to one, so the device always has queued work while the
+        host journals, streams, and admits. At the stream's tail
+        (nothing left to dispatch) the remaining window drains."""
+        if len(self._inflight) >= 2:
+            # the oldest window is (nearly) landed and the device still
+            # holds the newer one: consuming BEFORE dispatching keeps
+            # the device busy AND lets the slots this consume frees
+            # re-enter the very next window instead of idling a full
+            # extra dispatch
+            self._consume_window(finished)
+            # refill every slot the consume freed before dispatching:
+            # the window boundary is the async loop's admission point,
+            # so admit_burst paces per WINDOW (N tokens), not per
+            # micro-step — otherwise wide windows starve the batch
+            for _ in range(self.config.max_batch):
+                if not self._pending:
+                    break
+                before = len(self._pending)
+                finished.extend(self._admit_pending())
+                if len(self._pending) == before:
+                    break
+        dispatched = self._dispatch_window()
+        if self._inflight and not dispatched:
+            # stream tail: nothing left to dispatch, drain the pipeline
+            self._consume_window(finished)
+        self._chunk_step(finished)
+        self._update_occupancy()
+        self._maybe_reset_gap()
+        return finished
+
+    def _dispatch_window(self) -> bool:
+        """Dispatch one decode window without waiting for it; returns
+        False when no slot can decode. Per-slot input bookkeeping
+        mirrors the synchronous pending rule exactly: with ``r`` suffix
+        tokens still owed, ``min(r, N-1)`` ride this window as forced
+        inputs after the slot's last token, outputs ``j < r`` are marked
+        for discard (``keep``), and the slot only enters device-feedback
+        mode once the suffix is exhausted."""
+        N = self.substeps
+        B = self.config.max_batch
+        active = np.zeros((B,), bool)
+        forced = np.zeros((B, N), np.int32)
+        fcount = np.zeros((B,), np.int32)
+        entries: list[tuple[int, str, int]] = []
+        for i, s in enumerate(self._slots):
+            if s is None or s.chunking:
+                continue
+            if (not s.pending
+                    and len(s.tokens) + s.inflight_scheduled >= s.max_new):
+                # the slot's length budget is fully covered by windows
+                # already in flight: a fresh row would only produce
+                # output the consume side trims — leave it inactive
+                continue
+            active[i] = True
+            if s.feedback:
+                # next input is the previous window's device-resident
+                # carry; the host never saw it and never needs to
+                entries.append((i, s.req.rid, 0))
+                s.inflight_scheduled += N
+                continue
+            r = len(s.pending)
+            c = min(r, N - 1)
+            forced[i, 0] = s.last_token
+            if c:
+                forced[i, 1:1 + c] = s.pending[:c]
+            fcount[i] = c + 1
+            entries.append((i, s.req.rid, r))
+            s.inflight_scheduled += max(0, N - r)
+            del s.pending[:c]
+            if s.pending:
+                # suffix longer than the window: the next window is
+                # forced too, starting from the next owed token
+                s.last_token = s.pending.pop(0)
+            else:
+                s.feedback = True
+        if not entries:
+            return False
+        seed = self._carry_tok
+        if seed is None:
+            # committed like the carry outputs it stands in for — a
+            # host-resident seed would flip the jit signature between
+            # the first dispatch and every later one (two executables,
+            # busting the compile budget)
+            seed = jax.device_put(np.zeros((B,), np.int32))
+        t0 = time.perf_counter()
+        if not self._inflight and self._last_consume_done is not None:
+            # with a window still in flight the device cannot be starved
+            # and the gap is zero by construction; an empty pipeline
+            # means the device waited since the last consume finished
+            gap = max(0.0, t0 - self._last_consume_done)
+        else:
+            gap = 0.0
+        self._dispatch_gap.observe(gap)
+        self._gap_total += gap
+        toks, logits, carry, cache = self._decode(
+            self.variables, self._cache, seed, forced, fcount, active,
+            *self._lora_args())
+        self._cache = cache
+        self._carry_tok = carry
+        self._decode_steps_total.inc()
+        self._inflight.append(
+            _Window(toks=toks, logits=logits, entries=entries, t0=t0))
+        return True
+
+    def _consume_window(self, finished: list[Completion]) -> None:
+        """Materialize the OLDEST in-flight window and run the host side
+        for its tokens: journal fan-out (``on_token``), TTFT/latency
+        records, logit capture, EOS/length checks. Rows whose slot was
+        released or re-seated after dispatch are stale and skipped — a
+        lag-1 pipeline never journals a token the device hasn't
+        committed, and never mis-attributes one to a new occupant. A
+        stream finishing mid-window has its over-generated tail trimmed
+        here; the window's stale writes past EOS land only in the
+        slot's own (refcount-released) pages."""
+        win = self._inflight.popleft()
+        t_wait = time.perf_counter()
+        toks = np.asarray(win.toks)  # blocks until the window lands
+        t_ready = time.perf_counter()
+        self._busy_total += t_ready - t_wait
+        start = (self._last_consume_done
+                 if self._last_consume_done is not None else win.t0)
+        wall = max(t_ready - start, 1e-9)
+        N = self.substeps
+        logits_np = np.asarray(win.logits) if self.capture_logits else None
+        produced = 0
+        for i, rid, keep in win.entries:
+            slot = self._slots[i]
+            if slot is None or slot.req.rid != rid:
+                continue  # released/preempted after dispatch: stale row
+            slot.inflight_scheduled = max(
+                0, slot.inflight_scheduled - max(0, N - keep))
+            lat_done = False
+            done = None
+            for j in range(keep, N):
+                tok = int(toks[i, j])
+                if slot.prefix_hit and not slot.tokens:
+                    submit_ts = self._submit_ts.pop(rid, None)
+                    if submit_ts is not None:
+                        ttft = t_ready - submit_ts
+                        self._ttft_hist.observe(ttft)
+                        self._close_ttft(rid, ttft)
+                        root = self._req_spans.get(rid)
+                        if root is not None:
+                            root.attrs["ttft_s"] = ttft
+                if not lat_done:
+                    self._tenant_lat.labels(
+                        self._req_tenant.get(rid, "default")).observe(
+                            wall / N)
+                    lat_done = True
+                if logits_np is not None:
+                    self.logit_log.setdefault(rid, []).append(
+                        logits_np[i, j].copy())
+                slot.tokens.append(tok)
+                slot.last_token = tok
+                produced += 1
+                self._emit_token(rid, tok)
+                done = self._finish_reason(slot, tok)
+                if done:
+                    break
+            if self.tracer is not None:
+                root = self._req_spans.get(rid)
+                if root is not None:
+                    self.tracer.record(
+                        "serve.decode_step", win.t0, t_ready,
+                        attrs={"token_index": len(slot.tokens),
+                               "substeps": N},
+                        trace_id=root.trace_id, parent_id=root.span_id)
+            if done:
+                finished.append(self._release(i, done))
+        # wall is consume-to-consume: the engine's true per-window
+        # cadence, host bookkeeping included — so async tok/s is honest
+        # about everything, unlike the sync path's device-only dt
+        self._decode_time += wall
+        self._decode_tokens += produced
+        self._lat_hist.observe(wall / N)
+        self._tokens_total.inc(produced)
+        self._last_consume_done = time.perf_counter()
+        denom = self._gap_total + self._busy_total
+        if denom > 0:
+            self._host_overhead.set(self._gap_total / denom)
+
+    def _flush_pipeline(self) -> None:
+        """Drain every in-flight window to a committed host-coherent
+        boundary — required before anything that mutates state a window
+        in flight still depends on (weight swap, donation audit).
+        Completions surfacing here are returned by the NEXT step()
+        call; slots fall back out of device-feedback mode because the
+        carry is dropped with the pipeline."""
+        while self._inflight:
+            self._consume_window(self._flush_backlog)
+        self._carry_tok = None
+        for s in self._slots:
+            if s is not None:
+                s.feedback = False
+                s.inflight_scheduled = 0
 
     def _chunk_step(self, finished: list[Completion]) -> None:
         """Run at most one chunk of the in-flight chunked prefill —
@@ -1190,6 +1631,12 @@ class ServingEngine:
         agreement, else the resident version + 1)."""
         from move2kube_tpu.serving.fleet import weights as weightslib
 
+        if self.async_decode:
+            # windows in flight were dispatched under the OLD weights;
+            # drain them to a committed boundary so no stream mixes
+            # checkpoints mid-window (their completions surface from
+            # the next step() call)
+            self._flush_pipeline()
         if self.quant.quantize_weights:
             if self._audit_rate:
                 # the drift auditor must reference the NEW checkpoint,
@@ -1227,7 +1674,7 @@ class ServingEngine:
             # (pages still borrowed by in-flight slots survive until
             # those streams release them — that is the COW contract)
             self._prefix.clear()
-            self._prefix_pages.set(self._prefix.total_pages)
+            self._update_occupancy()
         self.weights_version = (int(version) if version is not None
                                 else self.weights_version + 1)
         self._weights_version_gauge.set(self.weights_version)
@@ -1382,7 +1829,7 @@ class ServingEngine:
         page run and block table up front (``seq_len`` starts at 0),
         mark the slot ``chunking`` so decode skips it, and let
         :meth:`_chunk_step` land the prompt one chunk per engine step."""
-        n_pages = pages_for(plen + max_new + self._spec_slack,
+        n_pages = pages_for(plen + max_new + self._overrun_slack,
                             self.cache_cfg.block_size)
         pages, pre = self._alloc_preempting(req, n_pages)
         if pages is None:
@@ -1444,7 +1891,7 @@ class ServingEngine:
         bs = self.cache_cfg.block_size
         c = hit.covered
         w = c // bs  # page index position c (the first write) lands in
-        n_total = pages_for(plen + max_new + self._spec_slack, bs)
+        n_total = pages_for(plen + max_new + self._overrun_slack, bs)
         priv = self._alloc_with_evict(n_total - w)
         if priv is None:
             self._allocator.free(hit.pages)
@@ -1547,7 +1994,7 @@ class ServingEngine:
     def _admit_cold(self, req: Request, slot_idx: int, plen: int,
                     max_new: int) -> tuple[bool, list[Completion]]:
         bs = self.cache_cfg.block_size
-        n_pages = pages_for(plen + max_new + self._spec_slack, bs)
+        n_pages = pages_for(plen + max_new + self._overrun_slack, bs)
         # a page-unaligned prompt that will be donated to the prefix
         # cache needs one spare page: the boundary page becomes shared
         # at insert, and this slot's own generation copy-on-writes it
@@ -1701,7 +2148,7 @@ class ServingEngine:
                 f"{req.rid}: handoff deadline {req.deadline_s:.3f}s "
                 f"{reason} for {max_new} new tokens")
         if (plen < 1
-                or plen + max_new + self._spec_slack > self.cache_cfg.max_seq):
+                or plen + max_new + self._overrun_slack > self.cache_cfg.max_seq):
             self._rejected.inc()
             self._tenant_rejected.labels(tenant).inc()
             self.slo.record(tenant, ok=False)
@@ -1727,7 +2174,7 @@ class ServingEngine:
         if not free:
             return False, []
         pages = self._alloc_with_evict(pages_for(
-            plen + max_new + self._spec_slack, self.cache_cfg.block_size))
+            plen + max_new + self._overrun_slack, self.cache_cfg.block_size))
         if pages is None:
             return False, []
         slot_idx = free[0]
@@ -1786,14 +2233,23 @@ class ServingEngine:
     def verify_cache_donated(self) -> int:
         """Compile the decode step and assert the KV pages really alias
         into the outputs (device-resident across steps). Returns the
-        alias count."""
-        tokens = np.zeros((self.config.max_batch,), np.int32)
-        active = np.zeros((self.config.max_batch,), bool)
+        alias count. In async mode the audited executable is the
+        multi-substep window — donation matters MORE there: a copied
+        cache would break the in-graph feedback chain's ordering."""
+        B = self.config.max_batch
+        active = np.zeros((B,), bool)
+        lora = self._lora_args(rows=np.zeros((B,), np.int32))
+        if self.async_decode:
+            self._flush_pipeline()
+            args = (self.variables, self._cache,
+                    np.zeros((B,), np.int32),
+                    np.zeros((B, self.substeps), np.int32),
+                    np.zeros((B,), np.int32), active) + lora
+        else:
+            args = (self.variables, self._cache,
+                    np.zeros((B,), np.int32), active) + lora
         return kvcache.assert_cache_donated(
-            self._decode, self.variables, self._cache, tokens, active,
-            *self._lora_args(rows=np.zeros((self.config.max_batch,),
-                                           np.int32)),
-            num_layers=self.cache_cfg.num_layers)
+            self._decode, *args, num_layers=self.cache_cfg.num_layers)
 
     def _snapshot_persistent_cache(self) -> None:
         self._cache_dir = None
@@ -1879,10 +2335,17 @@ class ServingEngine:
             if compiled is not None:
                 reports[f"prefill_{bucket}"] = \
                     costmodel.analyze_compiled(compiled)
+        B = self.config.max_batch
+        if self.async_decode:
+            decode_args = (np.zeros((B,), np.int32),
+                           np.zeros((B, self.substeps), np.int32),
+                           np.zeros((B,), np.int32),
+                           np.zeros((B,), bool))
+        else:
+            decode_args = (np.zeros((B,), np.int32),
+                           np.zeros((B,), bool))
         compiled = costmodel.lower_and_compile(
-            self._decode, self.variables, self._cache,
-            np.zeros((self.config.max_batch,), np.int32),
-            np.zeros((self.config.max_batch,), bool))
+            self._decode, self.variables, self._cache, *decode_args)
         if compiled is not None:
             decode = costmodel.analyze_compiled(compiled)
             reports["decode"] = decode
@@ -1937,7 +2400,17 @@ class ServingEngine:
             "active_slots": sum(1 for s in self._slots if s is not None),
             "ttft_p50_ms": self._ttft_hist.quantile(0.50) * 1e3,
             "ttft_p95_ms": self._ttft_hist.quantile(0.95) * 1e3,
+            # host-overlap evidence (PR 19): how long the device sat
+            # starved between consuming step k and dispatching k+1
+            "async_decode": bool(self.async_decode),
+            "dispatch_gap_p50_ms": self._dispatch_gap.quantile(0.50) * 1e3,
+            "dispatch_gap_total_s": self._gap_total,
+            "host_overhead_ratio": (
+                self._gap_total / (self._gap_total + self._busy_total)
+                if self._gap_total + self._busy_total > 0 else 0.0),
         }
+        if self.async_decode:
+            out["decode_substeps"] = self.substeps
         if self._prefix is not None:
             hits = self._prefix_hits.value
             misses = self._prefix_misses.value

@@ -41,6 +41,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import queue
 import threading
 import time
 import urllib.error
@@ -138,6 +139,115 @@ def _rendezvous_score(key: int, name: str) -> int:
     return int.from_bytes(h.digest()[:8], "little")
 
 
+class TokenFanout:
+    """Bounded-queue stream fan-out between the engine's step thread and
+    SSE/streaming subscribers (PR 19).
+
+    The async decode pipeline makes the step thread's time precious —
+    every millisecond it spends is a dispatch gap the device idles
+    through. So the step thread's half of streaming is ONE non-blocking
+    ``put_nowait`` onto a shared bounded queue; a dedicated worker
+    thread drains it into per-subscriber bounded buffers. A slow SSE
+    consumer can therefore never stall decode: when *its* buffer fills,
+    that subscriber alone is cut with a ``lagged`` event and counted
+    (``m2kt_serve_fanout_lagged_total``); if the shared queue itself
+    fills (the worker is starved), tokens are counted dropped
+    (``m2kt_serve_fanout_dropped_total``) rather than blocking the step.
+
+    The router's token *journal* does NOT ride this path — journaling
+    stays synchronous in the step thread because the lag-1 exactness
+    guarantee ("never journal a token the device hasn't committed, never
+    lose one it has") depends on it. Fan-out is best-effort delivery for
+    human eyeballs; the journal is the source of truth for resume.
+
+    Subscriber protocol: :meth:`subscribe` returns a ``queue.Queue`` of
+    ``("token", int)``, ``("finish", reason)`` and ``("lagged", None)``
+    events; ``finish``/``lagged`` are terminal."""
+
+    _STOP = object()
+
+    def __init__(self, registry: Registry | None = None,
+                 maxsize: int = 4096, sub_maxsize: int = 256):
+        self._q: queue.Queue = queue.Queue(maxsize)
+        self._subs: dict[str, list[queue.Queue]] = {}
+        self._lock = threading.Lock()
+        reg = registry if registry is not None else Registry()
+        self._dropped = reg.counter(
+            "m2kt_serve_fanout_dropped_total",
+            "Stream tokens dropped because the fan-out queue was full "
+            "(the step thread never blocks on streaming)")
+        self._lagged = reg.counter(
+            "m2kt_serve_fanout_lagged_total",
+            "Streaming subscribers disconnected for falling behind")
+        self._sub_maxsize = max(1, sub_maxsize)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="token-fanout", daemon=True)
+        self._thread.start()
+
+    def subscribe(self, rid: str) -> queue.Queue:
+        """Register a subscriber for ``rid``'s tokens; call BEFORE the
+        request is submitted or the head of the stream may be missed."""
+        sub: queue.Queue = queue.Queue(self._sub_maxsize)
+        with self._lock:
+            self._subs.setdefault(rid, []).append(sub)
+        return sub
+
+    def unsubscribe(self, rid: str, sub: queue.Queue) -> None:
+        with self._lock:
+            subs = self._subs.get(rid)
+            if subs and sub in subs:
+                subs.remove(sub)
+                if not subs:
+                    self._subs.pop(rid, None)
+
+    def publish(self, rid: str, tok: int) -> None:
+        """Step-thread half: enqueue and return, never block."""
+        try:
+            self._q.put_nowait(("token", rid, tok))
+        except queue.Full:
+            self._dropped.inc()
+
+    def finish(self, rid: str, reason: str = "") -> None:
+        try:
+            self._q.put_nowait(("finish", rid, reason))
+        except queue.Full:
+            self._dropped.inc()
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._q.put_nowait(self._STOP)
+        except queue.Full:
+            pass
+        self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._STOP or self._stop:
+                return
+            kind, rid, payload = item
+            with self._lock:
+                subs = list(self._subs.get(rid, ()))
+            for sub in subs:
+                try:
+                    sub.put_nowait((kind, payload))
+                except queue.Full:
+                    # this subscriber alone falls off the stream; the
+                    # terminal marker jumps the queue so it learns why
+                    self._lagged.inc()
+                    try:
+                        sub.queue.clear()  # make room for the marker
+                        sub.put_nowait(("lagged", None))
+                    except queue.Full:
+                        pass
+                    self.unsubscribe(rid, sub)
+            if kind == "finish":
+                with self._lock:
+                    self._subs.pop(rid, None)
+
+
 class ReplicaHandle:
     """One engine replica as the router sees it. ``deadline_s`` is the
     remaining budget for the call (None = unbounded); ``on_token`` is
@@ -173,6 +283,9 @@ class InProcessReplica(ReplicaHandle):
         # optional ServingChaos (serving/fleet/chaos.py): hooks into the
         # token stream / generate entry / health checks for fault drills
         self.chaos = None
+        # optional TokenFanout: best-effort streaming fan-out off the
+        # step thread; the journal callback above it stays synchronous
+        self.fanout: TokenFanout | None = None
         self._lock = threading.Lock()
         self._waiters: dict[str, tuple[threading.Event, list]] = {}
         self._token_cbs: dict[str, object] = {}
@@ -217,6 +330,8 @@ class InProcessReplica(ReplicaHandle):
             cb(tok)
         if self.chaos is not None:
             self.chaos.on_token(self.name, rid, tok)
+        if self.fanout is not None:
+            self.fanout.publish(rid, tok)
 
     def _loop(self) -> None:
         while not self._stop:

@@ -92,6 +92,23 @@ def pages_for(n_tokens: int, block_size: int) -> int:
     return -(-int(n_tokens) // int(block_size))
 
 
+def sanitized_views(cache: dict, active):
+    """Decode-time ``(block_tables, positions)`` views with every
+    inactive row redirected at the null page / position 0 (jit-safe).
+
+    Every decode-shaped executable (single-step, multi-substep, spec
+    verify) runs the *full* ``max_batch`` regardless of how many slots
+    hold live requests — empty rows still index the page pool. This is
+    the one place that makes those rows harmless: their writes land in
+    the reserved page 0 and their position math stays in range, so no
+    executable needs a bounds branch and no variant can drift from the
+    others' masking (a variant that forgot the redirect would scribble
+    a garbage row into a *live* sequence's page)."""
+    bt = jnp.where(active[:, None], cache["block_tables"], NULL_PAGE)
+    pos = jnp.where(active, cache["seq_lens"], 0)
+    return bt, pos
+
+
 def init_cache(cfg: KVCacheConfig) -> dict:
     """Zeroed device cache pytree. ``k``/``v`` are per-layer *lists* of
     page pools — 2·num_layers separate buffers, so every one of them gets

@@ -1,0 +1,281 @@
+"""Async decode pipeline tests (PR 19): double-buffered dispatch and
+in-graph multi-step decode.
+
+The load-bearing property is *stream equivalence*: with greedy decoding
+the async pipeline must produce byte-identical token streams to the
+synchronous reference loop — across substeps widths, quantized KV,
+chunked prefill, EOS at substep granularity, and mid-stream preemption.
+The lag-1 contract is the other half: a chaos kill at token N must leave
+exactly N tokens journaled (the host never journals a token the device
+hasn't committed), and a /metrics scrape must never touch the device.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from move2kube_tpu.models.llama import Llama, llama_tiny
+from move2kube_tpu.obs.metrics import Registry
+from move2kube_tpu.serving import quant as quantlib
+from move2kube_tpu.serving.engine import EngineConfig, Request, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def engine_parts():
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, async_decode="off", substeps=1, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("buckets", (8, 16))
+    registry = kw.pop("registry", None)
+    return ServingEngine(model, variables, EngineConfig(
+        async_decode=async_decode, substeps=substeps, **kw),
+        registry=registry)
+
+
+def _prompt(seed, plen=6):
+    return np.random.default_rng(seed).integers(1, 200, size=plen).tolist()
+
+
+def _reqs():
+    return [Request("a", _prompt(1, 4), 6),
+            Request("b", _prompt(2, 9), 8),
+            Request("c", _prompt(3, 14), 5),
+            Request("d", _prompt(4, 6), 7)]
+
+
+def _streams(engine, reqs):
+    return {c.rid: (c.tokens, c.finish_reason) for c in engine.run(reqs)}
+
+
+@pytest.fixture(scope="module")
+def sync_ref(engine_parts):
+    """One shared synchronous reference engine (compile is the dominant
+    test cost) plus its greedy streams for the canonical request set."""
+    model, variables = engine_parts
+    eng = _engine(model, variables)
+    return eng, _streams(eng, _reqs())
+
+
+# ----------------------------------------------------------------------
+# stream equivalence: async == sync, byte for byte
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("substeps", [1, 4])
+def test_async_streams_byte_identical_fp32(engine_parts, sync_ref,
+                                           substeps):
+    """Greedy fp32 streams through the async pipeline (double-buffered
+    dispatch, device-resident feedback, substeps in-graph) must equal
+    the synchronous reference exactly — slot recycling included."""
+    model, variables = engine_parts
+    sync, want = sync_ref
+    eng = _engine(model, variables, async_decode="on", substeps=substeps)
+    assert not sync.async_decode and eng.async_decode
+    assert eng.substeps == substeps
+    got = _streams(eng, _reqs())
+    assert got == want
+
+
+def test_async_eos_at_substep_granularity(engine_parts, sync_ref):
+    """EOS landing mid-window: the over-generated tail must be trimmed
+    host-side and the stream must stop exactly where the sync loop
+    does. The eos token is picked from a reference run so it fires at
+    an interior substep of a 4-wide window."""
+    model, variables = engine_parts
+    probe, _ = sync_ref
+    ref = _streams(probe, [Request("a", _prompt(1, 4), 12)])
+    eos = ref["a"][0][5]  # token 6 of 12: substep 2 of window 2 at N=4
+    sync = _engine(model, variables, eos_id=eos)
+    eng = _engine(model, variables, async_decode="on", substeps=4,
+                  eos_id=eos)
+    want = _streams(sync, [Request("a", _prompt(1, 4), 12)])
+    got = _streams(eng, [Request("a", _prompt(1, 4), 12)])
+    assert got == want
+    assert want["a"][1] == "eos" and len(want["a"][0]) == 6
+
+
+@pytest.mark.slow  # full int8-kv matrix rides `make asyncserve-smoke`
+def test_async_streams_int8kv_logit_gated(engine_parts):
+    """Async vs sync under int8-kv: same quantized KV on both sides, so
+    the streams must coincide and every decoded position's logits must
+    sit inside the int8 relative-error gate."""
+    model, variables = engine_parts
+    sync = _engine(model, variables, quant="int8-kv")
+    eng = _engine(model, variables, async_decode="on", substeps=2,
+                  quant="int8-kv")
+    sync.capture_logits = True
+    eng.capture_logits = True
+    want = _streams(sync, _reqs())
+    got = _streams(eng, _reqs())
+    assert got == want
+    for rid in want:
+        for a, b in zip(sync.logit_log[rid], eng.logit_log[rid]):
+            gate = quantlib.logit_gate(a, b)
+            assert gate["max_rel_err"] < 0.05, rid
+
+
+@pytest.mark.slow  # chunked-prefill matrix rides `make asyncserve-smoke`
+def test_async_chunked_prefill_composes(engine_parts):
+    """A long prompt riding the chunked-prefill executable while short
+    streams decode: the async window dispatcher must interleave with
+    _chunk_step without corrupting either stream."""
+    model, variables = engine_parts
+    kw = dict(chunk_prefill=8, buckets=(8, 16, 64))
+    reqs = [Request("long", _prompt(5, 40), 8),
+            Request("short", _prompt(6, 5), 10)]
+    want = _streams(_engine(model, variables, **kw), list(reqs))
+    got = _streams(_engine(model, variables, async_decode="on",
+                           substeps=2, **kw), list(reqs))
+    assert got == want
+
+
+@pytest.mark.slow  # preemption matrix rides `make asyncserve-smoke`
+def test_async_preemption_mid_stream(engine_parts, sync_ref):
+    """Priority preemption at the lag-1 boundary: the victim's paused
+    completion holds only CONSUMED tokens (a prefix of the
+    uninterrupted run — in-flight window rows go stale, they are never
+    surfaced), the survivor stays byte-identical, and the gold request
+    is served."""
+    model, variables = engine_parts
+    spec = "gold:prio=high;free:prio=besteffort"
+    ref, _ = sync_ref
+    truth = ref.run([Request("t", _prompt(7, 5), 12)])[0]
+    full2 = ref.run([Request("t2", _prompt(8, 9), 12)])[0]
+
+    eng = _engine(model, variables, async_decode="on", substeps=2,
+                  sched_tenants=spec)
+    eng.submit(Request("be1", _prompt(7, 5), 12, tenant="free"))
+    eng.submit(Request("be2", _prompt(8, 9), 12, tenant="free"))
+    done = []
+    for _ in range(4):
+        done += eng.step()
+    eng.submit(Request("gold", _prompt(9, 6), 2, tenant="gold"))
+    while eng.has_work():
+        done += eng.step()
+    by = {c.rid: c for c in done}
+    assert by["be2"].finish_reason == "preempted"
+    assert by["be1"].finish_reason == "length"
+    assert by["be1"].tokens == truth.tokens
+    n = len(by["be2"].tokens)
+    assert 0 <= n < 12
+    assert by["be2"].tokens == full2.tokens[:n]
+    assert len(by["gold"].tokens) == 2
+
+
+@pytest.mark.slow  # spec matrix rides `make asyncserve-smoke`
+def test_async_spec_decode_falls_back(engine_parts, capsys):
+    """Speculative decoding is host-synchronous (the verify step reads
+    draft tokens every iteration): auto silently keeps the sync loop,
+    on warns — and either way the stream equals the spec reference."""
+    model, variables = engine_parts
+    auto = _engine(model, variables, async_decode="auto", spec_k=2)
+    assert not auto.async_decode
+    assert "WARNING" not in capsys.readouterr().out
+    forced = _engine(model, variables, async_decode="on", spec_k=2)
+    assert not forced.async_decode
+    assert "M2KT_ASYNC_DECODE=on is incompatible" in capsys.readouterr().out
+    want = _streams(_engine(model, variables, spec_k=2), _reqs())
+    assert _streams(auto, _reqs()) == want
+
+
+# ----------------------------------------------------------------------
+# lag-1 journal exactness (chaos drill) + compile budget
+# ----------------------------------------------------------------------
+
+def test_async_chaos_kill_journals_exactly_n(engine_parts):
+    """Kill at token N under async (the PR-13 drill): the journal
+    callback raises on its Nth token. The tokens of the window still in
+    flight were computed but never consumed — exactly N must have been
+    journaled, no more, no fewer."""
+    model, variables = engine_parts
+    kill_at = 5
+    eng = _engine(model, variables, async_decode="on", substeps=4)
+    journal = []
+
+    def _cb(rid, tok):
+        journal.append((rid, tok))
+        if len(journal) == kill_at:
+            raise RuntimeError("chaos: kill at token N")
+
+    eng.on_token = _cb
+    with pytest.raises(RuntimeError, match="kill at token N"):
+        eng.run([Request("drill", _prompt(10, 5), 12)])
+    assert len(journal) == kill_at
+
+
+def test_async_compile_budget_holds(engine_parts):
+    """The multi-step executable REPLACES the sync decode step (jit is
+    lazy — the unused variant never compiles): a 12-request stream
+    across every bucket stays within num_buckets + 2."""
+    model, variables = engine_parts
+    eng = _engine(model, variables, max_batch=4, max_seq=64,
+                  buckets=(8, 16, 32), async_decode="on", substeps=4)
+    rng = np.random.default_rng(11)
+    lengths = [3, 30, 9, 17, 8, 25, 5, 12, 31, 6, 16, 20]
+    reqs = [Request(f"r{i}", rng.integers(1, 200, size=n).tolist(),
+                    int(rng.integers(1, 5)))
+            for i, n in enumerate(lengths)]
+    assert len(eng.run(reqs)) == 12
+    report = eng.compile_report()
+    assert report["decode_executables"] == 1
+    assert report["total_executables"] <= report["num_buckets"] + 2
+    # pipeline fully drained: every page back in the pool
+    assert eng._allocator.available == eng.cache_cfg.num_pages - 1
+
+
+def test_async_cache_donation_survives(engine_parts):
+    """The multi-step executable must still alias the KV page pools
+    in-place — double-buffering with a copied cache would defeat it."""
+    model, variables = engine_parts
+    eng = _engine(model, variables, max_seq=32, buckets=(8,),
+                  async_decode="on", substeps=2)
+    assert eng.verify_cache_donated() >= 2 * eng.cache_cfg.num_layers
+
+
+# ----------------------------------------------------------------------
+# satellite: scrape isolation + dispatch-gap instrumentation
+# ----------------------------------------------------------------------
+
+def test_metrics_scrape_adds_no_device_sync(engine_parts):
+    """Gauges are snapshotted at step-sync points; rendering /metrics
+    re-reads the snapshot only. Poisoning the device cache proves a
+    scrape cannot reach it."""
+    model, variables = engine_parts
+    reg = Registry()
+    eng = _engine(model, variables, async_decode="on", substeps=2,
+                  registry=reg)
+    eng.run([Request("a", _prompt(1, 4), 6)])
+    before = reg.render()
+    assert "m2kt_serve_slot_occupancy" in before
+    eng._cache = None  # any device-derived read would now blow up
+    eng._allocator = None
+    after = reg.render()
+    assert "m2kt_serve_slot_occupancy" in after
+
+
+def test_dispatch_gap_metrics(engine_parts, sync_ref):
+    """The direct evidence the tentpole moves: the sync loop pays a
+    dispatch gap every step (host bookkeeping while the device idles);
+    the double-buffered pipeline's gap collapses to (near) zero."""
+    model, variables = engine_parts
+    sync, _ = sync_ref
+    eng = _engine(model, variables, async_decode="on", substeps=2)
+    _streams(sync, _reqs())
+    _streams(eng, _reqs())
+    s_sync, s_async = sync.stats(), eng.stats()
+    assert s_sync["dispatch_gap_total_s"] > 0
+    assert s_async["dispatch_gap_total_s"] <= s_sync["dispatch_gap_total_s"]
+    assert s_async["host_overhead_ratio"] <= s_sync["host_overhead_ratio"]
+    assert s_async["async_decode"] and not s_sync["async_decode"]
+    assert s_async["decode_substeps"] == 2

@@ -294,7 +294,9 @@ def test_serving_optimizer_injects_knobs():
                    "M2KT_KV_BLOCK_SIZE": "16",
                    "M2KT_SERVE_QUANT": "off",
                    "M2KT_SERVE_KERNELS": "auto",
-                   "M2KT_SPEC_K": "0"}
+                   "M2KT_SPEC_K": "0",
+                   "M2KT_ASYNC_DECODE": "auto",
+                   "M2KT_DECODE_SUBSTEPS": "1"}
 
 
 def test_serving_parameterizer_lifts_knobs():
@@ -306,6 +308,8 @@ def test_serving_parameterizer_lifts_knobs():
         {"name": "M2KT_SERVE_QUANT", "value": "int8-kv"},
         {"name": "M2KT_SERVE_KERNELS", "value": "on"},
         {"name": "M2KT_SPEC_K", "value": "4"},
+        {"name": "M2KT_ASYNC_DECODE", "value": "on"},
+        {"name": "M2KT_DECODE_SUBSTEPS", "value": "4"},
     ]
     ir = tpu_serving_parameterizer(ir)
     assert ir.values.global_variables["tpuservemaxbatch"] == "16"
@@ -314,11 +318,15 @@ def test_serving_parameterizer_lifts_knobs():
     assert ir.values.global_variables["tpuservequant"] == "int8-kv"
     assert ir.values.global_variables["tpuservekernels"] == "on"
     assert ir.values.global_variables["tpuspeck"] == "4"
+    assert ir.values.global_variables["tpuserveasync"] == "on"
+    assert ir.values.global_variables["tpuservesubsteps"] == "4"
     env = {e["name"]: e["value"]
            for e in ir.services["srv"].containers[0]["env"]}
     assert env["M2KT_SERVE_MAX_BATCH"] == "{{ .Values.tpuservemaxbatch }}"
     assert env["M2KT_SERVE_QUANT"] == "{{ .Values.tpuservequant }}"
     assert env["M2KT_SPEC_K"] == "{{ .Values.tpuspeck }}"
+    assert env["M2KT_ASYNC_DECODE"] == "{{ .Values.tpuserveasync }}"
+    assert env["M2KT_DECODE_SUBSTEPS"] == "{{ .Values.tpuservesubsteps }}"
 
 
 def test_non_serving_service_untouched():
