@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke trainkernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke sched-smoke autoscale-smoke asyncserve-smoke clean
+.PHONY: test test-fast coverage lint ci dist bench dryrun e2e perf-smoke fault-smoke multichip-smoke serve-smoke obs-smoke elastic-smoke trace-smoke mfu-smoke fleet-smoke quant-smoke kernel-smoke trainkernel-smoke slo-smoke chaos-smoke swap-smoke numerics-smoke sched-smoke autoscale-smoke asyncserve-smoke usage-smoke clean
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -226,6 +226,16 @@ autoscale-smoke:
 asyncserve-smoke:
 	$(CPU_ENV) $(PY) -m pytest tests/test_async.py -q
 	$(CPU_ENV) $(PY) bench.py --model serving
+
+# usage ledger + capture→replay + auto-diagnostics (PR 20): ledger
+# determinism, chargeback identity, capture round-trip, watchdog
+# hysteresis/rate-limit units, then the bench usage phase (chargeback
+# Σ TPU-seconds ≡ pods×wall within 1%, capture replay within 10% of the
+# recorded rate and tenant shares, an induced SLO fast-burn producing
+# exactly one rate-limited diag bundle, ledger overhead ≤ 1%)
+usage-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_usage.py -q
+	$(CPU_ENV) $(PY) bench.py --model usage
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -68,7 +68,7 @@ MEASURE_CALLS = int(os.environ.get("M2KT_BENCH_MEASURE_CALLS", "3"))
 
 PHASES = ("resnet", "bert", "pallas", "llama", "translate", "goodput",
           "scaling", "serving", "fleet", "quant", "kernels", "obs",
-          "chaos", "swap", "numerics", "sched", "autoscale")
+          "chaos", "swap", "numerics", "sched", "autoscale", "usage")
 # single source of truth for each phase's reported metric name + unit,
 # shared by the measurement functions and the parent's failure fallback
 PHASE_METRICS = {
@@ -89,6 +89,7 @@ PHASE_METRICS = {
     "numerics": ("numerics_telemetry_overhead_fraction", "fraction"),
     "sched": ("multilora_aggregate_tokens_s", "tok/s"),
     "autoscale": ("autoscale_replica_hours_saving", "fraction"),
+    "usage": ("usage_replay_fidelity_err", "fraction"),
 }
 # phases that need the TPU backend; "translate" is pure-CPU tool work and
 # runs in a child with the TPU plugin hook disabled, so a hung tunnel can
@@ -1970,6 +1971,253 @@ def run_autoscale_probe() -> int:
     return 0
 
 
+def bench_usage(n: int) -> dict:
+    """Usage-ledger / capture→replay / auto-diagnostics phase. One
+    probe child drives multi-tenant traffic through a real llama_tiny
+    fleet with the usage ledger snapshotting, then gates the three
+    claims of the observability plane: (1) the chargeback identity —
+    per-tenant TPU-seconds sum to pods × wall within 1%; (2) replay
+    fidelity — the capture built from the ledger rings, replayed as a
+    simulator trace, reproduces the measured aggregate token rate and
+    per-tenant shares within 10%; (3) the anomaly watchdog — an induced
+    SLO fast-burn produces EXACTLY one diag bundle (profiler trace +
+    span ring + ledger window), an immediate re-trigger is rate-limit
+    suppressed, and the interval lapse re-arms it. Also measures ledger
+    snapshot overhead (must stay under 1% of the snapshot interval)."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    env.setdefault("M2KT_SLO_WINDOW_SCALE", "0.01")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--usage-probe"],
+        env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"usage probe rc={res.returncode}: {res.stderr[-300:]}")
+    probe = json.loads(res.stdout.strip().splitlines()[-1])
+    dt = time.perf_counter() - t0
+    fid_err = max(probe["replay_rate_err"], probe["replay_max_share_err"])
+    print(f"[bench] usage: chargeback identity err "
+          f"{probe['chargeback_identity_err']:.4f} over "
+          f"{probe['pods']} pods / {probe['total_wall_s']:.1f}s wall; "
+          f"replay rate err {probe['replay_rate_err']:.4f}, share err "
+          f"{probe['replay_max_share_err']:.4f} "
+          f"({probe['recorded_tokens']:.0f} tokens, "
+          f"{probe['tenants']} tenants); diag bundles "
+          f"{probe['diag_bundles_first']}→{probe['diag_bundles_final']} "
+          f"(suppressed {probe['diag_suppressed']}); snapshot "
+          f"{probe['snapshot_mean_s'] * 1e3:.2f}ms -> overhead "
+          f"{probe['ledger_overhead_fraction']:.5f} in {dt:.1f}s",
+          file=sys.stderr)
+    metric, unit = PHASE_METRICS["usage"]
+    return {"phase": "usage", "metric": metric,
+            "value": round(fid_err, 5), "unit": unit,
+            "chargeback_identity_err": probe["chargeback_identity_err"],
+            "total_wall_s": probe["total_wall_s"],
+            "total_tpu_seconds": probe["total_tpu_seconds"],
+            "pods": probe["pods"],
+            "tenants": probe["tenants"],
+            "recorded_tokens": probe["recorded_tokens"],
+            "replayed_tokens": probe["replayed_tokens"],
+            "replay_rate_err": probe["replay_rate_err"],
+            "replay_max_share_err": probe["replay_max_share_err"],
+            "replay_requests": probe["replay_requests"],
+            "diag_bundles_first": probe["diag_bundles_first"],
+            "diag_bundles_final": probe["diag_bundles_final"],
+            "diag_suppressed": probe["diag_suppressed"],
+            "diag_bundle_parts": probe["diag_bundle_parts"],
+            "snapshot_mean_s": probe["snapshot_mean_s"],
+            "ledger_overhead_fraction":
+                probe["ledger_overhead_fraction"],
+            "wall_s": round(dt, 2)}
+
+
+def run_usage_probe() -> int:
+    """In-process half of the usage phase (spawned by bench_usage with
+    jax forced onto host devices). Prints one JSON line."""
+    import dataclasses
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from move2kube_tpu.models.llama import Llama, llama_tiny
+    from move2kube_tpu.obs.bridge import DiagWatchdog
+    from move2kube_tpu.obs.ledger import (UsageLedger, engine_source,
+                                          router_source)
+    from move2kube_tpu.obs.metrics import Registry
+    from move2kube_tpu.obs.slo import SLOTracker
+    from move2kube_tpu.obs.tracing import SpanRecorder
+    from move2kube_tpu.serving.engine import EngineConfig
+    from move2kube_tpu.serving.fleet.capture import (CapturedTrace,
+                                                     build_capture,
+                                                     chargeback, fidelity)
+    from move2kube_tpu.serving.fleet.router import build_fleet
+
+    # ---- multi-tenant traffic through a real fleet -------------------
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                              attn_impl="dense")
+    model = Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(7)
+    ecfg = EngineConfig(max_batch=2, max_seq=128, block_size=8,
+                        buckets=(32,))
+    router = build_fleet(model, variables, 1, engine_config=ecfg)
+    engine = router.replicas[0].engine
+    # two pods' worth of ledgers, as the fleet runs them: the decode
+    # pod snapshots the engine counters, the router pod its admissions
+    eng_ledger = UsageLedger(registry=Registry(), role="decode",
+                             interval_s=0.1)
+    eng_ledger.add_source(engine_source(engine), "engine")
+    rt_ledger = UsageLedger(registry=Registry(), role="router",
+                            interval_s=0.1)
+    rt_ledger.add_source(router_source(router), "router")
+    prompt = rng.integers(1, cfg.vocab_size, size=16).tolist()
+    tenants = {"acme": 5, "globex": 3, "initech": 1}
+    try:
+        router.generate(list(prompt), max_new_tokens=2)  # compile warm
+        eng_ledger.snapshot()
+        rt_ledger.snapshot()
+        for _wave in range(3):
+            for tenant, weight in tenants.items():
+                for _ in range(weight):
+                    out = router.generate(list(prompt), max_new_tokens=4,
+                                          tenant=tenant)
+                    assert out.get("tokens"), out
+            eng_ledger.snapshot()
+            rt_ledger.snapshot()
+    finally:
+        for rep in router.replicas:
+            rep.close()
+    docs = [eng_ledger.doc(), rt_ledger.doc()]
+
+    # ---- gate 1: chargeback identity ---------------------------------
+    report = chargeback(docs)
+    identity_err = (abs(report["total_tpu_seconds"]
+                        - report["total_wall_s"])
+                    / max(1e-9, report["total_wall_s"]))
+    assert identity_err <= 0.01, (
+        f"TPU-seconds {report['total_tpu_seconds']:.3f} vs wall "
+        f"{report['total_wall_s']:.3f}: identity err {identity_err:.4f} "
+        "over the 1% gate")
+    billed = set(report["tenants"]) - {"unattributed"}
+    assert billed >= set(tenants), (
+        f"chargeback lost tenants: billed {sorted(billed)}, "
+        f"drove {sorted(tenants)}")
+
+    # ---- gate 2: capture -> replay fidelity --------------------------
+    capture = build_capture(docs, bin_s=0.5)
+    trace = CapturedTrace(capture, seed=0)
+    fid = fidelity(capture, trace)
+    assert fid["rate_err"] <= 0.10, (
+        f"replayed aggregate rate off by {fid['rate_err']:.3f} "
+        f"({fid['replayed_tps']:.1f} vs {fid['recorded_tps']:.1f} "
+        "tok/s) — over the 10% gate")
+    assert fid["max_share_err"] <= 0.10, (
+        f"per-tenant share error {fid['max_share_err']:.3f} over the "
+        f"10% gate: {fid['share_err']}")
+
+    # ---- gate 3: anomaly-triggered auto-profiling --------------------
+    # injected clocks make the burn/rate-limit timeline deterministic
+    t_now = [1000.0]
+
+    def clk() -> float:
+        return t_now[0]
+
+    slo = SLOTracker(registry=Registry(), clock=clk)
+    wd_reg = Registry()
+    diag_out = tempfile.mkdtemp(prefix="m2kt-diag-")
+    wd = DiagWatchdog(registry=wd_reg, slo=slo, tracer=SpanRecorder(),
+                      ledger=eng_ledger, out_dir=diag_out,
+                      min_interval_s=600.0, profile_seconds=0.2,
+                      clock=clk)
+
+    def burn(bad: bool, n_events: int = 40, dt: float = 1.0) -> None:
+        for _ in range(n_events):
+            t_now[0] += dt
+            slo.record(ok=not bad, ttft_s=10.0 if bad else 0.01)
+
+    burn(bad=True)
+    first = wd.check()
+    assert first is not None, "induced fast-burn did not trigger a capture"
+    for _ in range(5):  # still firing: hysteresis holds, no re-capture
+        wd.check()
+    bundles_first = len(wd.captures)
+    assert bundles_first == 1, (
+        f"{bundles_first} bundles from one sustained burn — wanted "
+        "exactly one")
+    # join before the re-arm capture: jax allows one active profiler
+    wd.wait(timeout_s=30.0)
+    # recover, re-burn inside the rate-limit interval: suppressed
+    burn(bad=False, n_events=120)
+    wd.check()
+    burn(bad=True)
+    assert wd.check() is None, "rate limit failed to suppress a re-burn"
+    suppressed = sum(
+        v for _lv, v in wd._c_suppressed.samples())  # noqa: SLF001
+    assert suppressed >= 1, "suppression was not counted"
+    # interval lapse re-arms: the next edge captures again
+    burn(bad=False, n_events=120)
+    wd.check()
+    t_now[0] += 601.0
+    burn(bad=True)
+    assert wd.check() is not None, (
+        "watchdog did not re-arm after the rate-limit interval")
+    wd.wait(timeout_s=30.0)
+    bundle = wd.captures[0]
+    manifest_path = os.path.join(bundle, "manifest.json")
+    assert os.path.exists(manifest_path), f"no manifest in {bundle}"
+    with open(manifest_path, encoding="utf-8") as f:
+        manifest = json.load(f)
+    parts = sorted(manifest.get("parts", []))
+    for part in ("traces.json", "usage.json", "profile"):
+        assert part in parts, f"bundle missing {part}: {parts}"
+        assert os.path.exists(os.path.join(bundle, part)), part
+    assert os.listdir(os.path.join(bundle, "profile")), (
+        "profiler capture produced no files")
+
+    # ---- ledger overhead ---------------------------------------------
+    reps = 50
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        eng_ledger.snapshot()
+    snap_mean_s = (time.perf_counter() - t0) / reps
+    from move2kube_tpu.obs.ledger import DEFAULT_INTERVAL_S
+    overhead = snap_mean_s / DEFAULT_INTERVAL_S
+    assert overhead <= 0.01, (
+        f"ledger snapshot costs {snap_mean_s * 1e3:.1f}ms — "
+        f"{overhead:.4f} of the {DEFAULT_INTERVAL_S:g}s interval, over "
+        "the 1% gate")
+
+    print(json.dumps({
+        "chargeback_identity_err": round(identity_err, 6),
+        "total_wall_s": round(report["total_wall_s"], 3),
+        "total_tpu_seconds": round(report["total_tpu_seconds"], 3),
+        "pods": len(report["pods"]),
+        "tenants": len(billed),
+        "recorded_tokens": round(fid["recorded_tokens"], 1),
+        "replayed_tokens": round(fid["replayed_tokens"], 1),
+        "replay_rate_err": round(fid["rate_err"], 6),
+        "replay_max_share_err": round(fid["max_share_err"], 6),
+        "replay_requests": int(trace.n),
+        "diag_bundles_first": bundles_first,
+        "diag_bundles_final": len(wd.captures),
+        "diag_suppressed": int(suppressed),
+        "diag_bundle_parts": parts,
+        "snapshot_mean_s": round(snap_mean_s, 6),
+        "ledger_overhead_fraction": round(overhead, 6),
+    }), flush=True)
+    return 0
+
+
 def bench_chaos(n: int) -> dict:
     """Serving-fleet fault-tolerance phase on forced host devices: a
     zipfian replay through the router while a chaos injector kills one
@@ -3425,7 +3673,7 @@ def run_child(phases: list[str]) -> int:
            "kernels": bench_kernels, "obs": bench_obs,
            "chaos": bench_chaos, "swap": bench_swap,
            "numerics": bench_numerics, "sched": bench_sched,
-           "autoscale": bench_autoscale}
+           "autoscale": bench_autoscale, "usage": bench_usage}
     ok = True
     for phase in phases:
         try:
@@ -3769,6 +4017,11 @@ def main() -> int:
                         help="internal: million-user simulator gate + "
                              "live predictive scale-up smoke (spawned "
                              "by the autoscale phase)")
+    parser.add_argument("--usage-probe", action="store_true",
+                        help="internal: usage-ledger chargeback "
+                             "identity, capture replay fidelity and "
+                             "diag-watchdog gates (spawned by the "
+                             "usage phase)")
     parser.add_argument("--swap-boot-probe", action="store_true",
                         help="internal: one cold replica boot to first "
                              "token (spawned by the swap probe; "
@@ -3798,6 +4051,8 @@ def main() -> int:
         return run_sched_probe()
     if args.autoscale_probe:
         return run_autoscale_probe()
+    if args.usage_probe:
+        return run_usage_probe()
     if args.child:
         return run_child(args.child.split(","))
     if args.opportunistic:

@@ -154,6 +154,50 @@ def numerics_audit_rate(svc_name: str) -> str:
         return "0.01"
 
 
+def usage_enabled(svc_name: str) -> bool:
+    """The ``m2kt.services.<name>.obs.usage`` QA knob — asked with the
+    same id by ``tpu_usage_optimizer`` (baking ``M2KT_USAGE`` into the
+    pod env) and any emitter surfacing the artifact, so one cached
+    answer keeps them agreed. Default on: the ledger is a periodic
+    dict merge (bench ``usage`` phase bounds it at <= 1%) and an
+    off-by-default ledger bills no one."""
+    from move2kube_tpu import qa
+    from move2kube_tpu.utils import common
+
+    name = common.make_dns_label(svc_name)
+    return qa.fetch_bool(
+        f"m2kt.services.{name}.obs.usage",
+        f"Keep a per-tenant usage ledger on [{name}]?",
+        ["Bounded ring of periodic usage snapshots (per-tenant tokens, "
+         "latency histograms, slot occupancy, weights version) served "
+         "at /usage and exit-flushed to m2kt-usage.jsonl — the input "
+         "to fleet chargeback and capture->replay; <= 1% overhead, "
+         "gated in the bench"],
+        True)
+
+
+def diag_enabled(svc_name: str) -> bool:
+    """The ``m2kt.services.<name>.obs.diag`` QA knob: should the
+    anomaly watchdog auto-capture diagnostic bundles (profiler trace +
+    span ring + ledger window into ``M2KT_DIAG_DIR``) on SLO fast-burn,
+    step-time regression, or non-finite steps? Rate-limited by
+    ``M2KT_DIAG_MIN_INTERVAL_S`` and capped by
+    ``M2KT_DIAG_MAX_CAPTURES`` so a flapping SLO cannot fill a
+    volume."""
+    from move2kube_tpu import qa
+    from move2kube_tpu.utils import common
+
+    name = common.make_dns_label(svc_name)
+    return qa.fetch_bool(
+        f"m2kt.services.{name}.obs.diag",
+        f"Auto-capture diagnostic bundles on anomalies for [{name}]?",
+        ["One-shot bundle (jax.profiler trace, /traces drain, usage-"
+         "ledger window) into M2KT_DIAG_DIR when SLO fast-burn fires, "
+         "step-time p95 regresses vs the rolling baseline, or a "
+         "non-finite step lands; rate-limited and capped"],
+        True)
+
+
 def maybe_rules_objects(svc: Service, ir: IR,
                         selector_label: str) -> list[dict]:
     """PrometheusRule + Grafana dashboard ConfigMap next to the

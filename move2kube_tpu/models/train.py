@@ -686,6 +686,11 @@ class StepTelemetry:
         # gauge live from measured wall times
         self._cost_report = None
         self._chip_spec = None
+        # optional anomaly watchdog (obs/bridge.DiagWatchdog): sync-point
+        # step times feed its regression baseline and a detected
+        # non-finite step edge-triggers a diagnostic capture. The loop
+        # assigns it post-construction; None keeps telemetry standalone.
+        self.watchdog = None
 
     def record_cost_model(self, step_fn, *args,
                           accelerator: str = "") -> None:
@@ -746,6 +751,13 @@ class StepTelemetry:
                     self._record_numerics(step, state, loss)
                 except Exception:  # noqa: BLE001 - never kill a run
                     pass
+            if self.watchdog is not None:
+                # sync points only: unsynced steps record dispatch time,
+                # which would poison the regression baseline
+                try:
+                    self.watchdog.observe_step(seconds)
+                except Exception:  # noqa: BLE001 - never kill a run
+                    pass
         if (self._cost_report is not None and self._chip_spec is not None
                 and seconds > 0):
             mfu = self._cost_report.mfu(seconds, self._chip_spec)
@@ -804,6 +816,11 @@ class StepTelemetry:
             return
         self._nonfinite_steps.inc()
         self._last_bad_group = bad or "loss"
+        if self.watchdog is not None:
+            try:
+                self.watchdog.note_nonfinite()
+            except Exception:  # noqa: BLE001 - never kill a run
+                pass
         if self.tracer is not None:
             now = time.perf_counter()
             self.tracer.record("train.numerics.nonfinite", now, now,

@@ -31,12 +31,24 @@ group tensor-health summaries recorded into the optimizer state,
 non-finite forensics with a first-bad-layer sidecar the flight recorder
 folds in, and the serving quant-drift audit knobs.
 
+PR 20 adds the usage plane: the per-pod usage ledger (:mod:`ledger`) —
+bounded snapshot rings of per-tenant tokens/latency/occupancy served at
+``GET /usage`` and exit-flushed to ``m2kt-usage.jsonl`` — and the
+anomaly watchdog (:class:`bridge.DiagWatchdog`) that freezes a one-shot
+diagnostic bundle (profiler trace + span ring + ledger window) on SLO
+fast-burn, step-time regression, or non-finite steps. The fleet-side
+consumers (chargeback, capture→replay) live in
+``serving/fleet/capture.py``.
+
 Stdlib-only on import (jax is loaded lazily, only for profiling and
 device-memory reads) so the whole package vendors into emitted images.
 """
 
 from move2kube_tpu.obs.bridge import (
+    DiagWatchdog,
     StragglerDetector,
+    diag_dir,
+    diag_enabled,
     install_goodput_hook,
     install_trace_hook,
     mirror_goodput,
@@ -82,6 +94,14 @@ from move2kube_tpu.obs.numerics import (
 from move2kube_tpu.obs.numerics import audit_rate as quant_audit_rate
 from move2kube_tpu.obs.numerics import enabled as numerics_enabled
 from move2kube_tpu.obs.numerics import summary as numerics_summary
+from move2kube_tpu.obs.ledger import (
+    UsageLedger,
+    engine_source,
+    install_usage_flush,
+    router_source,
+    usage_path,
+)
+from move2kube_tpu.obs.ledger import enabled as usage_enabled
 from move2kube_tpu.obs.slo import (
     SLOSpec,
     SLOTracker,
@@ -125,6 +145,15 @@ __all__ = [
     "install_trace_hook",
     "install_goodput_hook",
     "StragglerDetector",
+    "DiagWatchdog",
+    "diag_dir",
+    "diag_enabled",
+    "UsageLedger",
+    "engine_source",
+    "router_source",
+    "install_usage_flush",
+    "usage_enabled",
+    "usage_path",
     "Span",
     "SpanRecorder",
     "get_tracer",

@@ -28,6 +28,11 @@ DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 # values (tenant ids arrive on request headers)
 OVERFLOW_LABEL = "other"
 
+# every labels() call a capped family redirected into the overflow seat,
+# by family — the cap used to fire silently, which made "tenant 'other'
+# is hot" indistinguishable from "the cap is eating real tenants"
+DROPPED_SERIES = "m2kt_obs_series_dropped_total"
+
 
 def _escape_label(value: str) -> str:
     return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
@@ -70,6 +75,8 @@ class _Family:
         self.max_series = int(max_series)
         self._lock = lock
         self._children: dict[tuple[str, ...], object] = {}
+        # registry-installed callback fired on every overflow redirect
+        self._on_overflow = None
 
     def labels(self, *values, **kwvalues):
         if kwvalues:
@@ -88,16 +95,23 @@ class _Family:
             raise ValueError(
                 f"{self.name} takes {len(self.labelnames)} label values, "
                 f"got {len(values)}")
+        overflowed = False
         with self._lock:
             child = self._children.get(values)
             if child is None:
                 if (self.max_series > 0 and self.labelnames
                         and len(self._children) >= self.max_series):
+                    overflowed = True
                     values = (OVERFLOW_LABEL,) * len(self.labelnames)
                     child = self._children.get(values)
             if child is None:
                 child = self._children[values] = self._make_child()
-            return child
+        if overflowed and self._on_overflow is not None:
+            try:
+                self._on_overflow(self.name)
+            except Exception:  # noqa: BLE001 - accounting must not break updates
+                pass
+        return child
 
     def _default_child(self):
         if self.labelnames:
@@ -132,6 +146,17 @@ class _Family:
         with self._lock:
             return float(sum(getattr(c, "value", 0.0)
                              for c in self._children.values()))
+
+    def samples(self) -> list[tuple[tuple[str, ...], float]]:
+        """Consistent ``[(label_values, value)]`` snapshot of every
+        scalar child — the usage ledger reads per-tenant counters this
+        way instead of reparsing its own exposition page. Histogram
+        children (no scalar ``value``) are skipped; use
+        :meth:`Histogram.snapshots` for those."""
+        with self._lock:
+            return [(values, float(child.value))
+                    for values, child in self._children.items()
+                    if hasattr(child, "value")]
 
 
 class _Value:
@@ -234,6 +259,14 @@ class Histogram(_Family):
 
     def snapshot(self) -> "HistogramSnapshot":
         return self._default_child().snapshot()
+
+    def snapshots(self) -> dict[tuple[str, ...], "HistogramSnapshot"]:
+        """Per-label-set :class:`HistogramSnapshot` copies — how the
+        usage ledger freezes the per-tenant latency distributions."""
+        with self._lock:
+            children = dict(self._children)
+        return {values: child.snapshot()
+                for values, child in children.items()}
 
     @property
     def count(self) -> int:
@@ -480,8 +513,21 @@ class Registry:
                         f"{name} already registered as {fam.kind}")
                 return fam
             fam = cls(name, help, tuple(labels), self._lock, **kw)
+            if name != DROPPED_SERIES:
+                fam._on_overflow = self._note_series_drop
             self._families[name] = fam
             return fam
+
+    def _note_series_drop(self, family: str) -> None:
+        """Count one cardinality-cap trip: a ``labels()`` lookup this
+        registry redirected into a family's overflow seat. The drop
+        counter itself is uncapped (family names are code-controlled)
+        and exempt from the callback, so the accounting can't recurse."""
+        self.counter(
+            DROPPED_SERIES,
+            "Label lookups redirected into the 'other' overflow series "
+            "by a family's max_series cap", labels=("family",),
+        ).labels(family=family).inc()
 
     def counter(self, name: str, help: str = "",
                 labels: tuple[str, ...] = (),

@@ -44,6 +44,11 @@ THRESHOLDS = {
     # this multiple of the objective WHILE preemption is active is being
     # starved by higher classes, not by its own quota
     "tpuschedstarvefactor": "4",
+    # usage/diag plane (obs/ledger.py, obs/bridge.py DiagWatchdog):
+    # diag bundles captured per hour before the watchdog itself is the
+    # anomaly — each bundle is a profiler pause plus disk, so a storm
+    # means a flapping trigger or a mis-set rate limit
+    "tpudiagstormcount": "4",
 }
 
 
@@ -173,6 +178,32 @@ def prometheus_rule(name: str, selector_label: str,
                     "m2kt-flight.json) holds the full per-group tensor "
                     "health of the bad step. Check the loss scale "
                     "(m2kt_train_loss_scale) before blaming the data."),
+            },
+        },
+        {
+            "alert": "M2KTDiagCaptureStorm",
+            # the watchdog is rate-limited and capped in-process; this
+            # alert is the out-of-process backstop — a pod repeatedly
+            # arming means a flapping trigger (SLO oscillating around
+            # the burn threshold, a bimodal step time) or an operator
+            # who set M2KT_DIAG_MIN_INTERVAL_S to zero. The reason
+            # label on m2kt_diag_captures_total names the trigger.
+            "expr": (
+                f"sum(increase(m2kt_diag_captures_total{sel}[1h])) "
+                f"> {th['tpudiagstormcount']}"),
+            "for": "0m",
+            "labels": {"severity": "warning", "m2kt_service": name},
+            "annotations": {
+                "summary": f"{name}: diagnostic captures storming",
+                "description": (
+                    "The anomaly watchdog has captured more diagnostic "
+                    "bundles this hour than the storm budget — each one "
+                    "pauses the workload for a profiler trace and "
+                    "writes a bundle to M2KT_DIAG_DIR. Read the reason "
+                    "label (slo_fast_burn / step_regression / "
+                    "nonfinite) and the newest bundle's manifest; fix "
+                    "the underlying flap or raise "
+                    "M2KT_DIAG_MIN_INTERVAL_S."),
             },
         },
     ]
@@ -468,6 +499,27 @@ def grafana_dashboard(name: str, selector_label: str,
             27, "Host overhead ratio (gap / wall)",
             f"m2kt_serve_host_overhead_ratio{sel}", 0, 104,
             "percentunit"))
+        # usage/cost row (obs/ledger.py + serving/fleet/capture.py):
+        # who the fleet's TPU-seconds are billed to (attainment-
+        # weighted, from the aggregator), each tenant's net token rate,
+        # and the two self-health series of the plane itself — diag
+        # bundles by reason and label-cardinality drops by family
+        panels.append(_panel(
+            28, "Tenant TPU-seconds rate (attainment-weighted)",
+            f"sum(rate(m2kt_tenant_tpu_seconds_total{sel}[5m])) "
+            "by (tenant)", 12, 104))
+        panels.append(_panel(
+            29, "Tenant net token rate (tok/s)",
+            f"sum(rate(m2kt_router_admitted_tokens_total{sel}[5m])) "
+            "by (tenant) - sum(rate("
+            f"m2kt_router_admitted_tokens_unused_total{sel}[5m])) "
+            "by (tenant)", 0, 112))
+        panels.append(_panel(
+            30, "Diag captures by reason / series drops by family",
+            f"sum(increase(m2kt_diag_captures_total{sel}[1h])) "
+            "by (reason) or "
+            f"sum(increase(m2kt_obs_series_dropped_total{sel}[1h])) "
+            "by (family)", 12, 112))
     return {
         "title": f"move2kube-tpu: {name}",
         "uid": f"m2kt-{name}",

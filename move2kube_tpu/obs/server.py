@@ -41,6 +41,20 @@ DEFAULT_PROFILE_DIR = "/tmp/m2kt-profile"
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 MAX_PROFILE_SECONDS = 120.0
 
+# default /traces response bound: the ring window at a generous span
+# rate. A decode engine emits a handful of spans per request, so 64/s
+# covers a busy replica; an operator chasing more passes ?limit=N.
+TRACE_SPANS_PER_SECOND = 64
+
+
+def default_trace_limit() -> int:
+    """Span cap for an unqualified ``/traces`` pull, derived from the
+    ring window (``M2KT_TRACE_RING_SECONDS``) so the default response
+    stays proportional to what the ring can hold."""
+    from move2kube_tpu.obs import tracing
+
+    return max(1, int(tracing.ring_seconds() * TRACE_SPANS_PER_SECOND))
+
 
 def metrics_port_from_env(default: int = 0) -> int:
     """Resolve the telemetry port: env wins, else the baked-in default;
@@ -58,11 +72,14 @@ class TelemetryServer:
 
     def __init__(self, port: int = 0, registry: Registry | None = None,
                  profile_dir: str | None = None,
-                 readiness=None, tracer=None) -> None:
+                 readiness=None, tracer=None, ledger=None) -> None:
         self.registry = registry if registry is not None else default_registry()
         # span recorder served by /traces; None falls back to the
         # process-wide recorder iff tracing is enabled
         self._tracer = tracer
+        # usage ledger served by /usage (set_ledger post-construction:
+        # the serve template builds the server before the engine exists)
+        self._ledger = ledger
         self.profile_dir = (profile_dir
                             or os.environ.get(PROFILE_DIR_ENV, "")
                             or DEFAULT_PROFILE_DIR)
@@ -111,6 +128,8 @@ class TelemetryServer:
             self._handle_profile(req, parse_qs(parsed.query))
         elif parsed.path == "/traces":
             self._handle_traces(req, parse_qs(parsed.query))
+        elif parsed.path == "/usage":
+            self._handle_usage(req, parse_qs(parsed.query))
         else:
             self._send(req, 404, "not found\n")
 
@@ -124,6 +143,10 @@ class TelemetryServer:
         post-construction shape as ``set_readiness``)."""
         self._tracer = tracer
 
+    def set_ledger(self, ledger) -> None:
+        """Install/replace the usage ledger served by ``/usage``."""
+        self._ledger = ledger
+
     def _handle_traces(self, req, query: dict) -> None:
         from move2kube_tpu.obs import tracing
 
@@ -133,9 +156,31 @@ class TelemetryServer:
         if tracer is None:
             self._send(req, 404, "tracing disabled\n")
             return
-        doc = tracer.ring_doc()
+        try:
+            limit = int(query.get("limit", [""])[0] or default_trace_limit())
+        except (TypeError, ValueError):
+            self._send(req, 400, "limit must be an integer\n")
+            return
+        doc = tracer.ring_doc(limit=max(0, limit))
         if query.get("clear", ["0"])[0] not in ("0", "", "false"):
             tracer.clear()
+        self._send(req, 200, json.dumps(doc) + "\n", "application/json")
+
+    def _handle_usage(self, req, query: dict) -> None:
+        ledger = self._ledger
+        if ledger is None:
+            self._send(req, 404, "usage ledger disabled\n")
+            return
+        try:
+            window = float(query.get("window", ["0"])[0] or 0)
+        except (TypeError, ValueError):
+            self._send(req, 400, "window must be a number\n")
+            return
+        try:
+            doc = ledger.doc(window_s=window if window > 0 else None)
+        except Exception as e:  # noqa: BLE001 - probe must not 500
+            self._send(req, 422, f"usage ledger errored: {e}\n")
+            return
         self._send(req, 200, json.dumps(doc) + "\n", "application/json")
 
     def _handle_readyz(self, req) -> None:
@@ -209,7 +254,7 @@ def start_telemetry_server(port: int | None = None,
                            registry: Registry | None = None,
                            profile_dir: str | None = None,
                            readiness=None,
-                           tracer=None) -> TelemetryServer | None:
+                           tracer=None, ledger=None) -> TelemetryServer | None:
     """Start the telemetry server. ``port=None`` resolves from
     ``M2KT_METRICS_PORT`` and returns None when that says disabled (0 /
     unset) — the shape the emitted templates use. An explicit ``port=0``
@@ -221,7 +266,8 @@ def start_telemetry_server(port: int | None = None,
     try:
         return TelemetryServer(port=port, registry=registry,
                                profile_dir=profile_dir,
-                               readiness=readiness, tracer=tracer).start()
+                               readiness=readiness, tracer=tracer,
+                               ledger=ledger).start()
     except OSError:
         # never kill a training run over a busy metrics port
         return None

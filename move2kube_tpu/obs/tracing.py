@@ -396,10 +396,20 @@ class SpanRecorder:
 
     # -- flight-recorder half ---------------------------------------------
 
-    def ring_doc(self) -> dict:
+    def ring_doc(self, limit: int | None = None) -> dict:
         """The ring as one self-describing JSON document — the shape the
         flight recorder dumps and the ``/traces`` drain endpoint serves,
-        so the fleet collector and the supervisor parse the same thing."""
+        so the fleet collector and the supervisor parse the same thing.
+
+        ``limit`` bounds the span list to the NEWEST ``limit`` entries
+        (the ones a diagnosis wants); ``truncated`` counts what the
+        bound cut and ``dropped`` what ring eviction already lost, so a
+        reader always knows how much history is missing."""
+        spans = self.snapshot()
+        truncated = 0
+        if limit is not None and limit >= 0 and len(spans) > limit:
+            truncated = len(spans) - limit
+            spans = spans[len(spans) - limit:]
         return {
             "host": self.host,
             "slice_id": self.slice_id,
@@ -408,7 +418,8 @@ class SpanRecorder:
             "written_unix": time.time(),
             "ring_seconds": self.ring_seconds,
             "dropped": self.dropped,
-            "spans": self.snapshot(),
+            "truncated": truncated,
+            "spans": spans,
         }
 
     def flush_ring(self, path: str | None = None) -> str | None:

@@ -574,6 +574,51 @@ def tpu_numerics_optimizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_usage_optimizer(ir: IR) -> IR:
+    """Bake the usage-ledger and anomaly-diagnostics env into
+    accelerated services behind the ``m2kt.services.<name>.obs.usage``
+    and ``.obs.diag`` QA knobs (``apiresource.obs_wiring`` — shared +
+    cached, so every consumer agrees). Both runtime defaults are on, so
+    a knob answered off bakes an explicit ``0``: the pod env must
+    record the decision. Enabled pods also carry the tuning env —
+    ``M2KT_USAGE_INTERVAL_S`` / ``M2KT_USAGE_RING`` and
+    ``M2KT_DIAG_MIN_INTERVAL_S`` — at the runtime defaults so the Helm
+    parameterizer has literals to lift into chart values. Existing env
+    entries are never overwritten."""
+    from move2kube_tpu.apiresource.obs_wiring import (
+        diag_enabled,
+        usage_enabled,
+    )
+    from move2kube_tpu.obs import bridge as obs_bridge
+    from move2kube_tpu.obs import ledger as obs_ledger
+
+    for svc in ir.services.values():
+        acc = getattr(svc, "accelerator", None)
+        if acc is None:
+            continue
+        use = usage_enabled(svc.name)
+        diag = diag_enabled(svc.name)
+        entries = [("M2KT_USAGE", "1" if use else "0"),
+                   ("M2KT_DIAG", "1" if diag else "0")]
+        if use:
+            entries += [
+                ("M2KT_USAGE_INTERVAL_S",
+                 f"{obs_ledger.DEFAULT_INTERVAL_S:g}"),
+                ("M2KT_USAGE_RING", str(obs_ledger.DEFAULT_RING)),
+            ]
+        if diag:
+            entries.append(
+                ("M2KT_DIAG_MIN_INTERVAL_S",
+                 f"{obs_bridge.DEFAULT_DIAG_MIN_INTERVAL_S:g}"))
+        for container in svc.containers:
+            env = container.setdefault("env", [])
+            existing = {e.get("name") for e in env}
+            for env_name, value in entries:
+                if env_name not in existing:
+                    env.append({"name": env_name, "value": value})
+    return ir
+
+
 OPTIMIZERS = [
     normalize_character_optimizer,
     ingress_optimizer,
@@ -589,6 +634,7 @@ OPTIMIZERS = [
     tpu_sched_optimizer,
     tpu_planreport_optimizer,
     tpu_numerics_optimizer,
+    tpu_usage_optimizer,
 ]
 
 

@@ -294,6 +294,38 @@ def tpu_numerics_parameterizer(ir: IR) -> IR:
     return ir
 
 
+def tpu_usage_parameterizer(ir: IR) -> IR:
+    """Lift the usage-ledger / diagnostics env the usage optimizer
+    injected into chart values: ``M2KT_USAGE`` -> ``tpuusage``,
+    ``M2KT_USAGE_INTERVAL_S`` -> ``tpuusageinterval``,
+    ``M2KT_USAGE_RING`` -> ``tpuusagering``, ``M2KT_DIAG`` ->
+    ``tpudiag`` and ``M2KT_DIAG_MIN_INTERVAL_S`` ->
+    ``tpudiagmininterval`` — so a Helm install can turn off chargeback
+    collection, retune the snapshot cadence, or relax the diag-capture
+    rate limit (``--set tpudiagmininterval=60``) without a rebuild."""
+    lifted = {
+        "M2KT_USAGE": "tpuusage",
+        "M2KT_USAGE_INTERVAL_S": "tpuusageinterval",
+        "M2KT_USAGE_RING": "tpuusagering",
+        "M2KT_DIAG": "tpudiag",
+        "M2KT_DIAG_MIN_INTERVAL_S": "tpudiagmininterval",
+    }
+    for svc in ir.services.values():
+        if getattr(svc, "accelerator", None) is None:
+            continue
+        for container in svc.containers:
+            for env in container.get("env", []) or []:
+                key = lifted.get(env.get("name"))
+                if key is None:
+                    continue
+                value = env.get("value")
+                if value is None or "{{" in str(value):
+                    continue
+                ir.values.global_variables.setdefault(key, str(value))
+                env["value"] = "{{ .Values.%s }}" % key
+    return ir
+
+
 def tpu_rules_parameterizer(ir: IR) -> IR:
     """Lift the alert-rule thresholds (obs/rules.py ``THRESHOLDS``) into
     chart values for every service whose ``m2kt.services.<name>.obs.rules``
@@ -329,7 +361,8 @@ PARAMETERIZERS = [image_name_parameterizer, ingress_parameterizer,
                   tpu_elastic_parameterizer,
                   tpu_obs_parameterizer, tpu_slo_parameterizer,
                   tpu_sched_parameterizer,
-                  tpu_numerics_parameterizer, tpu_rules_parameterizer]
+                  tpu_numerics_parameterizer, tpu_usage_parameterizer,
+                  tpu_rules_parameterizer]
 
 
 def parameterize(ir: IR) -> IR:

@@ -151,6 +151,12 @@ from move2kube_tpu.serving.kvcache import (
 LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 
+# request-shape buckets (tokens): power-of-two edges matching the
+# prefill bucket ladder, so a recorded histogram replays onto the same
+# compile buckets the engine actually serves
+LENGTH_BUCKETS = (16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0,
+                  2048.0, 4096.0)
+
 
 def select_decode_matmul(mesh=None):
     """Pick the decode-projection matmul for this deployment.
@@ -676,6 +682,17 @@ class ServingEngine:
             "m2kt_serve_tenant_rejected_total",
             "Requests rejected at submit by tenant",
             labels=("tenant",), max_series=cap + 1)
+        # request-shape histograms: the usage ledger snapshots these so
+        # the fleet capture can replay each tenant's prompt/output
+        # length mix, not just its aggregate token rate
+        self._tenant_prompt_tokens = reg.histogram(
+            "m2kt_serve_tenant_prompt_tokens",
+            "Prompt length (tokens) of completed requests by tenant",
+            buckets=LENGTH_BUCKETS, labels=("tenant",), max_series=cap + 1)
+        self._tenant_decode_tokens = reg.histogram(
+            "m2kt_serve_tenant_decode_tokens",
+            "Generated length (tokens) of completed requests by tenant",
+            buckets=LENGTH_BUCKETS, labels=("tenant",), max_series=cap + 1)
         self._quant_mode = reg.gauge(
             "m2kt_serve_quant_mode",
             "Serving quant policy (0=off, 1=int8, 2=int8-kv)")
@@ -1692,7 +1709,14 @@ class ServingEngine:
         self._allocator.free(slot.pages)
         self._slots[slot_idx] = None
         self._completed.labels(reason=reason).inc()
-        self._req_tenant.pop(slot.req.rid, None)
+        tenant = self._req_tenant.pop(slot.req.rid, None) or "default"
+        if reason != "preempted":
+            # a preempted stream resumes and releases again — recording
+            # it here would double-count the request's shape
+            self._tenant_prompt_tokens.labels(tenant).observe(
+                float(len(slot.req.prompt)))
+            self._tenant_decode_tokens.labels(tenant).observe(
+                float(len(slot.tokens)))
         self._deadline_abs.pop(slot.req.rid, None)
         self._submit_ts.pop(slot.req.rid, None)
         if self.adapters is not None:

@@ -43,7 +43,8 @@ from dataclasses import dataclass
 
 from move2kube_tpu.obs.metrics import Registry, default_registry
 from move2kube_tpu.serving.fleet.forecast import (
-    CounterDemand, DemandForecaster)
+    CounterDemand, DemandForecaster, TenantCounterDemand,
+    TenantDemandForecaster)
 
 log = logging.getLogger("move2kube_tpu.autoscaler")
 
@@ -300,21 +301,105 @@ class FleetActuator:
 # emitted controller Deployment main loop
 # ---------------------------------------------------------------------------
 
+def _split_labels(line: str, name: str) -> tuple[str, str] | None:
+    """Split one exposition line of family ``name`` into
+    ``(label_section, rest)``. Quote-aware: a ``}`` inside a quoted
+    label value (tenants are untrusted header strings) does not end the
+    label section. Returns None when the line is not this family or is
+    malformed — the caller warns and moves on, never raises."""
+    if line.startswith(name + "{"):
+        i = len(name) + 1
+        in_quotes = False
+        escaped = False
+        while i < len(line):
+            c = line[i]
+            if escaped:
+                escaped = False
+            elif c == "\\":
+                escaped = True
+            elif c == '"':
+                in_quotes = not in_quotes
+            elif c == "}" and not in_quotes:
+                return line[len(name) + 1:i], line[i + 1:].strip()
+            i += 1
+        return None  # unterminated label section
+    if line.startswith(name + " ") or line.startswith(name + "\t"):
+        return "", line[len(name):].strip()
+    return None
+
+
 def parse_counter_total(text: str, name: str) -> float:
     """Sum every sample of ``name`` (all label sets) in a Prometheus
-    text exposition page. Tolerant of anything that is not the metric."""
+    text exposition page. Tolerant of anything that is not the metric,
+    of labeled families (quote-aware — a ``}`` inside a tenant label
+    value does not truncate the parse), and of exposition lines with
+    trailing timestamps (``name value timestamp``: the VALUE is the
+    first token after the labels, not the last token on the line).
+    Malformed samples warn and are skipped — this runs inside the
+    emitted controller loop and must fail open, never crash it."""
     total = 0.0
+    bad = 0
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        if not (line.startswith(name + "{") or line.startswith(name + " ")):
+        if not line.startswith(name):
             continue
+        parts = _split_labels(line, name)
+        if parts is None:
+            if line.startswith(name + "{"):
+                bad += 1  # this family, unterminated labels
+            continue
+        _, rest = parts
+        fields = rest.split()
         try:
-            total += float(line.rsplit(None, 1)[-1])
-        except ValueError:
+            total += float(fields[0])
+        except (IndexError, ValueError):
+            bad += 1
             continue
+    if bad:
+        log.warning("%d malformed exposition line(s) for %s skipped",
+                    bad, name)
     return total
+
+
+def parse_counter_by_label(text: str, name: str,
+                           label: str) -> dict[str, float]:
+    """Per-label-value sums of ``name`` — the per-tenant split of the
+    same page :func:`parse_counter_total` aggregates. Samples missing
+    the label fold into ``""``; malformed samples warn and are skipped
+    (same fail-open contract)."""
+    import re
+
+    out: dict[str, float] = {}
+    bad = 0
+    pat = re.compile(label + r'="((?:[^"\\]|\\.)*)"')
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or not line.startswith(name):
+            continue
+        parts = _split_labels(line, name)
+        if parts is None:
+            if line.startswith(name + "{"):
+                bad += 1
+            continue
+        labels_raw, rest = parts
+        fields = rest.split()
+        try:
+            value = float(fields[0])
+        except (IndexError, ValueError):
+            bad += 1
+            continue
+        m = pat.search(labels_raw)
+        key = ""
+        if m:
+            key = (m.group(1).replace('\\"', '"')
+                   .replace("\\n", "\n").replace("\\\\", "\\"))
+        out[key] = out.get(key, 0.0) + value
+    if bad:
+        log.warning("%d malformed exposition line(s) for %s skipped",
+                    bad, name)
+    return out
 
 
 def scrape_admitted_tokens(url: str, timeout_s: float = 5.0) -> float | None:
@@ -325,11 +410,30 @@ def scrape_admitted_tokens(url: str, timeout_s: float = 5.0) -> float | None:
     try:
         with urllib.request.urlopen(url, timeout=timeout_s) as resp:
             text = resp.read().decode("utf-8", "replace")
+        return (parse_counter_total(text, ADMITTED_COUNTER)
+                - parse_counter_total(text, UNUSED_COUNTER))
     except Exception as err:  # noqa: BLE001 - scrape is best-effort
         log.warning("metrics scrape %s failed: %s", url, err)
         return None
-    return (parse_counter_total(text, ADMITTED_COUNTER)
-            - parse_counter_total(text, UNUSED_COUNTER))
+
+
+def scrape_tenant_admitted_tokens(
+        url: str, timeout_s: float = 5.0) -> dict[str, float] | None:
+    """Per-tenant net admitted-token counters from the router's
+    /metrics page (admitted minus the unused corrections), or None on
+    any failure. Negative per-tenant nets clamp to 0 — a correction
+    outpacing admissions is not negative demand."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            text = resp.read().decode("utf-8", "replace")
+        admitted = parse_counter_by_label(text, ADMITTED_COUNTER, "tenant")
+        unused = parse_counter_by_label(text, UNUSED_COUNTER, "tenant")
+        return {tenant: max(0.0, value - unused.get(tenant, 0.0))
+                for tenant, value in admitted.items()}
+    except Exception as err:  # noqa: BLE001 - scrape is best-effort
+        log.warning("tenant metrics scrape %s failed: %s", url, err)
+        return None
 
 
 class KubeScaleActuator:
@@ -390,7 +494,17 @@ def run_controller(loops: int | None = None,
     """Main loop of the emitted autoscaler Deployment: scrape the
     router counters, forecast, decide, export gauges, optionally patch
     the decode Deployment's scale. Runs forever in the pod (``loops``
-    bounds it for tests). Returns the last target."""
+    bounds it for tests). Returns the last target.
+
+    The forecast is per-tenant (closing the ROADMAP item-2 leftover):
+    each tenant's net admitted-token counter feeds its own
+    Holt-Winters forecaster, the controller scales on the sum, and the
+    split exports as ``m2kt_autoscale_tenant_forecast_tps{tenant}``.
+    When the page carries no tenant labels the whole rate lands on the
+    ``default`` tenant, which degrades to exactly the old aggregate
+    behavior."""
+    from move2kube_tpu.obs.slo import DEFAULT_TENANT, max_tenants
+
     cfg = AutoscaleConfig.from_env()
     url = os.environ.get(METRICS_URL_ENV, "").strip()
     target_deploy = os.environ.get(TARGET_ENV, "").strip()
@@ -398,12 +512,18 @@ def run_controller(loops: int | None = None,
         raise SystemExit(f"{METRICS_URL_ENV} is required for the "
                          "autoscaler role")
     reg = registry or default_registry()
-    forecaster = DemandForecaster(clock=clock)
-    demand = CounterDemand(lambda: 0.0, forecaster, clock=clock,
-                           window_s=max(30.0, 2 * cfg.interval_s))
+    window_s = max(30.0, 2 * cfg.interval_s)
+    forecaster = TenantDemandForecaster(clock=clock,
+                                        max_tenants=max_tenants())
+    demand = TenantCounterDemand(forecaster, clock=clock,
+                                 window_s=window_s)
     scaler = PredictiveAutoscaler(
         forecaster, lambda: replica_capacity_tps(default=100.0),
         config=cfg, clock=clock, registry=reg)
+    g_tenant_forecast = reg.gauge(
+        "m2kt_autoscale_tenant_forecast_tps",
+        "Forecast admitted-token demand per tenant at now + lead",
+        labels=("tenant",), max_series=max_tenants() + 1)
     actuator = None
     if target_deploy and os.environ.get(ACTUATE_ENV, "").strip() == "1":
         actuator = KubeScaleActuator(target_deploy)
@@ -411,9 +531,19 @@ def run_controller(loops: int | None = None,
     n = 0
     while loops is None or n < loops:
         n += 1
-        value = scrape_admitted_tokens(url)
-        if value is not None:
-            demand.tick(value=value)
+        per_tenant = scrape_tenant_admitted_tokens(url)
+        if per_tenant is None:
+            # labeled scrape failed outright; the aggregate fallback
+            # keeps the controller fed through a degraded page
+            value = scrape_admitted_tokens(url)
+            per_tenant = None if value is None else {DEFAULT_TENANT: value}
+        if per_tenant is not None:
+            if not per_tenant:
+                per_tenant = {DEFAULT_TENANT: 0.0}
+            demand.tick(per_tenant)
+            for tenant, tps in forecaster.forecast_by_tenant(
+                    cfg.lead_time_s).items():
+                g_tenant_forecast.labels(tenant=tenant).set(tps)
             new = scaler.decide(current)
             if new != current and actuator is not None:
                 if actuator.scale_to(new):

@@ -167,6 +167,69 @@ class DemandForecaster:
                    + self.seasonal(target))
 
 
+class TenantDemandForecaster:
+    """Per-tenant :class:`DemandForecaster` bank behind the aggregate
+    forecaster's API — the ROADMAP item-2 leftover: the emitted
+    controller used to forecast one aggregate rate, so a burst in one
+    tenant was smeared across the seasonal memory of all of them.
+
+    ``observe(tenant, tps)`` routes to that tenant's forecaster
+    (first-come seats up to ``max_tenants``; later tenants share one
+    overflow forecaster, the same bounded-cardinality convention as the
+    metric labels). ``forecast(horizon_s)`` sums the per-tenant
+    forecasts, so a :class:`PredictiveAutoscaler` holding this object
+    needs no changes; :meth:`forecast_by_tenant` exposes the split for
+    gauges and chargeback-aware scaling. All tenants share one seasonal
+    epoch so their diurnal bins align."""
+
+    OVERFLOW = "other"
+
+    def __init__(self, config: ForecastConfig | None = None,
+                 clock=time.monotonic, epoch: float | None = None,
+                 max_tenants: int = 8) -> None:
+        self.config = config or ForecastConfig()
+        self._clock = clock
+        self._epoch = epoch
+        self.max_tenants = max(1, int(max_tenants))
+        self._forecasters: dict[str, DemandForecaster] = {}
+
+    def _get(self, tenant: str) -> DemandForecaster:
+        f = self._forecasters.get(tenant)
+        if f is None:
+            if (len(self._forecasters) >= self.max_tenants
+                    and tenant != self.OVERFLOW):
+                return self._get(self.OVERFLOW)
+            f = self._forecasters[tenant] = DemandForecaster(
+                self.config, clock=self._clock, epoch=self._epoch)
+        return f
+
+    def tenants(self) -> list[str]:
+        return list(self._forecasters)
+
+    def observe(self, tenant: str, tps: float,
+                t: float | None = None) -> None:
+        now = self._clock() if t is None else float(t)
+        if self._epoch is None:
+            # one shared epoch: every tenant's seasonal bins align
+            self._epoch = now
+        self._get(str(tenant)).observe(tps, t=now)
+
+    def forecast_by_tenant(self, horizon_s: float = 0.0,
+                           now: float | None = None) -> dict[str, float]:
+        return {tenant: f.forecast(horizon_s, now=now)
+                for tenant, f in self._forecasters.items()}
+
+    def forecast(self, horizon_s: float = 0.0,
+                 now: float | None = None) -> float:
+        """Aggregate demand = sum of per-tenant forecasts — the shape
+        :class:`PredictiveAutoscaler` consumes unchanged."""
+        return sum(self.forecast_by_tenant(horizon_s, now=now).values())
+
+    @property
+    def observations(self) -> int:
+        return sum(f.observations for f in self._forecasters.values())
+
+
 class CounterDemand:
     """Demand-rate source over a monotone token counter: wraps the
     shared :class:`WindowRate` sampler (obs/metrics.py) and feeds a
@@ -190,3 +253,52 @@ class CounterDemand:
         tps = self._rate.rate(self.window_s, now=now)
         self.forecaster.observe(tps, t=now)
         return tps
+
+
+class TenantCounterDemand:
+    """Per-tenant :class:`CounterDemand`: one :class:`WindowRate` per
+    tenant over scraped counter values, feeding a
+    :class:`TenantDemandForecaster`. The emitted controller ticks this
+    with the per-tenant net-admitted-token dict each scrape."""
+
+    def __init__(self, forecaster: TenantDemandForecaster,
+                 clock=time.monotonic, window_s: float = 60.0) -> None:
+        self.forecaster = forecaster
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._rates: dict[str, WindowRate] = {}
+
+    def _seat(self, tenant: str) -> str:
+        """The rate-window key ``tenant`` lands on: its own seat while
+        seats remain, the shared overflow seat after (cap + 1 windows
+        total, the same convention as the metric labels)."""
+        if tenant in self._rates:
+            return tenant
+        if len(self._rates) >= self.forecaster.max_tenants:
+            tenant = TenantDemandForecaster.OVERFLOW
+            if tenant in self._rates:
+                return tenant
+        self._rates[tenant] = WindowRate(
+            lambda: 0.0, clock=self._clock,
+            horizon_s=max(600.0, 10 * self.window_s))
+        return tenant
+
+    def tick(self, totals: dict[str, float],
+             t: float | None = None) -> dict[str, float]:
+        """Fold one scrape's per-tenant counter totals in; returns the
+        observed per-tenant tokens/s. Tenants beyond the seat cap fold
+        into the shared overflow rate BEFORE differencing, so their
+        combined counter still differences correctly."""
+        now = self._clock() if t is None else float(t)
+        folded: dict[str, float] = {}
+        for tenant, value in totals.items():
+            key = self._seat(str(tenant))
+            folded[key] = folded.get(key, 0.0) + float(value)
+        out: dict[str, float] = {}
+        for key, value in folded.items():
+            rate = self._rates[key]
+            rate.sample(t=now, value=value)
+            tps = rate.rate(self.window_s, now=now)
+            self.forecaster.observe(key, tps, t=now)
+            out[key] = tps
+        return out

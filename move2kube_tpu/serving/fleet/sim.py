@@ -475,10 +475,13 @@ def simulate(trace: Trace, fleet: FleetConfig, policy) -> SimResult:
 
     good = ttft <= fleet.ttft_slo_s
     per_tenant = {}
+    # captured traces carry the real tenant names; synthetic ones rank
+    names = getattr(trace, "tenant_names", None) \
+        or [f"tenant-{tid}" for tid in range(cfg.tenants)]
     for tid in range(cfg.tenants):
         mask = trace.tenant == tid
         if mask.any():
-            per_tenant[f"tenant-{tid}"] = float(good[mask].mean())
+            per_tenant[names[tid]] = float(good[mask].mean())
     return SimResult(
         policy=getattr(policy, "name", type(policy).__name__),
         requests=n,
@@ -498,16 +501,26 @@ def simulate(trace: Trace, fleet: FleetConfig, policy) -> SimResult:
 
 def compare_policies(trace_cfg: TraceConfig | None = None,
                      fleet_cfg: FleetConfig | None = None,
-                     latency: LatencyModel | None = None) -> dict:
+                     latency: LatencyModel | None = None,
+                     trace=None) -> dict:
     """The bench gate: one trace, both policies, verdict. Returns
     ``{"trace": ..., "reactive": ..., "predictive": ...,
     "predictive_wins": bool}`` where winning means better SLO
-    attainment AND fewer replica-hours on the SAME trace."""
-    trace_cfg = trace_cfg or TraceConfig()
+    attainment AND fewer replica-hours on the SAME trace.
+
+    ``trace`` accepts a prebuilt trace — in particular a
+    :class:`~move2kube_tpu.serving.fleet.capture.CapturedTrace`
+    replaying recorded production traffic — in place of the synthetic
+    diurnal generator; any duck-typed trace exposing the
+    :class:`Trace` surface works."""
     fleet_cfg = fleet_cfg or FleetConfig()
-    latency = latency or LatencyModel.synthetic()
     wall0 = time.perf_counter()
-    trace = Trace(trace_cfg, latency)
+    if trace is None:
+        trace_cfg = trace_cfg or TraceConfig()
+        latency = latency or LatencyModel.synthetic()
+        trace = Trace(trace_cfg, latency)
+    else:
+        trace_cfg = trace.cfg
     reactive = simulate(trace, fleet_cfg,
                         ReactiveHPAPolicy(fleet_cfg))
     predictive = simulate(trace, fleet_cfg,

@@ -49,6 +49,7 @@ def test_resnet_train_step(mesh8):
     assert np.mean(losses[-2:]) < np.mean(losses[:2])  # it learns the batch
 
 
+@pytest.mark.slow  # heavy; runs unfiltered in make ci and the file's smoke target
 def test_unet_diffusion_train_step(mesh8):
     """DDPM UNet (models/unet.py): noise-prediction training on the CPU
     mesh learns the fixed batch; skip connections and timestep

@@ -303,6 +303,7 @@ def test_audit_rate_env_parsing(monkeypatch):
 # ----------------------------------------------------------------------
 
 
+@pytest.mark.slow  # heavy; runs unfiltered in make ci and the file's smoke target
 def test_validation_harness_pass_and_fail(tmp_path):
     """Acceptance round-trip: the stock semantics pass every gate; a
     deliberately-broken translation (constant updates — a wrong

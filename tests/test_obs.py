@@ -761,8 +761,8 @@ def test_rules_emission_behind_qa_knob():
     alerts = {r["alert"]: r for r in group["rules"]}
     assert set(alerts) == {"M2KTGoodputLow", "M2KTStepTimeP95Regression",
                            "M2KTRestartStorm", "M2KTMFULow",
-                           "M2KTHBMHeadroomLow",
-                           "M2KTNonFiniteSteps"}  # trainer: no serving rule
+                           "M2KTHBMHeadroomLow", "M2KTNonFiniteSteps",
+                           "M2KTDiagCaptureStorm"}  # trainer: no serving rule
     # k8s output bakes the literal defaults into the PromQL
     assert "< 0.5" in alerts["M2KTGoodputLow"]["expr"]
     assert "> 1.5 *" in alerts["M2KTStepTimeP95Regression"]["expr"]

@@ -28,6 +28,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # -- the headline proof: kill at step N, supervisor restarts, resume ---------
 
 
+@pytest.mark.slow  # heavy; runs unfiltered in make ci and the file's smoke target
 def test_kill_at_step_resumes_from_checkpoint(tmp_path):
     """The full in-pod story in one subprocess: minitrain dies at step 5
     (injected, exactly-once), the supervisor classifies it retryable and

@@ -327,6 +327,7 @@ def test_preempt_resume_token_exact_fp32(llama_parts):
             rep.close()
 
 
+@pytest.mark.slow  # heavy; runs unfiltered in make ci and the file's smoke target
 def test_resume_refeed_int8kv_logit_gated(llama_parts):
     """The resume mechanics in isolation (what the journal does: re-feed
     prompt + emitted tokens to a fresh prefill) under int8-kv. The
@@ -388,6 +389,7 @@ def test_router_throttles_over_quota(llama_parts):
 # multi-LoRA: batched equivalence vs dedicated merged-weight engines
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow  # heavy; runs unfiltered in make ci and the file's smoke target
 @pytest.mark.parametrize("family", ["llama", "gpt2"])
 def test_multilora_batch_equivalence(family, llama_parts, gpt2_parts):
     model, variables = llama_parts if family == "llama" else gpt2_parts

@@ -225,6 +225,7 @@ def _greedy_reference(model, variables, prompt, n):
     return toks[len(prompt):]
 
 
+@pytest.mark.slow  # heavy; runs unfiltered in make ci and the file's smoke target
 def test_engine_early_finish_and_readmission(llama_engine_parts):
     """max_batch=2 with 4 requests of different lengths: short sequences
     finish early, free their slot and pages, and queued requests are
